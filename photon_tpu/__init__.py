@@ -1,0 +1,15 @@
+"""photon-tpu: a TPU-native framework with the capabilities of photon-ml.
+
+A from-scratch JAX/XLA/Pallas rebuild of the reference
+(TheClimateCorporation/photon-ml, LinkedIn-lineage GLM + GAME/GLMix on
+Spark/Scala — see SURVEY.md): generalized linear models (logistic, linear,
+Poisson, smoothed-hinge SVM), batch second-order optimizers (L-BFGS, OWL-QN,
+TRON) running as single on-device XLA loops, and GAME mixed-effect models
+(fixed effect + per-entity random effects via coordinate descent) with
+data-parallel `psum` gradients and `vmap`-batched entity solves sharded over a
+`jax.sharding.Mesh`.
+"""
+
+__version__ = "0.1.0"
+
+from photon_tpu.types import TaskType  # noqa: F401

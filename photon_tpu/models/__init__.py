@@ -1,0 +1,2 @@
+"""Model substrate: Coefficients pytree, GLM per-task models, GAME models."""
+from photon_tpu.models.coefficients import Coefficients  # noqa: F401

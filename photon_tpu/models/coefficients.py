@@ -1,0 +1,37 @@
+"""Model coefficients as a JAX pytree.
+
+Parity: reference ⟦photon-lib/.../model/Coefficients.scala⟧ — a Breeze vector of
+means plus optional per-coefficient variances. Here it is a frozen dataclass
+registered as a pytree so it flows through jit/vmap/shard_map and can be
+sharded over a feature axis (SURVEY.md §2.6 P3).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Coefficients:
+    """means[D] (+ optional variances[D]) for one generalized linear model."""
+
+    means: Array
+    variances: Optional[Array] = None
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[-1]
+
+    @staticmethod
+    def zeros(dim: int, dtype=jnp.float32, with_variances: bool = False) -> "Coefficients":
+        v = jnp.zeros((dim,), dtype) if with_variances else None
+        return Coefficients(means=jnp.zeros((dim,), dtype), variances=v)
+
+    def norm2(self) -> Array:
+        return jnp.sqrt(jnp.sum(self.means * self.means))

@@ -1,0 +1,10 @@
+"""Numerical ops: pointwise losses, sparse feature ops, Pallas kernels."""
+from photon_tpu.ops.losses import (  # noqa: F401
+    LogisticLoss,
+    PointwiseLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+    get_loss,
+    loss_for_task,
+)

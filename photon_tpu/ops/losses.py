@@ -1,0 +1,166 @@
+"""Pointwise GLM losses as pure functions of the margin.
+
+Each loss exposes the same contract as the reference's ``PointwiseLossFunction``
+⟦photon-lib/.../function/glm/*LossFunction.scala⟧ (unverified path — see
+SURVEY.md): given the margin z = wᵀx (+ offset) and the label y it returns
+
+  * ``loss(z, y)``   — the per-example loss value,
+  * ``d1(z, y)``     — ∂loss/∂z  (the reference's ``DzLoss``),
+  * ``d2(z, y)``     — ∂²loss/∂z² (the reference's ``DzzLoss``).
+
+TPU-first design notes: these are scalar-free, shape-polymorphic jnp functions;
+they broadcast over whole batches so XLA fuses them into the surrounding
+matmul/segment-sum. All math is numerically stable in bfloat16/float32
+(log1p/softplus forms); labels follow the reference conventions —
+binary {0, 1} for logistic and smoothed-hinge, reals for linear, counts ≥ 0
+for Poisson.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class PointwiseLoss:
+    """A pointwise loss ℓ(z, y) with first and second margin-derivatives."""
+
+    name: str
+    loss: Callable[[Array, Array], Array]
+    d1: Callable[[Array, Array], Array]
+    d2: Callable[[Array, Array], Array]
+    # The inverse link (mean function) used by the corresponding GLM when
+    # turning a score into a prediction — reference ``computeMean``.
+    mean: Callable[[Array], Array]
+
+    def loss_and_d1(self, z: Array, y: Array) -> tuple[Array, Array]:
+        return self.loss(z, y), self.d1(z, y)
+
+
+# --- Logistic (binary cross-entropy on the logit) -----------------------------
+# Reference ⟦LogisticLossFunction.scala⟧: y ∈ {0,1},
+#   ℓ(z, y) = log(1 + e^z) − y·z ;  ∂ℓ/∂z = σ(z) − y ;  ∂²ℓ/∂z² = σ(z)(1 − σ(z)).
+
+def _logistic_loss(z: Array, y: Array) -> Array:
+    return jax.nn.softplus(z) - y * z
+
+
+def _logistic_d1(z: Array, y: Array) -> Array:
+    return jax.nn.sigmoid(z) - y
+
+
+def _logistic_d2(z: Array, y: Array) -> Array:
+    s = jax.nn.sigmoid(z)
+    return s * (1.0 - s)
+
+
+LogisticLoss = PointwiseLoss(
+    name="logistic",
+    loss=_logistic_loss,
+    d1=_logistic_d1,
+    d2=_logistic_d2,
+    mean=jax.nn.sigmoid,
+)
+
+
+# --- Squared loss -------------------------------------------------------------
+# Reference ⟦SquaredLossFunction.scala⟧: ℓ(z, y) = ½(z − y)².
+
+def _squared_loss(z: Array, y: Array) -> Array:
+    d = z - y
+    return 0.5 * d * d
+
+
+SquaredLoss = PointwiseLoss(
+    name="squared",
+    loss=_squared_loss,
+    d1=lambda z, y: z - y,
+    d2=lambda z, y: jnp.ones_like(z),
+    mean=lambda z: z,
+)
+
+
+# --- Poisson loss (negative log-likelihood up to a constant) ------------------
+# Reference ⟦PoissonLossFunction.scala⟧: ℓ(z, y) = e^z − y·z.
+
+def _poisson_loss(z: Array, y: Array) -> Array:
+    return jnp.exp(z) - y * z
+
+
+PoissonLoss = PointwiseLoss(
+    name="poisson",
+    loss=_poisson_loss,
+    d1=lambda z, y: jnp.exp(z) - y,
+    d2=lambda z, y: jnp.exp(z),
+    mean=jnp.exp,
+)
+
+
+# --- Smoothed hinge (Rennie & Srebro 2005) ------------------------------------
+# Reference ⟦SmoothedHingeLossFunction.scala⟧: y ∈ {0,1} mapped to s = 2y − 1,
+# t = s·z:
+#   ℓ = ½ − t          if t ≤ 0
+#   ℓ = ½(1 − t)²      if 0 < t < 1
+#   ℓ = 0              if t ≥ 1
+# Only once-differentiable; d2 is the a.e. second derivative (1 on 0<t<1),
+# matching the reference's use of it in Hessian-vector products.
+
+def _hinge_t(z: Array, y: Array) -> Array:
+    s = 2.0 * y - 1.0
+    return s * z
+
+
+def _smoothed_hinge_loss(z: Array, y: Array) -> Array:
+    t = _hinge_t(z, y)
+    return jnp.where(t <= 0.0, 0.5 - t, jnp.where(t < 1.0, 0.5 * (1.0 - t) ** 2, 0.0))
+
+
+def _smoothed_hinge_d1(z: Array, y: Array) -> Array:
+    s = 2.0 * y - 1.0
+    t = s * z
+    dt = jnp.where(t <= 0.0, -1.0, jnp.where(t < 1.0, t - 1.0, 0.0))
+    return s * dt
+
+
+def _smoothed_hinge_d2(z: Array, y: Array) -> Array:
+    t = _hinge_t(z, y)
+    return jnp.where((t > 0.0) & (t < 1.0), 1.0, 0.0)
+
+
+SmoothedHingeLoss = PointwiseLoss(
+    name="smoothed_hinge",
+    loss=_smoothed_hinge_loss,
+    d1=_smoothed_hinge_d1,
+    d2=_smoothed_hinge_d2,
+    # The SVM "mean" is the raw score (the reference scores by margin sign).
+    mean=lambda z: z,
+)
+
+
+_BY_NAME = {
+    "logistic": LogisticLoss,
+    "squared": SquaredLoss,
+    "poisson": PoissonLoss,
+    "smoothed_hinge": SmoothedHingeLoss,
+}
+
+
+def loss_for_task(task) -> PointwiseLoss:
+    """Map a TaskType to its pointwise loss (reference: GLM task dispatch)."""
+    from photon_tpu.types import TaskType
+
+    return {
+        TaskType.LOGISTIC_REGRESSION: LogisticLoss,
+        TaskType.LINEAR_REGRESSION: SquaredLoss,
+        TaskType.POISSON_REGRESSION: PoissonLoss,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: SmoothedHingeLoss,
+    }[task]
+
+
+def get_loss(name: str) -> PointwiseLoss:
+    return _BY_NAME[name]

@@ -1,0 +1,134 @@
+"""Optimizer base types: convergence reasons, config, result, state tracking.
+
+Parity: reference ⟦photon-lib/.../optimization/Optimizer.scala⟧ template
+(init → iterate → convergence check), ``ConvergenceReason``, ``OptimizerState``
+and ⟦OptimizationStatesTracker.scala⟧.
+
+TPU-first design: the whole optimize loop runs on-device inside one
+``lax.while_loop`` under jit (SURVEY.md §3.4 — the reference's driver-side
+Breeze loop with one Spark job per iteration becomes a single XLA program).
+The per-iteration tracker is a pair of fixed-size arrays written by masked
+dynamic-index updates, so state history survives jit. Everything here is
+vmap-compatible so the same optimizer batches over thousands of random-effect
+entity solves (SURVEY.md §2.6 P2).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# Convergence reason codes (int32 on device; 0 means "still running").
+NOT_CONVERGED = 0
+MAX_ITERATIONS = 1
+FUNCTION_VALUES_CONVERGED = 2
+GRADIENT_CONVERGED = 3
+
+CONVERGENCE_REASON_NAMES = {
+    NOT_CONVERGED: "NOT_CONVERGED",
+    MAX_ITERATIONS: "MAX_ITERATIONS",
+    FUNCTION_VALUES_CONVERGED: "FUNCTION_VALUES_CONVERGED",
+    GRADIENT_CONVERGED: "GRADIENT_CONVERGED",
+}
+
+# An objective for first-order optimizers: x -> (value, gradient).
+ValueAndGrad = Callable[[Array], tuple[Array, Array]]
+# Hessian-vector product for second-order optimizers: (x, v) -> H(x) @ v.
+Hvp = Callable[[Array, Array], Array]
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    """Static (compile-time) optimizer hyperparameters.
+
+    Defaults follow the reference ⟦GLMOptimizationConfiguration⟧ conventions:
+    tolerance is *relative* function-change tolerance, also applied to the
+    relative gradient norm, as in the reference's dual convergence check.
+    """
+
+    max_iterations: int = 80
+    tolerance: float = 1e-7
+    # L-BFGS/OWL-QN history length (Breeze default m=10 ⟦LBFGS.scala⟧).
+    history_length: int = 10
+    # Line-search probe cap per iteration.
+    max_line_search_iterations: int = 25
+    # TRON inner conjugate-gradient iteration cap.
+    max_cg_iterations: int = 20
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class OptimizerResult:
+    """Terminal state + per-iteration history (the states tracker).
+
+    ``values[i]`` / ``grad_norms[i]`` are valid for i < iterations; beyond that
+    they hold padding. ``converged_reason`` is a code from this module.
+    """
+
+    x: Array
+    value: Array
+    grad_norm: Array
+    iterations: Array            # int32 scalar
+    converged_reason: Array      # int32 scalar
+    values: Array                # [max_iterations + 1] tracked objective values
+    grad_norms: Array            # [max_iterations + 1] tracked gradient norms
+
+    def reason_name(self) -> str:
+        return CONVERGENCE_REASON_NAMES[int(self.converged_reason)]
+
+
+def l2_norm(v: Array) -> Array:
+    return jnp.sqrt(jnp.sum(v * v))
+
+
+def check_convergence(
+    it: Array,
+    f_prev: Array,
+    f: Array,
+    gnorm: Array,
+    gnorm0: Array,
+    config: OptimizerConfig,
+) -> Array:
+    """Reference-parity dual convergence test → reason code (0 if not done).
+
+    Gradient test is relative to the initial gradient norm (Breeze/LIBLINEAR
+    convention: ``|∇f| ≤ tol·|∇f₀|``); function test is relative change.
+    """
+    tol = jnp.asarray(config.tolerance, f.dtype)
+    grad_ok = gnorm <= tol * jnp.maximum(gnorm0, 1e-30)
+    denom = jnp.maximum(jnp.maximum(jnp.abs(f_prev), jnp.abs(f)), 1.0)
+    fun_ok = (it > 0) & (jnp.abs(f_prev - f) <= tol * denom)
+    reason = jnp.where(
+        grad_ok,
+        GRADIENT_CONVERGED,
+        jnp.where(fun_ok, FUNCTION_VALUES_CONVERGED, NOT_CONVERGED),
+    )
+    return reason.astype(jnp.int32)
+
+
+def finalize_reason(reason: Array, it: Array, max_iterations: int) -> Array:
+    """Map a still-running loop that hit the iteration cap to MAX_ITERATIONS."""
+    return jnp.where(
+        (reason == NOT_CONVERGED) & (it >= max_iterations),
+        MAX_ITERATIONS,
+        reason,
+    ).astype(jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class Optimizer:
+    """Common interface: ``optimize(value_and_grad, x0) -> OptimizerResult``.
+
+    Subclasses (LBFGS/OWLQN/TRON) implement ``optimize`` as a pure jittable
+    function of device arrays; they carry only static config so instances can
+    be closed over inside jit.
+    """
+
+    config: OptimizerConfig = OptimizerConfig()
+
+    def optimize(self, value_and_grad: ValueAndGrad, x0: Array, **kw) -> OptimizerResult:
+        raise NotImplementedError

@@ -1,0 +1,33 @@
+"""Test fixture: run all tests on a virtual 8-device CPU mesh.
+
+The idiomatic equivalent of the reference's `local[*]` Spark test fixture
+⟦SparkTestUtils.sparkTest⟧ (SURVEY.md §4): `--xla_force_host_platform_device_count=8`
+gives 8 XLA CPU devices so the real `psum`/`shard_map`/`pjit` code paths execute
+in-process without TPU hardware. Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Some environments ship a sitecustomize that registers an external TPU PJRT
+# plugin and force-overrides jax_platforms after env vars are read; pin the
+# config back to cpu so tests never try to claim real TPU hardware.
+jax.config.update("jax_platforms", "cpu")
+
+# The reference's math is double-precision (Breeze/JVM); enable x64 so golden
+# and finite-difference tests can compare at full precision. Production entry
+# points still default to float32/bfloat16 arrays.
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

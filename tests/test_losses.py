@@ -1,0 +1,78 @@
+"""Pointwise-loss unit tests: values and finite-difference derivative checks.
+
+Mirrors the reference's loss unit tier ⟦LogisticLossFunctionTest etc.⟧
+(SURVEY.md §4): hand-computed values plus finite-difference gradient checks.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.ops.losses import (
+    LogisticLoss,
+    PoissonLoss,
+    SmoothedHingeLoss,
+    SquaredLoss,
+)
+
+ALL_LOSSES = [LogisticLoss, SquaredLoss, PoissonLoss, SmoothedHingeLoss]
+BINARY = {"logistic", "smoothed_hinge"}
+
+
+def _labels_for(loss, rng, n):
+    if loss.name in BINARY:
+        return rng.integers(0, 2, size=n).astype(np.float32)
+    if loss.name == "poisson":
+        return rng.poisson(2.0, size=n).astype(np.float32)
+    return rng.normal(size=n).astype(np.float32)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_finite_difference_d1(loss, rng):
+    z = jnp.asarray(rng.normal(size=32) * 2.0, jnp.float64)
+    y = jnp.asarray(_labels_for(loss, rng, 32), jnp.float64)
+    eps = 1e-5
+    num = (loss.loss(z + eps, y) - loss.loss(z - eps, y)) / (2 * eps)
+    np.testing.assert_allclose(loss.d1(z, y), num, rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_finite_difference_d2(loss, rng):
+    # Keep away from smoothed-hinge kinks at t ∈ {0, 1}.
+    z = jnp.asarray(rng.uniform(0.1, 0.8, size=32), jnp.float64)
+    y = jnp.asarray(_labels_for(loss, rng, 32), jnp.float64)
+    eps = 1e-5
+    num = (loss.d1(z + eps, y) - loss.d1(z - eps, y)) / (2 * eps)
+    np.testing.assert_allclose(loss.d2(z, y), num, rtol=1e-4, atol=1e-7)
+
+
+def test_logistic_values():
+    z = jnp.asarray([0.0, 100.0, -100.0])
+    y = jnp.asarray([1.0, 1.0, 0.0])
+    got = LogisticLoss.loss(z, y)
+    np.testing.assert_allclose(got, [np.log(2.0), 0.0, 0.0], atol=1e-6)
+    assert bool(jnp.all(jnp.isfinite(LogisticLoss.loss(jnp.asarray([1e4, -1e4]), jnp.asarray([0.0, 1.0])))))
+
+
+def test_squared_values():
+    np.testing.assert_allclose(SquaredLoss.loss(jnp.asarray(3.0), jnp.asarray(1.0)), 2.0)
+
+
+def test_poisson_values():
+    np.testing.assert_allclose(PoissonLoss.loss(jnp.asarray(0.0), jnp.asarray(2.0)), 1.0)
+
+
+def test_smoothed_hinge_regions():
+    y = jnp.ones((4,))
+    z = jnp.asarray([-1.0, 0.5, 1.5, 1.0])
+    np.testing.assert_allclose(
+        SmoothedHingeLoss.loss(z, y), [1.5, 0.125, 0.0, 0.0], atol=1e-6
+    )
+
+
+@pytest.mark.parametrize("loss", ALL_LOSSES, ids=lambda l: l.name)
+def test_grad_matches_autodiff(loss, rng):
+    z = jnp.asarray(rng.normal(size=16).astype(np.float32))
+    y = jnp.asarray(_labels_for(loss, rng, 16))
+    auto = jax.vmap(jax.grad(lambda zz, yy: loss.loss(zz, yy)))(z, y)
+    np.testing.assert_allclose(loss.d1(z, y), auto, rtol=1e-5, atol=1e-6)

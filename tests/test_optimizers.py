@@ -1,0 +1,201 @@
+"""Optimizer golden tests: closed-form quadratics, scipy/sklearn parity,
+cross-optimizer agreement (TRON vs L-BFGS), as in the reference's
+numerical-parity tier (SURVEY.md §4).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.optimize
+
+from photon_tpu.optim import (
+    GRADIENT_CONVERGED,
+    LBFGS,
+    OWLQN,
+    TRON,
+    OptimizerConfig,
+)
+from photon_tpu.ops.losses import LogisticLoss
+
+
+def quadratic_problem(rng, d=8, cond=10.0):
+    q, _ = np.linalg.qr(rng.normal(size=(d, d)))
+    eigs = np.linspace(1.0, cond, d)
+    a = (q * eigs) @ q.T
+    b = rng.normal(size=d)
+    a = jnp.asarray(a, jnp.float32)
+    b = jnp.asarray(b, jnp.float32)
+
+    def vg(x):
+        g = a @ x - b
+        return 0.5 * x @ a @ x - b @ x, g
+
+    x_star = jnp.linalg.solve(a, b)
+    return vg, x_star
+
+
+def logistic_data(rng, n=200, d=10):
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    w_true = rng.normal(size=d).astype(np.float32)
+    p = 1.0 / (1.0 + np.exp(-(x @ w_true)))
+    y = (rng.uniform(size=n) < p).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def logistic_objective(x, y, l2=0.1):
+    def value(w):
+        z = x @ w
+        return jnp.sum(LogisticLoss.loss(z, y)) + 0.5 * l2 * jnp.sum(w * w)
+
+    return jax.value_and_grad(value), value
+
+
+class TestLBFGS:
+    def test_quadratic_exact(self, rng):
+        vg, x_star = quadratic_problem(rng)
+        res = jax.jit(lambda x0: LBFGS(OptimizerConfig()).optimize(vg, x0))(
+            jnp.zeros(8, jnp.float32)
+        )
+        np.testing.assert_allclose(res.x, x_star, atol=1e-4)
+        assert int(res.converged_reason) in (2, 3)
+
+    def test_logistic_vs_scipy(self, rng):
+        x, y = logistic_data(rng)
+        vg, value = logistic_objective(x, y)
+        res = jax.jit(
+            lambda w0: LBFGS(OptimizerConfig(max_iterations=200)).optimize(vg, w0)
+        )(jnp.zeros(10, jnp.float32))
+        ref = scipy.optimize.minimize(
+            lambda w: float(value(jnp.asarray(w, jnp.float32))),
+            np.zeros(10),
+            jac=lambda w: np.asarray(vg(jnp.asarray(w, jnp.float32))[1], np.float64),
+            method="L-BFGS-B",
+        )
+        np.testing.assert_allclose(float(res.value), ref.fun, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(res.x), ref.x, atol=2e-3)
+
+    def test_tracker_is_monotone(self, rng):
+        x, y = logistic_data(rng)
+        vg, _ = logistic_objective(x, y)
+        res = LBFGS(OptimizerConfig()).optimize(vg, jnp.zeros(10, jnp.float32))
+        vals = np.asarray(res.values)[: int(res.iterations) + 1]
+        assert np.all(np.diff(vals) <= 1e-5)
+
+    def test_vmap_batched_solves(self, rng):
+        # The random-effect path: one optimizer, many independent problems.
+        n_ent, n, d = 5, 40, 4
+        xs = jnp.asarray(rng.normal(size=(n_ent, n, d)).astype(np.float32))
+        ws = jnp.asarray(rng.normal(size=(n_ent, d)).astype(np.float32))
+        ys = (jax.nn.sigmoid(jnp.einsum("end,ed->en", xs, ws)) > 0.5).astype(jnp.float32)
+
+        def solve(x, y):
+            def value(w):
+                return jnp.sum(LogisticLoss.loss(x @ w, y)) + 0.05 * jnp.sum(w * w)
+
+            return LBFGS(OptimizerConfig(max_iterations=50)).optimize(
+                jax.value_and_grad(value), jnp.zeros(d, jnp.float32)
+            )
+
+        res = jax.jit(jax.vmap(solve))(xs, ys)
+        assert res.x.shape == (n_ent, d)
+        # Each batched solve must match its standalone solve.
+        single = solve(xs[1], ys[1])
+        np.testing.assert_allclose(res.x[1], single.x, atol=1e-4)
+
+
+class TestOWLQN:
+    def test_l1_matches_sklearn(self, rng):
+        from sklearn.linear_model import LogisticRegression
+
+        x, y = logistic_data(rng, n=300, d=8)
+        l1 = 2.0
+        vg = jax.value_and_grad(
+            lambda w: jnp.sum(LogisticLoss.loss(x @ w, y))
+        )
+        res = jax.jit(
+            lambda w0: OWLQN(OptimizerConfig(max_iterations=300)).optimize(
+                vg, w0, jnp.full((8,), l1)
+            )
+        )(jnp.zeros(8, jnp.float32))
+        ref = LogisticRegression(
+            penalty="l1", C=1.0 / l1, solver="liblinear", fit_intercept=False,
+            tol=1e-8, max_iter=2000,
+        ).fit(np.asarray(x), np.asarray(y))
+
+        def total(w):
+            z = np.asarray(x) @ w
+            return float(
+                np.sum(np.maximum(z, 0) - np.asarray(y) * z + np.log1p(np.exp(-np.abs(z))))
+                + l1 * np.abs(w).sum()
+            )
+
+        # Objective parity within 0.5% (different solvers, same optimum).
+        assert float(res.value) <= total(ref.coef_[0]) * 1.005
+        # Sparsity: OWL-QN must produce exact zeros where sklearn does.
+        got_zero = np.asarray(res.x) == 0.0
+        assert got_zero.sum() >= (np.abs(ref.coef_[0]) < 1e-6).sum() - 1
+
+    def test_reduces_to_lbfgs_when_no_l1(self, rng):
+        x, y = logistic_data(rng)
+        vg, _ = logistic_objective(x, y)
+        a = OWLQN(OptimizerConfig(max_iterations=150)).optimize(
+            vg, jnp.zeros(10, jnp.float32), jnp.zeros((10,))
+        )
+        b = LBFGS(OptimizerConfig(max_iterations=150)).optimize(
+            vg, jnp.zeros(10, jnp.float32)
+        )
+        np.testing.assert_allclose(float(a.value), float(b.value), rtol=1e-4)
+
+
+class TestTRON:
+    def _hvp(self, vg):
+        grad_fn = lambda w: vg(w)[1]
+        return lambda w, v: jax.jvp(grad_fn, (w,), (v,))[1]
+
+    def test_quadratic_exact(self, rng):
+        vg, x_star = quadratic_problem(rng)
+        res = jax.jit(
+            lambda x0: TRON(OptimizerConfig()).optimize(vg, x0, self._hvp(vg))
+        )(jnp.zeros(8, jnp.float32))
+        np.testing.assert_allclose(res.x, x_star, atol=1e-3)
+
+    def test_agrees_with_lbfgs_on_logistic(self, rng):
+        x, y = logistic_data(rng)
+        vg, _ = logistic_objective(x, y)
+        a = jax.jit(
+            lambda w0: TRON(OptimizerConfig(max_iterations=100)).optimize(
+                vg, w0, self._hvp(vg)
+            )
+        )(jnp.zeros(10, jnp.float32))
+        b = LBFGS(OptimizerConfig(max_iterations=200)).optimize(
+            vg, jnp.zeros(10, jnp.float32)
+        )
+        np.testing.assert_allclose(float(a.value), float(b.value), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(a.x), np.asarray(b.x), atol=5e-3)
+
+    def test_rejected_step_is_not_convergence(self):
+        # f(w) = w⁴ − w from w0=0: singular Hessian at 0 makes the first CG
+        # step walk to the boundary and get rejected. A rejected step must
+        # shrink the radius and retry — not read as FUNCTION_VALUES_CONVERGED.
+        vg = jax.value_and_grad(lambda w: jnp.sum(w**4 - w))
+        res = TRON(OptimizerConfig(max_iterations=100)).optimize(
+            vg, jnp.zeros(1, jnp.float32), self._hvp(vg)
+        )
+        np.testing.assert_allclose(float(res.x[0]), (1 / 4) ** (1 / 3), atol=1e-3)
+
+    def test_poisson_with_tron(self, rng):
+        from photon_tpu.ops.losses import PoissonLoss
+
+        n, d = 150, 6
+        x = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32)) * 0.3
+        w_true = jnp.asarray(rng.normal(size=d).astype(np.float32)) * 0.5
+        y = jnp.asarray(rng.poisson(np.exp(np.asarray(x @ w_true))).astype(np.float32))
+        vg = jax.value_and_grad(
+            lambda w: jnp.sum(PoissonLoss.loss(x @ w, y)) + 0.5 * jnp.sum(w * w)
+        )
+        res = TRON(OptimizerConfig(max_iterations=100)).optimize(
+            vg, jnp.zeros(d, jnp.float32), self._hvp(vg)
+        )
+        assert int(res.converged_reason) in (2, 3)
+        # Gradient at the optimum is ~zero.
+        assert float(res.grad_norm) < 1e-2 * max(1.0, float(res.value))
