@@ -1,0 +1,9 @@
+"""Data layer: batches, datasets, normalization, validation, sampling."""
+from photon_tpu.data.batch import (  # noqa: F401
+    DenseFeatures,
+    Features,
+    LabeledBatch,
+    SparseFeatures,
+    ell_from_rows,
+    make_dense_batch,
+)

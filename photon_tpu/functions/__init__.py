@@ -1,0 +1,6 @@
+"""Objective functions and optimization problems."""
+from photon_tpu.functions.objective import GLMObjective, intercept_reg_mask  # noqa: F401
+from photon_tpu.functions.problem import (  # noqa: F401
+    GLMOptimizationProblem,
+    VarianceComputationType,
+)

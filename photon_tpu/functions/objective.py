@@ -1,0 +1,109 @@
+"""GLM objective functions over batches: value, gradient, HVP, Hessian diag.
+
+Parity: reference ⟦photon-api/.../function/DistributedGLMLossFunction.scala⟧ +
+⟦photon-lib/.../function/SingleNodeGLMLossFunction.scala⟧ and the aggregators
+⟦ValueAndGradientAggregator, HessianVectorAggregator, HessianDiagonalAggregator⟧
+(SURVEY.md §2.1/§2.2).
+
+TPU-first: there is ONE objective implementation. The reference needed separate
+distributed (treeAggregate) and single-node (Breeze loop) objective stacks; here
+the same pure function serves both — run it on one chip, under ``vmap`` for
+per-entity solves, or on a sharded batch where XLA turns the row-sum into an
+AllReduce over ICI (see parallel/). Gradients come from autodiff (the
+aggregators' hand-rolled sums fall out of the vjp of matvec), and Hessian-vector
+products from forward-over-reverse ``jax.jvp``.
+
+Conventions (reference parity, SURVEY.md §7 hard-part #6):
+  * total loss = Σᵢ wᵢ ℓ(zᵢ, yᵢ) with zᵢ = xᵢᵀβ + offsetᵢ  (no 1/N scaling),
+  * L2 term = λ/2 ‖β_masked‖² where the mask excludes the intercept,
+  * L1 is never part of the smooth objective (OWL-QN handles it).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import LabeledBatch
+from photon_tpu.ops.losses import PointwiseLoss
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMObjective:
+    """Smooth GLM objective bound to a loss; batch is passed per call.
+
+    ``reg_mask`` (None = all ones) excludes coefficients (e.g. the intercept)
+    from the L2 term. All methods are pure and jit/vmap/shard_map-safe.
+    """
+
+    loss: PointwiseLoss
+    l2_weight: float = 0.0
+    reg_mask: Optional[Array] = None
+
+    # -- core --------------------------------------------------------------
+
+    def _l2_vec(self, like: Array) -> Array:
+        """Per-coefficient L2 penalty λᵢ = λ·maskᵢ. The mask is a per-feature
+        penalty weight (binary in the reference: 0 on the intercept)."""
+        if self.reg_mask is None:
+            return jnp.full_like(like, self.l2_weight)
+        return self.l2_weight * self.reg_mask.astype(like.dtype)
+
+    def value(self, w: Array, batch: LabeledBatch) -> Array:
+        z = batch.features.matvec(w) + batch.offsets
+        data_term = jnp.sum(batch.weights * self.loss.loss(z, batch.labels))
+        return data_term + 0.5 * jnp.sum(self._l2_vec(w) * w * w)
+
+    def value_and_grad(self, w: Array, batch: LabeledBatch) -> tuple[Array, Array]:
+        """Hand-fused single pass: z → (ℓ, dℓ/dz) → Xᵀ(w·dz) + L2 terms.
+
+        Equivalent to ``jax.value_and_grad(self.value)`` but computes the loss
+        and its margin-derivative together (the reference's
+        ``ValueAndGradientAggregator`` seqOp) so one data pass serves both.
+        """
+        z = batch.features.matvec(w) + batch.offsets
+        lv = jnp.sum(batch.weights * self.loss.loss(z, batch.labels))
+        dz = batch.weights * self.loss.d1(z, batch.labels)
+        g = batch.features.rmatvec(dz)
+        lam = self._l2_vec(w)
+        lv = lv + 0.5 * jnp.sum(lam * w * w)
+        g = g + lam * w
+        return lv, g
+
+    def hessian_vector(self, w: Array, v: Array, batch: LabeledBatch) -> Array:
+        """H·v in one pass: Xᵀ(diag(w·d2)·Xv) + λ·v_masked.
+
+        Reference ⟦HessianVectorAggregator⟧; on TPU this is two fused
+        matvecs — no separate aggregation job.
+        """
+        z = batch.features.matvec(w) + batch.offsets
+        d2 = batch.weights * self.loss.d2(z, batch.labels)
+        hv = batch.features.rmatvec(d2 * batch.features.matvec(v))
+        return hv + self._l2_vec(v) * v
+
+    def hessian_diagonal(self, w: Array, batch: LabeledBatch) -> Array:
+        """diag(H) = Σᵢ wᵢ d2ᵢ xᵢⱼ² + λ·mask — reference ⟦HessianDiagonalAggregator⟧."""
+        z = batch.features.matvec(w) + batch.offsets
+        d2 = batch.weights * self.loss.d2(z, batch.labels)
+        diag = batch.features.sq_rmatvec(d2)
+        return diag + self._l2_vec(w)
+
+    # -- closure builders for the optimizers --------------------------------
+
+    def bind(self, batch: LabeledBatch) -> Callable[[Array], tuple[Array, Array]]:
+        """Close over a batch → ``w ↦ (value, grad)`` for Optimizer.optimize."""
+        return lambda w: self.value_and_grad(w, batch)
+
+    def bind_hvp(self, batch: LabeledBatch) -> Callable[[Array, Array], Array]:
+        return lambda w, v: self.hessian_vector(w, v, batch)
+
+
+def intercept_reg_mask(dim: int, intercept_index: Optional[int]) -> Optional[Array]:
+    """1s everywhere except the intercept column (reference convention)."""
+    if intercept_index is None:
+        return None
+    return jnp.ones((dim,), jnp.float32).at[intercept_index].set(0.0)

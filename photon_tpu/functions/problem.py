@@ -1,0 +1,109 @@
+"""Optimization problems: optimizer + objective + regularization + variances.
+
+Parity: reference ⟦photon-api/.../optimization/GeneralizedLinearOptimizationProblem,
+DistributedOptimizationProblem, SingleNodeOptimizationProblem⟧ and
+``VarianceComputationType`` (SURVEY.md §2.2).
+
+TPU-first: the distributed/single-node split disappears — one
+``GLMOptimizationProblem.run`` is the whole solve as a pure jittable function.
+Distribution is a property of how the *batch* is sharded (parallel/), not of
+the problem class; the per-entity variant is this same function under ``vmap``
+(random effects).
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import LabeledBatch
+from photon_tpu.functions.objective import GLMObjective
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.ops.losses import loss_for_task
+from photon_tpu.optim import (
+    LBFGS,
+    OWLQN,
+    TRON,
+    OptimizerConfig,
+    OptimizerResult,
+    OptimizerType,
+    RegularizationContext,
+)
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+class VarianceComputationType(enum.Enum):
+    """Reference ⟦VarianceComputationType⟧: NONE / SIMPLE (1/diag H) /
+    FULL (diag H⁻¹)."""
+
+    NONE = "NONE"
+    SIMPLE = "SIMPLE"
+    FULL = "FULL"
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMOptimizationProblem:
+    """Binds task, optimizer choice, regularization, and variance mode.
+
+    ``run(batch, w0)`` returns the trained model + optimizer state history.
+    Static configuration only — instances close cleanly into jit.
+    """
+
+    task: TaskType
+    optimizer_type: OptimizerType = OptimizerType.LBFGS
+    optimizer_config: OptimizerConfig = OptimizerConfig()
+    regularization: RegularizationContext = RegularizationContext()
+    reg_weight: float = 0.0
+    variance_type: VarianceComputationType = VarianceComputationType.NONE
+    reg_mask: Optional[Array] = None
+
+    def objective(self) -> GLMObjective:
+        return GLMObjective(
+            loss=loss_for_task(self.task),
+            l2_weight=self.regularization.l2_weight(self.reg_weight),
+            reg_mask=self.reg_mask,
+        )
+
+    def run(
+        self, batch: LabeledBatch, w0: Array
+    ) -> tuple[GeneralizedLinearModel, OptimizerResult]:
+        obj = self.objective()
+        vg = obj.bind(batch)
+
+        if self.optimizer_type == OptimizerType.LBFGS:
+            result = LBFGS(self.optimizer_config).optimize(vg, w0)
+        elif self.optimizer_type == OptimizerType.OWLQN:
+            l1 = self.regularization.l1_weight(self.reg_weight)
+            mask = self.reg_mask if self.reg_mask is not None else jnp.ones_like(w0)
+            result = OWLQN(self.optimizer_config).optimize(vg, w0, l1 * mask)
+        elif self.optimizer_type == OptimizerType.TRON:
+            result = TRON(self.optimizer_config).optimize(vg, w0, obj.bind_hvp(batch))
+        else:  # pragma: no cover - enum is closed
+            raise ValueError(f"unknown optimizer {self.optimizer_type}")
+
+        variances = self._variances(obj, result.x, batch)
+        model = GeneralizedLinearModel(
+            Coefficients(means=result.x, variances=variances), self.task
+        )
+        return model, result
+
+    def _variances(
+        self, obj: GLMObjective, w: Array, batch: LabeledBatch
+    ) -> Optional[Array]:
+        if self.variance_type == VarianceComputationType.NONE:
+            return None
+        if self.variance_type == VarianceComputationType.SIMPLE:
+            return 1.0 / jnp.maximum(obj.hessian_diagonal(w, batch), 1e-12)
+        # FULL: materialize H column-by-column via HVPs and invert. Only
+        # sensible for moderate D (same caveat as the reference's full
+        # Hessian inverse).
+        eye = jnp.eye(w.shape[0], dtype=w.dtype)
+        h = jax.vmap(lambda v: obj.hessian_vector(w, v, batch))(eye)
+        h = 0.5 * (h + h.T)
+        return jnp.diag(jnp.linalg.inv(h + 1e-12 * eye))

@@ -1,0 +1,51 @@
+"""Generalized linear models: coefficients + task-specific link.
+
+Parity: reference ⟦photon-lib/.../model/GeneralizedLinearModel.scala⟧ and the
+per-task subclasses ⟦LogisticRegressionModel, LinearRegressionModel,
+PoissonRegressionModel, SmoothedHingeLossLinearSVMModel⟧. Here one frozen
+pytree dataclass with a static ``task`` field replaces the subclass hierarchy —
+the task dispatches the mean function, and the whole model flows through
+jit/vmap (a [E, D] stack of means IS a batch of E models, which is how
+random-effect model collections are stored).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import Features
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.ops.losses import loss_for_task
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class GeneralizedLinearModel:
+    """means (+variances) with a task type; scoring is pure."""
+
+    coefficients: Coefficients
+    task: TaskType = dataclasses.field(metadata=dict(static=True))
+
+    @property
+    def dim(self) -> int:
+        return self.coefficients.dim
+
+    def compute_score(self, features: Features, offsets: Array | None = None) -> Array:
+        """Raw linear score xᵀβ (+ offset) — reference ``computeScore``."""
+        z = features.matvec(self.coefficients.means)
+        if offsets is not None:
+            z = z + offsets
+        return z
+
+    def compute_mean(self, features: Features, offsets: Array | None = None) -> Array:
+        """Score through the inverse link — reference ``computeMeanFunction``."""
+        return loss_for_task(self.task).mean(self.compute_score(features, offsets))
+
+    @staticmethod
+    def zeros(dim: int, task: TaskType, dtype=jnp.float32) -> "GeneralizedLinearModel":
+        return GeneralizedLinearModel(Coefficients.zeros(dim, dtype), task)

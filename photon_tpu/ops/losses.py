@@ -10,8 +10,10 @@ SURVEY.md): given the margin z = wᵀx (+ offset) and the label y it returns
 
 TPU-first design notes: these are scalar-free, shape-polymorphic jnp functions;
 they broadcast over whole batches so XLA fuses them into the surrounding
-matmul/segment-sum. All math is numerically stable in bfloat16/float32
-(log1p/softplus forms); labels follow the reference conventions —
+matmul/segment-sum. The logistic and smoothed-hinge losses use overflow-safe
+softplus/piecewise forms; the Poisson loss is exp(z) by definition and
+overflows for z ≳ 88 in float32 (≳ 709 in float64) — same bound as the
+reference's Breeze implementation. Labels follow the reference conventions:
 binary {0, 1} for logistic and smoothed-hinge, reals for linear, counts ≥ 0
 for Poisson.
 """

@@ -1,0 +1,14 @@
+"""Parallelism: meshes, data-parallel fitting, collectives."""
+from photon_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    ENTITY_AXIS,
+    FEATURE_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated,
+    shard_batch_pytree,
+)
+from photon_tpu.parallel.data_parallel import (  # noqa: F401
+    fit_data_parallel,
+    spmd_value_and_grad,
+)

@@ -1,0 +1,84 @@
+"""Device-mesh construction and sharding helpers.
+
+This is the rebuild's replacement for the reference's Spark runtime substrate
+(SURVEY.md §1 layer R / §5.8): instead of executors + treeAggregate +
+TorrentBroadcast, a `jax.sharding.Mesh` with named axes and XLA collectives
+over ICI/DCN.
+
+Axis conventions (SURVEY.md §2.6):
+  * ``data``    — batch rows (P1 data parallelism; gradient psum),
+  * ``entity``  — random-effect entities (P2/P6 expert-style sharding),
+  * ``feature`` — coefficient dimension (P3 sharded optimizer state).
+
+A mesh may use any subset; a multi-slice deployment adds an outer DCN axis by
+listing it first (slowest-varying) so collectives ride ICI within a slice.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+ENTITY_AXIS = "entity"
+FEATURE_AXIS = "feature"
+
+
+def make_mesh(
+    axis_sizes: dict[str, int] | None = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh from {axis: size}. Default: all devices on ``data``."""
+    devices = list(devices if devices is not None else jax.devices())
+    if not axis_sizes:
+        axis_sizes = {DATA_AXIS: len(devices)}
+    names = tuple(axis_sizes)
+    sizes = tuple(axis_sizes.values())
+    n = int(np.prod(sizes))
+    if n != len(devices):
+        raise ValueError(
+            f"mesh wants {n} devices ({axis_sizes}) but {len(devices)} available"
+        )
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, names)
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (row) dimension over ``axis``; replicate the rest."""
+    return NamedSharding(mesh, P(axis))
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch_pytree(batch, mesh: Mesh, axis: str = DATA_AXIS):
+    """Device-put every array leaf of a batch pytree row-sharded over ``axis``.
+
+    All leaves of a LabeledBatch share the same leading row count, so one
+    spec applies uniformly (ELL idx/val are [N, K]; labels/offsets/weights
+    are [N]).
+    """
+
+    def put(leaf):
+        spec = P(axis, *([None] * (leaf.ndim - 1)))
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, batch)
+
+
+def pad_rows_to_multiple(arrs_n_leading, multiple: int):
+    """Host-side: pad row count to a multiple (for even sharding), returning
+    the padded pytree. Padded rows must be masked by weight=0 by the caller."""
+    import numpy as _np
+
+    def pad(a):
+        n = a.shape[0]
+        r = (-n) % multiple
+        if r == 0:
+            return a
+        pad_width = [(0, r)] + [(0, 0)] * (a.ndim - 1)
+        return _np.pad(_np.asarray(a), pad_width)
+
+    return jax.tree.map(pad, arrs_n_leading)

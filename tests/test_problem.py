@@ -1,0 +1,132 @@
+"""End-to-end GLMOptimizationProblem tests: each task type trains to the
+sklearn/scipy optimum; variance computation matches the inverse Hessian.
+The single-chip degenerate case of the reference's ⟦FixedEffectCoordinate⟧
+training path (SURVEY.md §7 stage 3).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.batch import make_dense_batch
+from photon_tpu.functions.objective import intercept_reg_mask
+from photon_tpu.functions.problem import (
+    GLMOptimizationProblem,
+    VarianceComputationType,
+)
+from photon_tpu.optim import (
+    L2RegularizationContext,
+    L1RegularizationContext,
+    OptimizerConfig,
+    OptimizerType,
+)
+from photon_tpu.types import TaskType
+
+
+def _with_intercept(x):
+    return np.concatenate([np.ones((x.shape[0], 1)), x], axis=1)
+
+
+def test_logistic_matches_sklearn(rng):
+    from sklearn.linear_model import LogisticRegression
+
+    n, d = 400, 6
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (1 / (1 + np.exp(-(x @ w + 0.3))) > rng.uniform(size=n)).astype(float)
+    lam = 1.0
+    xd = _with_intercept(x)
+    batch = make_dense_batch(xd, y, dtype=jnp.float64)
+    prob = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_type=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=300, tolerance=1e-10),
+        regularization=L2RegularizationContext,
+        reg_weight=lam,
+        reg_mask=intercept_reg_mask(d + 1, 0),
+    )
+    model, res = prob.run(batch, jnp.zeros(d + 1, jnp.float64))
+    ref = LogisticRegression(C=1.0 / lam, tol=1e-10, max_iter=5000).fit(x, y)
+    np.testing.assert_allclose(model.coefficients.means[0],
+                               ref.intercept_[0], atol=2e-3)
+    np.testing.assert_allclose(model.coefficients.means[1:],
+                               ref.coef_[0], atol=2e-3)
+
+
+def test_linear_matches_ridge_closed_form(rng):
+    n, d = 200, 5
+    x = rng.normal(size=(n, d))
+    y = x @ rng.normal(size=d) + 0.1 * rng.normal(size=n)
+    lam = 2.0
+    batch = make_dense_batch(x, y, dtype=jnp.float64)
+    prob = GLMOptimizationProblem(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer_type=OptimizerType.TRON,
+        optimizer_config=OptimizerConfig(max_iterations=100, tolerance=1e-12),
+        regularization=L2RegularizationContext,
+        reg_weight=lam,
+        variance_type=VarianceComputationType.FULL,
+    )
+    model, _ = prob.run(batch, jnp.zeros(d, jnp.float64))
+    # Closed form: (XᵀX + λI)⁻¹ Xᵀy.
+    w_star = np.linalg.solve(x.T @ x + lam * np.eye(d), x.T @ y)
+    np.testing.assert_allclose(model.coefficients.means, w_star, atol=1e-6)
+    # FULL variances = diag((XᵀX + λI)⁻¹) for squared loss.
+    v_star = np.diag(np.linalg.inv(x.T @ x + lam * np.eye(d)))
+    np.testing.assert_allclose(model.coefficients.variances, v_star, rtol=1e-4)
+
+
+def test_poisson_owlqn_sparsifies(rng):
+    n, d = 300, 10
+    x = rng.normal(size=(n, d)) * 0.4
+    w_true = np.zeros(d)
+    w_true[:3] = [0.8, -0.5, 0.6]
+    y = rng.poisson(np.exp(x @ w_true)).astype(float)
+    batch = make_dense_batch(x, y, dtype=jnp.float64)
+    prob = GLMOptimizationProblem(
+        task=TaskType.POISSON_REGRESSION,
+        optimizer_type=OptimizerType.OWLQN,
+        optimizer_config=OptimizerConfig(max_iterations=200),
+        regularization=L1RegularizationContext,
+        reg_weight=15.0,
+    )
+    model, _ = prob.run(batch, jnp.zeros(d, jnp.float64))
+    means = np.asarray(model.coefficients.means)
+    assert (means == 0.0).sum() >= 4, means
+    assert np.abs(means[:3]).min() > 0.0
+
+
+def test_simple_variances(rng):
+    n, d = 150, 4
+    x = rng.normal(size=(n, d))
+    y = rng.integers(0, 2, n).astype(float)
+    batch = make_dense_batch(x, y, dtype=jnp.float64)
+    prob = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        regularization=L2RegularizationContext,
+        reg_weight=0.5,
+        variance_type=VarianceComputationType.SIMPLE,
+    )
+    model, _ = prob.run(batch, jnp.zeros(d, jnp.float64))
+    w = model.coefficients.means
+    z = x @ np.asarray(w)
+    s = 1 / (1 + np.exp(-z))
+    diag_h = (s * (1 - s))[:, None] * x**2
+    expect = 1.0 / (diag_h.sum(0) + 0.5)
+    np.testing.assert_allclose(model.coefficients.variances, expect, rtol=1e-5)
+
+
+def test_smoothed_hinge_trains(rng):
+    n, d = 200, 5
+    x = rng.normal(size=(n, d))
+    w = rng.normal(size=d)
+    y = (x @ w > 0).astype(float)
+    batch = make_dense_batch(x, y, dtype=jnp.float64)
+    prob = GLMOptimizationProblem(
+        task=TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+        regularization=L2RegularizationContext,
+        reg_weight=0.1,
+        optimizer_config=OptimizerConfig(max_iterations=200),
+    )
+    model, res = prob.run(batch, jnp.zeros(d, jnp.float64))
+    acc = float(((x @ np.asarray(model.coefficients.means) > 0) == y).mean())
+    assert acc > 0.95
