@@ -1,0 +1,108 @@
+"""Benchmark: fixed-effect logistic L-BFGS throughput on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (BASELINE.json config #1 scaled up): sparse CTR-style logistic
+regression — N rows x K nnz/row over a D-dim feature space, full on-device
+L-BFGS solve (SURVEY.md §3.4's hot loop, where the reference pays one Spark
+job per iteration).
+
+``value`` is samples/sec through the optimizer: N x (number of value+grad
+data passes) / wall-time. ``vs_baseline`` is measured against a same-machine
+single-process NumPy implementation of the identical objective pass — a local
+stand-in for the reference's per-executor-core Breeze seqOp cost, since the
+reference publishes no numbers (BASELINE.json "published": {}).
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _make_data(n_rows: int, dim: int, k: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, dim, size=(n_rows, k)).astype(np.int32)
+    val = rng.normal(size=(n_rows, k)).astype(np.float32) / np.sqrt(k)
+    w_true = rng.normal(size=dim).astype(np.float32)
+    z = (val * w_true[idx]).sum(axis=1)
+    labels = (rng.random(n_rows) < 1.0 / (1.0 + np.exp(-z))).astype(np.float32)
+    return idx, val, labels
+
+
+def numpy_pass_time(idx, val, labels, n_iter: int = 3) -> float:
+    """Seconds per value+grad pass of the same objective in plain NumPy."""
+    n, k = idx.shape
+    dim = int(idx.max()) + 1
+    w = np.zeros(dim, dtype=np.float32)
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        z = (val * w[idx]).sum(axis=1)
+        p = 1.0 / (1.0 + np.exp(-z))
+        _ = np.logaddexp(0.0, z) - labels * z  # loss vector
+        dz = p - labels
+        g = np.zeros(dim, dtype=np.float32)
+        np.add.at(g, idx.ravel(), (dz[:, None] * val).ravel())
+        w = w - 1e-3 * g  # keep iterations non-degenerate
+    return (time.perf_counter() - t0) / n_iter
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+    from photon_tpu.functions.problem import GLMOptimizationProblem
+    from photon_tpu.optim import OptimizerConfig, OptimizerType
+    from photon_tpu.types import TaskType
+
+    n_rows, dim, k = 1 << 19, 1 << 18, 32
+    idx, val, labels = _make_data(n_rows, dim, k)
+
+    batch = LabeledBatch(
+        features=SparseFeatures(idx=jnp.asarray(idx), val=jnp.asarray(val), dim=dim),
+        labels=jnp.asarray(labels),
+        offsets=jnp.zeros((n_rows,), jnp.float32),
+        weights=jnp.ones((n_rows,), jnp.float32),
+    )
+    max_iter = 40
+    problem = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_type=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=max_iter, tolerance=0.0),
+        reg_weight=1.0,
+    )
+    w0 = jnp.zeros((dim,), jnp.float32)
+    run = jax.jit(problem.run)
+    model, result = run(batch, w0)  # compile + warm up
+    np.asarray(result.value)
+
+    # Timing forces a host readback: on the tunneled TPU platform in this
+    # image, block_until_ready returns before remote execution completes.
+    t0 = time.perf_counter()
+    model, result = run(batch, w0)
+    np.asarray(model.coefficients.means)
+    np.asarray(result.value)
+    dt = time.perf_counter() - t0
+
+    # Each L-BFGS iteration is >=1 fused value+grad pass (line-search probes
+    # add more, uncounted — conservative).
+    iters = int(result.iterations) + 1
+    samples_per_sec = n_rows * iters / dt
+
+    # Same-machine NumPy baseline on a subsample, scaled to full N.
+    sub = slice(0, n_rows // 8)
+    np_pass = numpy_pass_time(idx[sub], val[sub], labels[sub]) * 8.0
+    np_samples_per_sec = n_rows / np_pass
+
+    print(json.dumps({
+        "metric": "fixed_effect_logistic_lbfgs_samples_per_sec",
+        "value": round(samples_per_sec, 1),
+        "unit": "samples/sec",
+        "vs_baseline": round(samples_per_sec / np_samples_per_sec, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -177,7 +177,7 @@ def ell_from_rows(
     k = max_nnz or max((len(r[0]) for r in rows), default=1)
     k = max(k, 1)
     idx = np.full((n, k), dim, dtype=np.int32)
-    val = np.zeros((n, k), dtype=np.float32)
+    val = np.zeros((n, k), dtype=np.dtype(dtype))
     for i, (ri, rv) in enumerate(rows):
         if len(ri) > k:
             raise ValueError(

@@ -76,6 +76,18 @@ class GLMOptimizationProblem:
         obj = self.objective()
         vg = obj.bind(batch)
 
+        # Reference parity: L1 (and the L1 part of elastic net) is only
+        # handled by OWL-QN; pairing it with a smooth optimizer would
+        # silently train unregularized.
+        if (
+            self.optimizer_type != OptimizerType.OWLQN
+            and self.regularization.l1_weight(self.reg_weight) > 0.0
+        ):
+            raise ValueError(
+                f"{self.regularization.reg_type.name} regularization requires "
+                f"OptimizerType.OWLQN, got {self.optimizer_type.name}"
+            )
+
         if self.optimizer_type == OptimizerType.LBFGS:
             result = LBFGS(self.optimizer_config).optimize(vg, w0)
         elif self.optimizer_type == OptimizerType.OWLQN:

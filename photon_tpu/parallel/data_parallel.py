@@ -53,19 +53,11 @@ def fit_data_parallel(
     (padding is invisible to the objective — SURVEY.md batch semantics).
     Returns (GeneralizedLinearModel, OptimizerResult), both replicated.
     """
-    import jax.numpy as jnp
-    import numpy as np
-
     from photon_tpu.parallel.mesh import pad_rows_to_multiple
 
     axis_size = mesh.shape[data_axis]
-    n = batch.n_rows
-    if n % axis_size:
-        true_n = n
+    if batch.n_rows % axis_size:
         batch = pad_rows_to_multiple(batch, axis_size)
-        w = np.asarray(batch.weights)
-        w[true_n:] = 0.0
-        batch = dataclasses.replace(batch, weights=jnp.asarray(w))
     batch = shard_batch_pytree(batch, mesh, data_axis)
     rep = replicated(mesh)
     w0 = jax.device_put(w0, rep)

@@ -70,7 +70,9 @@ def shard_batch_pytree(batch, mesh: Mesh, axis: str = DATA_AXIS):
 
 def pad_rows_to_multiple(arrs_n_leading, multiple: int):
     """Host-side: pad row count to a multiple (for even sharding), returning
-    the padded pytree. Padded rows must be masked by weight=0 by the caller."""
+    the padded pytree. Padding is zero-fill, so for a LabeledBatch the padded
+    rows carry weight 0 and are invisible to objectives/evaluators — no
+    further masking is required."""
     import numpy as _np
 
     def pad(a):

@@ -107,18 +107,14 @@ def test_sparse_batch_data_parallel(rng, mesh):
 
 
 def test_uneven_rows_reject_or_pad(rng, mesh):
-    # 321 rows don't divide 8 — shard_batch_pytree should raise a clear error
-    # from jax; pad_rows_to_multiple is the documented fix.
+    # 321 rows don't divide 8; pad_rows_to_multiple zero-fills, which already
+    # leaves padded rows at weight 0 — the padded fit must equal the exact one.
     from photon_tpu.parallel.mesh import pad_rows_to_multiple
 
     batch = _data(rng, n=321)
     padded = pad_rows_to_multiple(batch, 8)
-    # mark padded rows invalid
-    w = np.asarray(padded.weights)
-    w[321:] = 0.0
-    padded = LabeledBatch(padded.features, padded.labels, padded.offsets,
-                          jnp.asarray(w))
     assert padded.n_rows == 328
+    np.testing.assert_array_equal(np.asarray(padded.weights)[321:], 0.0)
     prob = _make_problem()
     m_pad, _ = fit_data_parallel(prob, padded, jnp.zeros(9, jnp.float64), mesh)
     m_ref, _ = prob.run(batch, jnp.zeros(9, jnp.float64))
