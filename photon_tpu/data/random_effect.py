@@ -1,0 +1,233 @@
+"""Random-effect datasets: per-entity data, bucketed and padded for vmap.
+
+Parity: reference ⟦photon-api/.../data/RandomEffectDataset.scala⟧ +
+``LocalDataset`` + ⟦.../projector/LinearSubspaceProjector⟧ and the
+sample-count-balancing ⟦RandomEffectDatasetPartitioner⟧ (SURVEY.md §2.2,
+§3.5, §2.6 P2/P6).
+
+TPU-first layout: instead of an ``RDD[(REId, LocalDataset)]`` with one Breeze
+solve per entity inside ``mapPartitions``, entities are grouped host-side and
+packed into **buckets** of identical padded shape ``[E, S, K]`` (entities x
+max-samples x max-nnz). Within a bucket every per-entity solve is one lane of
+a ``vmap``; buckets shard over the mesh's entity axis. Shapes are quantized
+to powers of two so the number of distinct XLA compilations stays O(log² of
+the size range) — the TPU analog of the reference's skew-balancing
+partitioner.
+
+Feature projection: each entity sees only the feature columns present in its
+own rows (the reference's ``LinearSubspaceProjector``). Global ELL indices are
+remapped to a compact per-entity local space ``[0, P)``; ``proj[e, p]`` maps
+local slot p back to the global column (or ``global_dim`` for unused pad
+slots, which is the global ghost column). Scoring and model export gather
+through ``proj``.
+
+Active/passive split: rows beyond ``active_bound`` per entity keep weight for
+scoring (``weights``) but carry 0 in ``train_weights`` — the reference's
+passive data, scored but not trained on.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class EntityBucket:
+    """One padded bucket of entities with identical [E, S, K, P] shapes.
+
+    ``idx``/``val`` are per-entity ELL in *local* feature space (ghost column
+    = P). ``proj`` maps local→global columns (ghost slots hold
+    ``global_dim``). ``row_ids`` maps each (entity, sample) slot back to the
+    global row it came from (padding slots hold the global row count N, a
+    ghost row). ``weights`` masks valid rows; ``train_weights`` additionally
+    zeroes passive rows. ``entity_ids`` are dense REIds (padding: -1).
+    """
+
+    idx: Array            # [E, S, K] int32, local column ids
+    val: Array            # [E, S, K]
+    labels: Array         # [E, S]
+    weights: Array        # [E, S] — 0 marks padded rows
+    train_weights: Array  # [E, S] — 0 marks padded AND passive rows
+    row_ids: Array        # [E, S] int32 into the global sample order; N = pad
+    proj: Array           # [E, P] int32 local→global column map; dim = pad
+    entity_ids: Array     # [E] int32 dense entity ids; -1 = padded entity
+
+    @property
+    def n_entities(self) -> int:
+        return self.idx.shape[0]
+
+    @property
+    def max_samples(self) -> int:
+        return self.idx.shape[1]
+
+    @property
+    def local_dim(self) -> int:
+        return self.proj.shape[1]
+
+    def local_batches(self, global_offsets: Array):
+        """Per-entity LabeledBatch pytree stacked on axis 0 (for vmap), using
+        offsets gathered from the global per-sample offset vector."""
+        from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+
+        global_offsets = global_offsets.astype(self.val.dtype)
+        # Ghost row offset 0: extend then gather (row_ids padding == n).
+        ext = jnp.concatenate([global_offsets, jnp.zeros((1,), global_offsets.dtype)])
+        offsets = ext[self.row_ids]
+        return LabeledBatch(
+            features=SparseFeatures(idx=self.idx, val=self.val, dim=self.local_dim),
+            labels=self.labels,
+            offsets=offsets,
+            weights=self.train_weights,
+        )
+
+    def scores(self, coefs: Array) -> Array:
+        """Per-slot scores [E, S] from per-entity coefficients [E, P]
+        (offsets NOT included — GAME composes scores additively)."""
+        ext = jnp.concatenate([coefs, jnp.zeros_like(coefs[:, :1])], axis=1)
+
+        def one(w_ext, idx, val):
+            return jnp.sum(w_ext[idx] * val, axis=-1)
+
+        return jax.vmap(one)(ext, self.idx, self.val)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataset:
+    """All buckets for one random-effect coordinate + host-side entity index.
+
+    ``entity_to_slot`` maps entity key → (bucket_index, lane); ``n_rows`` is
+    the global sample count the ``row_ids`` refer to.
+    """
+
+    re_type: str                      # entity column name, e.g. "userId"
+    buckets: Sequence[EntityBucket]
+    entity_keys: Sequence             # dense REId -> original key
+    entity_to_slot: dict              # dense REId -> (bucket, lane)
+    n_rows: int
+    global_dim: int
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.entity_keys)
+
+    def scatter_scores(self, per_bucket_scores: Sequence[Array]) -> Array:
+        """Assemble a global [n_rows] score vector from per-bucket [E, S]
+        scores (padding slots point at the ghost row and are dropped)."""
+        out = jnp.zeros((self.n_rows + 1,), per_bucket_scores[0].dtype)
+        for b, s in zip(self.buckets, per_bucket_scores):
+            out = out.at[b.row_ids.ravel()].set(s.ravel())
+        return out[: self.n_rows]
+
+
+def build_random_effect_dataset(
+    re_type: str,
+    entity_keys_per_row: np.ndarray,
+    idx: np.ndarray,
+    val: np.ndarray,
+    labels: np.ndarray,
+    global_dim: int,
+    weights: Optional[np.ndarray] = None,
+    active_bound: Optional[int] = None,
+    min_entity_rows: int = 1,
+    intercept_index: Optional[int] = None,
+    dtype=np.float32,
+) -> RandomEffectDataset:
+    """Host-side builder: group rows by entity, project features, bucket+pad.
+
+    Inputs are global ELL arrays (``idx[N, K]`` with ghost == ``global_dim``)
+    plus one entity key per row. Entities with fewer than ``min_entity_rows``
+    rows are dropped (reference: ``numActiveDataPointsLowerBound``).
+    ``intercept_index``, when given, is force-included in every entity's
+    subspace so each per-entity model can carry an intercept.
+    """
+    n, k = idx.shape
+    labels = np.asarray(labels, dtype)
+    weights = np.ones(n, dtype) if weights is None else np.asarray(weights, dtype)
+
+    keys, inv = np.unique(entity_keys_per_row, return_inverse=True)
+    order = np.argsort(inv, kind="stable")
+    counts = np.bincount(inv, minlength=len(keys))
+
+    # Per-entity row lists in original order; drop tiny entities.
+    starts = np.zeros(len(keys) + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    kept = [e for e in range(len(keys)) if counts[e] >= min_entity_rows]
+
+    # Build per-entity projections + local data (numpy, then bucketed).
+    entities = []
+    for e in kept:
+        rows = order[starts[e]:starts[e + 1]]
+        e_idx = idx[rows]             # [s, k] global ids (ghost == global_dim)
+        cols = np.unique(e_idx[e_idx < global_dim])
+        if intercept_index is not None and intercept_index not in cols:
+            cols = np.sort(np.append(cols, intercept_index))
+        if len(cols) == 0:
+            cols = np.asarray([0], np.int64)
+        # local remap: ghost -> len(cols) (local ghost)
+        local = np.searchsorted(cols, np.minimum(e_idx, global_dim - 1)).astype(np.int32)
+        local = np.where(e_idx >= global_dim, len(cols), local)
+        entities.append((e, rows, cols, local, val[rows]))
+
+    # Bucket by (pow2 samples, pow2 local dim).
+    bucket_map: dict[tuple[int, int], list] = {}
+    for ent in entities:
+        s_cap = len(ent[1])
+        p_cap = len(ent[2])
+        key = (_next_pow2(s_cap), _next_pow2(p_cap))
+        bucket_map.setdefault(key, []).append(ent)
+
+    buckets = []
+    entity_keys_out = []
+    entity_to_slot = {}
+    for (s_pad, p_pad), members in sorted(bucket_map.items()):
+        ecount = len(members)
+        b_idx = np.full((ecount, s_pad, k), p_pad, np.int32)   # local ghost
+        b_val = np.zeros((ecount, s_pad, k), dtype)
+        b_lab = np.zeros((ecount, s_pad), dtype)
+        b_w = np.zeros((ecount, s_pad), dtype)
+        b_tw = np.zeros((ecount, s_pad), dtype)
+        b_rows = np.full((ecount, s_pad), n, np.int32)         # global ghost row
+        b_proj = np.full((ecount, p_pad), global_dim, np.int32)
+        b_eids = np.full((ecount,), -1, np.int32)
+        for lane, (e, rows, cols, local, vals) in enumerate(members):
+            s = len(rows)
+            b_idx[lane, :s] = local
+            b_val[lane, :s] = vals
+            b_lab[lane, :s] = labels[rows]
+            b_w[lane, :s] = weights[rows]
+            tw = weights[rows].copy()
+            if active_bound is not None and s > active_bound:
+                tw[active_bound:] = 0.0      # passive rows: scored, not trained
+            b_tw[lane, :s] = tw
+            b_rows[lane, :s] = rows
+            b_proj[lane, : len(cols)] = cols
+            dense_id = len(entity_keys_out)
+            b_eids[lane] = dense_id
+            entity_keys_out.append(keys[e])
+            entity_to_slot[dense_id] = (len(buckets), lane)
+        buckets.append(EntityBucket(
+            idx=jnp.asarray(b_idx), val=jnp.asarray(b_val),
+            labels=jnp.asarray(b_lab), weights=jnp.asarray(b_w),
+            train_weights=jnp.asarray(b_tw), row_ids=jnp.asarray(b_rows),
+            proj=jnp.asarray(b_proj), entity_ids=jnp.asarray(b_eids),
+        ))
+
+    return RandomEffectDataset(
+        re_type=re_type,
+        buckets=tuple(buckets),
+        entity_keys=list(entity_keys_out),
+        entity_to_slot=entity_to_slot,
+        n_rows=n,
+        global_dim=global_dim,
+    )

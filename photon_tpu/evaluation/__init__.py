@@ -1,0 +1,17 @@
+"""Evaluation: metric functions + evaluator/suite API (SURVEY.md §2.2)."""
+from photon_tpu.evaluation.evaluator import (  # noqa: F401
+    EvaluationResults,
+    EvaluationSuite,
+    Evaluator,
+    parse_evaluator,
+)
+from photon_tpu.evaluation.metrics import (  # noqa: F401
+    auc,
+    grouped_auc,
+    grouped_precision_at_k,
+    logistic_loss,
+    poisson_loss,
+    rmse,
+    smoothed_hinge_loss,
+    squared_loss,
+)

@@ -1,0 +1,215 @@
+"""Pure metric functions over score/label/weight arrays.
+
+Parity: reference ⟦photon-api/.../evaluation/⟧ — `AreaUnderROCCurveEvaluator`,
+`RMSEEvaluator`, `PoissonLossEvaluator`, `SquaredLossEvaluator`,
+`LogisticLossEvaluator`, `SmoothedHingeLossEvaluator`, `PrecisionAtKEvaluator`
+and the sharded/grouped `MultiEvaluator` variants (SURVEY.md §2.2).
+
+TPU-first: every metric is a fixed-shape jit-compatible function of
+``(scores, labels, weights[, group_ids])``. Weight 0 marks padding, so the
+same functions work on padded/sharded batches. Grouped metrics use
+``segment_sum`` over dense group ids instead of the reference's
+RDD ``groupBy`` — one pass, no shuffle (SURVEY.md §2.6 table).
+
+AUC uses the weighted Mann-Whitney statistic with half-credit for score ties
+(equal to trapezoidal ROC integration, the reference's tie convention —
+SURVEY.md §7 hard-part #7).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_EPS = 1e-12
+
+
+def _tie_group_ids(sorted_scores: Array) -> Array:
+    """Dense ids of equal-score runs in an already-sorted score vector."""
+    n = sorted_scores.shape[0]
+    boundary = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         (sorted_scores[1:] != sorted_scores[:-1]).astype(jnp.int32)]
+    )
+    return jnp.cumsum(boundary)
+
+
+def auc(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    """Weighted ROC AUC with average-rank (trapezoidal) tie handling.
+
+    AUC = Σ_pos w⁺·(W⁻_below + ½·W⁻_tied) / (W⁺·W⁻). Returns NaN when either
+    class has zero total weight (undefined, as in the reference).
+    """
+    w = jnp.ones_like(scores) if weights is None else weights
+    order = jnp.argsort(scores)
+    s, y, w = scores[order], labels[order], w[order]
+    pos_w = w * (y > 0.5)
+    neg_w = w * (y <= 0.5)
+
+    g = _tie_group_ids(s)
+    n = s.shape[0]
+    neg_per_group = jax.ops.segment_sum(neg_w, g, num_segments=n)
+    neg_below = jnp.cumsum(neg_per_group) - neg_per_group  # exclusive prefix
+
+    credit = pos_w * (neg_below[g] + 0.5 * neg_per_group[g])
+    w_pos = jnp.sum(pos_w)
+    w_neg = jnp.sum(neg_w)
+    return jnp.where(
+        (w_pos > 0) & (w_neg > 0),
+        jnp.sum(credit) / jnp.maximum(w_pos * w_neg, _EPS),
+        jnp.nan,
+    )
+
+
+def rmse(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    w = jnp.ones_like(scores) if weights is None else weights
+    se = w * (scores - labels) ** 2
+    return jnp.sqrt(jnp.sum(se) / jnp.maximum(jnp.sum(w), _EPS))
+
+
+def squared_loss(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    """Weighted mean squared error (reference SquaredLossEvaluator is a sum;
+    we report the weighted mean so values are comparable across data sizes,
+    matching how the reference normalizes in its sharded variants)."""
+    w = jnp.ones_like(scores) if weights is None else weights
+    return jnp.sum(w * (scores - labels) ** 2) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def logistic_loss(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    """Weighted mean logistic negative log-likelihood of raw scores."""
+    w = jnp.ones_like(scores) if weights is None else weights
+    # log(1+e^z) - y z, stable via logaddexp.
+    ll = jnp.logaddexp(0.0, scores) - labels * scores
+    return jnp.sum(w * ll) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def poisson_loss(scores: Array, labels: Array, weights: Array | None = None) -> Array:
+    """Weighted mean Poisson negative log-likelihood (dropping log y! const)."""
+    w = jnp.ones_like(scores) if weights is None else weights
+    nll = jnp.exp(scores) - labels * scores
+    return jnp.sum(w * nll) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+def smoothed_hinge_loss(
+    scores: Array, labels: Array, weights: Array | None = None
+) -> Array:
+    """Weighted mean Rennie smoothed hinge on ±1 targets (0/1 labels accepted)."""
+    w = jnp.ones_like(scores) if weights is None else weights
+    t = jnp.where(labels > 0.5, 1.0, -1.0)
+    z = t * scores
+    loss = jnp.where(
+        z >= 1.0, 0.0, jnp.where(z <= 0.0, 0.5 - z, 0.5 * (1.0 - z) ** 2)
+    )
+    return jnp.sum(w * loss) / jnp.maximum(jnp.sum(w), _EPS)
+
+
+# -- grouped ("sharded"/Multi) metrics --------------------------------------
+
+
+def _group_sort(group_ids: Array, scores: Array):
+    """Sort rows by (group, score desc); returns permutation."""
+    # Two stable sorts: by -score, then by group — lexicographic.
+    order1 = jnp.argsort(-scores, stable=True)
+    order2 = jnp.argsort(group_ids[order1], stable=True)
+    return order1[order2]
+
+
+def grouped_auc(
+    scores: Array,
+    labels: Array,
+    group_ids: Array,
+    weights: Array | None = None,
+    num_groups: int | None = None,
+) -> Array:
+    """Unweighted-mean over groups of within-group AUC.
+
+    Reference ⟦MultiAUCEvaluator / ShardedAUC:idTag⟧: groups lacking both a
+    positive and a negative are skipped. ``group_ids`` are dense ints in
+    [0, num_groups).
+    """
+    w = jnp.ones_like(scores) if weights is None else weights
+    m = num_groups if num_groups is not None else scores.shape[0]
+    order = _group_sort(group_ids, -scores)  # ascending score within group
+    gsort, ssort, ysort, wsort = (
+        group_ids[order], scores[order], labels[order], w[order]
+    )
+    n = scores.shape[0]
+
+    # Tie runs within (group, score): break runs when either changes.
+    boundary = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         ((ssort[1:] != ssort[:-1]) | (gsort[1:] != gsort[:-1])).astype(jnp.int32)]
+    )
+    tie = jnp.cumsum(boundary)
+
+    pos_w = wsort * (ysort > 0.5)
+    neg_w = wsort * (ysort <= 0.5)
+    neg_per_tie = jax.ops.segment_sum(neg_w, tie, num_segments=n)
+    neg_cum_incl = jnp.cumsum(neg_per_tie)  # over tie groups
+
+    # Exclusive prefix of negatives *within this group*: subtract the value at
+    # the group's first tie run.
+    first_tie_of_group = jax.ops.segment_min(tie, gsort, num_segments=m)
+    neg_before_tie = neg_cum_incl - neg_per_tie            # exclusive, global
+    group_base = neg_before_tie[first_tie_of_group]         # [m]
+    neg_below_in_group = neg_before_tie[tie] - group_base[gsort]
+
+    credit = pos_w * (neg_below_in_group + 0.5 * neg_per_tie[tie])
+    auc_num = jax.ops.segment_sum(credit, gsort, num_segments=m)
+    w_pos = jax.ops.segment_sum(pos_w, gsort, num_segments=m)
+    w_neg = jax.ops.segment_sum(neg_w, gsort, num_segments=m)
+    valid = (w_pos > 0) & (w_neg > 0)
+    per_group = auc_num / jnp.maximum(w_pos * w_neg, _EPS)
+    n_valid = jnp.sum(valid)
+    return jnp.where(
+        n_valid > 0,
+        jnp.sum(jnp.where(valid, per_group, 0.0)) / jnp.maximum(n_valid, 1),
+        jnp.nan,
+    )
+
+
+def grouped_precision_at_k(
+    scores: Array,
+    labels: Array,
+    group_ids: Array,
+    k: int,
+    weights: Array | None = None,
+    num_groups: int | None = None,
+) -> Array:
+    """Mean over groups of (# positives in the group's top-k scores) / k.
+
+    Reference ⟦PrecisionAtKEvaluator⟧ divides by k (not group size); groups
+    with no valid rows are skipped. Rows with weight 0 (padding) are ignored.
+    """
+    w = jnp.ones_like(scores) if weights is None else weights
+    m = num_groups if num_groups is not None else scores.shape[0]
+    valid_row = w > 0
+    # Push invalid rows to the bottom by group-sorting on masked scores.
+    neg_inf = jnp.asarray(-jnp.inf, scores.dtype)
+    masked = jnp.where(valid_row, scores, neg_inf)
+    order = _group_sort(group_ids, masked)
+    gsort = group_ids[order]
+    ysort = (labels[order] > 0.5) & valid_row[order]
+
+    # Rank within group = position - group start.
+    n = scores.shape[0]
+    pos_idx = jnp.arange(n)
+    group_start = jax.ops.segment_min(pos_idx, gsort, num_segments=m)
+    rank = pos_idx - group_start[gsort]
+    in_top_k = (rank < k) & valid_row[order]
+
+    hits = jax.ops.segment_sum(
+        (ysort & in_top_k).astype(scores.dtype), gsort, num_segments=m
+    )
+    group_rows = jax.ops.segment_sum(
+        valid_row[order].astype(scores.dtype), gsort, num_segments=m
+    )
+    has_rows = group_rows > 0
+    per_group = hits / k
+    n_valid = jnp.sum(has_rows)
+    return jnp.where(
+        n_valid > 0,
+        jnp.sum(jnp.where(has_rows, per_group, 0.0)) / jnp.maximum(n_valid, 1),
+        jnp.nan,
+    )

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
+from functools import partial
 from typing import Optional
 
 import jax
@@ -63,17 +64,38 @@ class GLMOptimizationProblem:
     variance_type: VarianceComputationType = VarianceComputationType.NONE
     reg_mask: Optional[Array] = None
 
-    def objective(self) -> GLMObjective:
+    def objective(self, reg_mask: Optional[Array] = None) -> GLMObjective:
         return GLMObjective(
             loss=loss_for_task(self.task),
             l2_weight=self.regularization.l2_weight(self.reg_weight),
-            reg_mask=self.reg_mask,
+            reg_mask=self.reg_mask if reg_mask is None else reg_mask,
         )
 
-    def run(
-        self, batch: LabeledBatch, w0: Array
+    def fit(
+        self, batch: LabeledBatch, w0: Array, reg_mask: Optional[Array] = None
     ) -> tuple[GeneralizedLinearModel, OptimizerResult]:
-        obj = self.objective()
+        """Jitted ``run`` with a process-wide compilation cache.
+
+        The problem (minus any array-valued ``reg_mask``, which is passed as
+        a dynamic argument) is the static jit key, so repeated fits with the
+        same config and shapes — every coordinate-descent step — reuse one
+        XLA executable instead of re-tracing a fresh ``jax.jit(problem.run)``.
+        """
+        mask = reg_mask if reg_mask is not None else self.reg_mask
+        key = (
+            dataclasses.replace(self, reg_mask=None)
+            if self.reg_mask is not None
+            else self
+        )
+        return _fit_jitted(key, batch, w0, mask)
+
+    def run(
+        self, batch: LabeledBatch, w0: Array, reg_mask: Optional[Array] = None
+    ) -> tuple[GeneralizedLinearModel, OptimizerResult]:
+        """Full solve. ``reg_mask`` overrides the static ``self.reg_mask`` —
+        used by random effects, where each vmapped entity solve carries its
+        own projected per-feature penalty mask."""
+        obj = self.objective(reg_mask)
         vg = obj.bind(batch)
 
         # Reference parity: L1 (and the L1 part of elastic net) is only
@@ -92,7 +114,7 @@ class GLMOptimizationProblem:
             result = LBFGS(self.optimizer_config).optimize(vg, w0)
         elif self.optimizer_type == OptimizerType.OWLQN:
             l1 = self.regularization.l1_weight(self.reg_weight)
-            mask = self.reg_mask if self.reg_mask is not None else jnp.ones_like(w0)
+            mask = obj.reg_mask if obj.reg_mask is not None else jnp.ones_like(w0)
             result = OWLQN(self.optimizer_config).optimize(vg, w0, l1 * mask)
         elif self.optimizer_type == OptimizerType.TRON:
             result = TRON(self.optimizer_config).optimize(vg, w0, obj.bind_hvp(batch))
@@ -119,3 +141,8 @@ class GLMOptimizationProblem:
         h = jax.vmap(lambda v: obj.hessian_vector(w, v, batch))(eye)
         h = 0.5 * (h + h.T)
         return jnp.diag(jnp.linalg.inv(h + 1e-12 * eye))
+
+
+@partial(jax.jit, static_argnums=0)
+def _fit_jitted(problem: GLMOptimizationProblem, batch, w0, reg_mask):
+    return problem.run(batch, w0, reg_mask)

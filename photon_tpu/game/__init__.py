@@ -1,0 +1,21 @@
+"""GAME (Generalized Additive Mixed Effects) layer — SURVEY.md §1 L5/L6.
+
+Coordinates, coordinate descent, composite models, estimator/transformer:
+the TPU-native rebuild of the reference's ⟦photon-api/.../algorithm/⟧,
+⟦.../model/⟧ and ⟦.../estimators/⟧ packages.
+"""
+from photon_tpu.game.coordinates import (  # noqa: F401
+    FixedEffectCoordinate,
+    FixedEffectModel,
+    RandomEffectCoordinate,
+)
+from photon_tpu.game.descent import (  # noqa: F401
+    CoordinateDescent,
+    CoordinateStepRecord,
+    GameModel,
+    ValidationData,
+)
+from photon_tpu.game.random_effect import (  # noqa: F401
+    RandomEffectModel,
+    train_random_effects,
+)

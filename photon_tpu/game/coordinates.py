@@ -1,0 +1,101 @@
+"""GAME coordinates: one unit of block-coordinate descent.
+
+Parity: reference ⟦photon-api/.../algorithm/Coordinate.scala,
+FixedEffectCoordinate.scala, RandomEffectCoordinate.scala⟧ (SURVEY.md §2.2,
+§3.4/§3.5). A coordinate owns its training data and optimization problem and
+exposes ``train(offsets, init) -> model`` and ``score(model) -> [N]``.
+
+TPU-first: offsets are a plain per-row array aligned with the global sample
+order (fixed at dataset build time), so the reference's score-RDD joins by
+``UniqueSampleId`` become elementwise adds (SURVEY.md §2.6 comm table).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import LabeledBatch
+from photon_tpu.data.random_effect import RandomEffectDataset
+from photon_tpu.functions.problem import GLMOptimizationProblem
+from photon_tpu.game.random_effect import (
+    RandomEffectModel,
+    train_random_effects,
+)
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.parallel.data_parallel import fit_data_parallel
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectModel:
+    """Population-level GLM for one feature shard — reference
+    ⟦FixedEffectModel(coefficientsBroadcast, featureShardId)⟧. Replication
+    over the mesh replaces the broadcast."""
+
+    model: GeneralizedLinearModel
+    feature_shard: str
+
+    def score_batch(self, batch: LabeledBatch) -> Array:
+        """Raw per-row scores WITHOUT offsets (GAME sums coordinate scores)."""
+        return batch.features.matvec(self.model.coefficients.means)
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectCoordinate:
+    """Train one GLM on all rows, data-parallel over the mesh (SURVEY §3.4)."""
+
+    batch: LabeledBatch            # offsets field ignored; passed per train()
+    problem: GLMOptimizationProblem
+    feature_shard: str = "global"
+    mesh: Optional[object] = None
+    data_axis: str = "data"
+
+    def train(self, offsets: Array, init: Optional[FixedEffectModel] = None):
+        batch = self.batch.with_offsets(offsets.astype(self.batch.labels.dtype))
+        if init is not None:
+            w0 = init.model.coefficients.means
+        else:
+            w0 = jnp.zeros((batch.dim,), batch.labels.dtype)
+        if self.mesh is not None:
+            model, result = fit_data_parallel(
+                self.problem, batch, w0, self.mesh, self.data_axis
+            )
+        else:
+            model, result = self.problem.fit(batch, w0)
+        return FixedEffectModel(model, self.feature_shard), result
+
+    def score(self, model: FixedEffectModel) -> Array:
+        return model.score_batch(self.batch)
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectCoordinate:
+    """Per-entity GLMs over a RandomEffectDataset (SURVEY §3.5)."""
+
+    dataset: RandomEffectDataset
+    problem: GLMOptimizationProblem
+    mesh: Optional[object] = None
+    entity_axis: str = "data"
+    global_reg_mask: Optional[Array] = None
+
+    def train(self, offsets: Array, init: Optional[RandomEffectModel] = None):
+        # Warm start is structural: same dataset -> same buckets, so the
+        # previous coefficient stacks are valid initial points.
+        init_coefs = init.bucket_coefs if init is not None else None
+        return train_random_effects(
+            self.problem, self.dataset, offsets,
+            mesh=self.mesh, entity_axis=self.entity_axis,
+            global_reg_mask=self.global_reg_mask,
+            init_coefs=init_coefs,
+        )
+
+    def score(self, model: RandomEffectModel) -> Array:
+        return model.score_dataset(self.dataset)
+
+
+Coordinate = Union[FixedEffectCoordinate, RandomEffectCoordinate]
+DatumScoringModel = Union[FixedEffectModel, RandomEffectModel]

@@ -1,0 +1,160 @@
+"""Coordinate descent: the GAME outer loop.
+
+Parity: reference ⟦photon-api/.../algorithm/CoordinateDescent.scala⟧ (SURVEY.md
+§3.3): for each sweep, for each coordinate in the update sequence — remove the
+coordinate's own score from the total, train against the residual as offset,
+add the new score back; evaluate on validation after every coordinate update
+and keep the best model seen.
+
+TPU-first: per-coordinate scores are plain [N] device arrays in a fixed global
+sample order, so the reference's score-RDD zip/joins are elementwise adds, and
+"subtract own score" is literally ``total - scores[cid]`` (SURVEY.md §2.6 P7).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.evaluation import EvaluationResults, EvaluationSuite
+from photon_tpu.game.coordinates import Coordinate, DatumScoringModel
+
+Array = jax.Array
+
+logger = logging.getLogger("photon_tpu.game")
+
+
+@dataclasses.dataclass(frozen=True)
+class GameModel:
+    """Composite model keyed by coordinate id — reference ⟦GameModel⟧."""
+
+    models: Mapping[str, DatumScoringModel]
+
+    def __getitem__(self, cid: str) -> DatumScoringModel:
+        return self.models[cid]
+
+    def keys(self):
+        return self.models.keys()
+
+
+@dataclasses.dataclass
+class CoordinateStepRecord:
+    """One (sweep, coordinate) step of the tracker — reference
+    ⟦OptimizationStatesTracker⟧ + per-step validation logging."""
+
+    sweep: int
+    coordinate_id: str
+    seconds: float
+    validation: Optional[EvaluationResults] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ValidationData:
+    """Validation rows + per-coordinate scorers.
+
+    ``scorers[cid](model) -> [n_rows]`` raw coordinate scores on the
+    validation rows (fixed effect: matvec on the validation batch; random
+    effect: cross-dataset projection). Built by the estimator.
+    """
+
+    labels: Array
+    weights: Array
+    offsets: Array
+    scorers: Mapping[str, object]
+    group_ids_by_column: Optional[Mapping[str, Array]] = None
+    num_groups_by_column: Optional[Mapping[str, int]] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateDescent:
+    """Run block-coordinate descent over an ordered update sequence."""
+
+    update_sequence: Sequence[str]
+    n_sweeps: int = 1
+
+    def run(
+        self,
+        coordinates: Mapping[str, Coordinate],
+        n_rows: int,
+        base_offsets: Optional[Array] = None,
+        validation: Optional[ValidationData] = None,
+        suite: Optional[EvaluationSuite] = None,
+        initial_models: Optional[Mapping[str, DatumScoringModel]] = None,
+    ) -> tuple[GameModel, list[CoordinateStepRecord]]:
+        for cid in self.update_sequence:
+            if cid not in coordinates:
+                raise ValueError(f"update sequence names unknown coordinate {cid!r}")
+        if validation is not None and suite is None:
+            raise ValueError("validation data provided without an evaluation suite")
+
+        base = (
+            jnp.zeros((n_rows,), jnp.float32)
+            if base_offsets is None
+            else jnp.asarray(base_offsets)
+        )
+
+        models: dict[str, DatumScoringModel] = dict(initial_models or {})
+        scores: dict[str, Array] = {}
+        # Initial scores from warm-start models, else zero.
+        for cid in self.update_sequence:
+            if cid in models:
+                scores[cid] = coordinates[cid].score(models[cid])
+            else:
+                scores[cid] = jnp.zeros((n_rows,), base.dtype)
+        total = base + sum(scores.values())
+
+        tracker: list[CoordinateStepRecord] = []
+        best_metric: Optional[float] = None
+        best_models: Optional[dict] = None
+        # Validation scores cached per coordinate — only the coordinate just
+        # trained is re-scored (random-effect cross-dataset projection is
+        # host-side work, so re-scoring every coordinate each step is O(C²)).
+        v_cache: dict[str, Array] = {
+            cid: validation.scorers[cid](models[cid])
+            for cid in models
+            if validation is not None
+        }
+
+        for sweep in range(self.n_sweeps):
+            for cid in self.update_sequence:
+                coord = coordinates[cid]
+                t0 = time.perf_counter()
+                residual_offset = total - scores[cid]
+                model, _ = coord.train(residual_offset, models.get(cid))
+                new_score = coord.score(model)
+                total = residual_offset + new_score
+                scores[cid] = new_score
+                models[cid] = model
+                dt = time.perf_counter() - t0
+
+                record = CoordinateStepRecord(sweep, cid, dt)
+                if validation is not None:
+                    v_cache[cid] = validation.scorers[cid](model)
+                    v_scores = sum(v_cache.values())
+                    record.validation = suite.evaluate(
+                        validation.offsets + v_scores,
+                        validation.labels,
+                        validation.weights,
+                        validation.group_ids_by_column,
+                        validation.num_groups_by_column,
+                    )
+                    primary = record.validation.primary
+                    if best_metric is None or suite.primary.better_than(
+                        primary, best_metric
+                    ):
+                        best_metric = primary
+                        best_models = dict(models)
+                    logger.info(
+                        "sweep %d coord %s: %s (%.2fs)",
+                        sweep, cid, record.validation, dt,
+                    )
+                else:
+                    logger.info("sweep %d coord %s done (%.2fs)", sweep, cid, dt)
+                tracker.append(record)
+
+        final = best_models if best_models is not None else models
+        return GameModel(dict(final)), tracker

@@ -1,0 +1,229 @@
+"""Random-effect training: vmapped per-entity solves, sharded over the mesh.
+
+Parity: reference ⟦photon-api/.../algorithm/RandomEffectCoordinate.scala⟧ +
+⟦SingleNodeOptimizationProblem⟧ (SURVEY.md §3.5): thousands of independent
+per-entity GLM solves. The reference runs one Breeze L-BFGS per entity inside
+``mapPartitions``; here each bucket of same-shape entities is ONE
+``vmap``-batched masked solve (entities converge at different iterations —
+``lax.while_loop`` under vmap runs until every lane's convergence flag is
+set, which is exactly the masked-convergence semantics SURVEY.md §7
+hard-part #1 calls for), compiled once and sharded across chips over the
+mesh's entity axis with zero communication in the inner loop (SPMD ≙ the
+reference's embarrassing parallelism, without the shuffle).
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from photon_tpu.data.random_effect import EntityBucket, RandomEffectDataset
+from photon_tpu.functions.problem import GLMOptimizationProblem
+from photon_tpu.optim.base import OptimizerResult
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectModel:
+    """Per-entity GLMs for one random-effect coordinate.
+
+    Parity: reference ⟦RandomEffectModel(modelsRDD: RDD[(REId, GLM)])⟧ — here
+    a list of per-bucket coefficient stacks ``[E, P]`` in each entity's local
+    feature subspace, plus the projection/slot structure to interpret them.
+    Unseen entities score 0 (the reference's fallback to the zero model).
+    """
+
+    re_type: str
+    task: TaskType
+    bucket_coefs: Sequence[Array]               # per bucket: [E, P]
+    bucket_proj: Sequence[Array]                # per bucket: [E, P] -> global col
+    bucket_entity_ids: Sequence[Array]          # per bucket: [E] dense REId
+    entity_keys: Sequence                       # dense REId -> original key
+    entity_to_slot: dict                        # dense REId -> (bucket, lane)
+    global_dim: int
+    bucket_variances: Optional[Sequence[Array]] = None
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.entity_keys)
+
+    def coefficients_for(self, entity_key) -> tuple[np.ndarray, np.ndarray]:
+        """(global_indices, values) sparse coefficient vector for one entity
+        (host-side; for model export and cross-dataset scoring)."""
+        keys = {k: i for i, k in enumerate(self.entity_keys)}
+        dense = keys.get(entity_key)
+        if dense is None:
+            return np.zeros(0, np.int64), np.zeros(0, np.float32)
+        b, lane = self.entity_to_slot[dense]
+        proj = np.asarray(self.bucket_proj[b][lane])
+        coefs = np.asarray(self.bucket_coefs[b][lane])
+        valid = proj < self.global_dim
+        return proj[valid].astype(np.int64), coefs[valid]
+
+    def score_dataset(self, dataset: RandomEffectDataset) -> Array:
+        """Scores for every row of the dataset this model was trained on
+        (or any dataset with identical bucket structure)."""
+        per_bucket = [
+            b.scores(c) for b, c in zip(dataset.buckets, self.bucket_coefs)
+        ]
+        return dataset.scatter_scores(per_bucket)
+
+    def project_to(self, dataset: RandomEffectDataset) -> list[Array]:
+        """Coefficient stacks re-projected into a *different* dataset's local
+        subspaces (validation / scoring data). Host-side per-entity remap —
+        the reference's model-RDD join by REId (SURVEY.md §3.6); entities
+        unseen at training time get the zero model."""
+        key_to_dense = {k: i for i, k in enumerate(self.entity_keys)}
+        old_proj = [np.asarray(p) for p in self.bucket_proj]
+        old_coefs = [np.asarray(c) for c in self.bucket_coefs]
+        out = []
+        for b in dataset.buckets:
+            proj = np.asarray(b.proj)
+            eids = np.asarray(b.entity_ids)
+            coefs = np.zeros(proj.shape, old_coefs[0].dtype)
+            for lane in range(b.n_entities):
+                dense_new = eids[lane]
+                if dense_new < 0:
+                    continue
+                dense_old = key_to_dense.get(dataset.entity_keys[dense_new])
+                if dense_old is None:
+                    continue
+                bo, lo = self.entity_to_slot[dense_old]
+                pv = old_proj[bo][lo]
+                cv = old_coefs[bo][lo]
+                valid = pv < self.global_dim
+                gi, gv = pv[valid], cv[valid]
+                if len(gi) == 0:
+                    continue
+                # match new local columns against the trained sparse vector
+                cols_new = proj[lane]
+                pos = np.clip(np.searchsorted(gi, cols_new), 0, len(gi) - 1)
+                hit = gi[pos] == cols_new
+                coefs[lane][hit] = gv[pos[hit]]
+            out.append(jnp.asarray(coefs))
+        return out
+
+    def score_new_dataset(self, dataset: RandomEffectDataset) -> Array:
+        """Scores for a dataset built from different rows (e.g. validation)."""
+        coef_stacks = self.project_to(dataset)
+        per_bucket = [
+            b.scores(c) for b, c in zip(dataset.buckets, coef_stacks)
+        ]
+        return dataset.scatter_scores(per_bucket)
+
+
+def _pad_bucket(
+    bucket: EntityBucket, multiple: int, n_rows: int, global_dim: int
+) -> EntityBucket:
+    """Pad the entity axis to a multiple of the mesh axis size with inert
+    lanes: weight-0 rows, ghost row_ids (so no score scatters anywhere),
+    ghost proj columns, and entity_id −1."""
+    e = bucket.n_entities
+    r = (-e) % multiple
+    if r == 0:
+        return bucket
+
+    def pad(a, fill):
+        widths = [(0, r)] + [(0, 0)] * (a.ndim - 1)
+        return jnp.pad(a, widths, constant_values=fill)
+
+    return EntityBucket(
+        idx=pad(bucket.idx, bucket.local_dim),      # local ghost column
+        val=pad(bucket.val, 0),
+        labels=pad(bucket.labels, 0),
+        weights=pad(bucket.weights, 0),
+        train_weights=pad(bucket.train_weights, 0),
+        row_ids=pad(bucket.row_ids, n_rows),        # global ghost row
+        proj=pad(bucket.proj, global_dim),          # global ghost column
+        entity_ids=pad(bucket.entity_ids, -1),
+    )
+
+
+@partial(jax.jit, static_argnums=0)
+def _fit_bucket_jitted(problem, batches, w0, local_mask):
+    """One vmapped bucket solve; static problem key keeps the XLA executable
+    cached across coordinate-descent sweeps (same config + bucket shapes)."""
+    return jax.vmap(
+        lambda b, w, m: problem.run(b, w, reg_mask=m), in_axes=(0, 0, 0)
+    )(batches, w0, local_mask)
+
+
+def train_random_effects(
+    problem: GLMOptimizationProblem,
+    dataset: RandomEffectDataset,
+    offsets: Array,
+    mesh=None,
+    entity_axis: str = "data",
+    global_reg_mask: Optional[Array] = None,
+    init_coefs: Optional[Sequence[Array]] = None,
+) -> tuple[RandomEffectModel, list[OptimizerResult]]:
+    """Fit one GLM per entity; returns the model + per-bucket solver results.
+
+    ``offsets`` is the global per-sample residual score from the other GAME
+    coordinates (reference: dataset offsets updated by CoordinateDescent).
+    ``global_reg_mask`` (e.g. 0 on the intercept column) is projected into
+    each entity's local subspace.
+    """
+    coefs_out, var_out, results = [], [], []
+    want_var = problem.variance_type.name != "NONE"
+
+    for b_i, bucket in enumerate(dataset.buckets):
+        orig_e = bucket.n_entities
+        if mesh is not None:
+            axis_size = mesh.shape[entity_axis]
+            bucket = _pad_bucket(bucket, axis_size, dataset.n_rows, dataset.global_dim)
+
+        p = bucket.local_dim
+        e = bucket.n_entities
+        if init_coefs is not None:
+            w0 = jnp.asarray(init_coefs[b_i], bucket.val.dtype)
+            if w0.shape[0] < e:  # mesh padding added inert lanes
+                w0 = jnp.pad(w0, ((0, e - w0.shape[0]), (0, 0)))
+        else:
+            w0 = jnp.zeros((e, p), bucket.val.dtype)
+
+        # Project the global regularization mask into each local subspace.
+        # Ghost slots get mask 1 (their coefficients stay 0 regardless).
+        if global_reg_mask is not None:
+            ext = jnp.concatenate(
+                [global_reg_mask.astype(bucket.val.dtype), jnp.ones((1,), bucket.val.dtype)]
+            )
+            local_mask = ext[bucket.proj]
+        else:
+            local_mask = jnp.ones((e, p), bucket.val.dtype)
+
+        batches = bucket.local_batches(offsets)
+
+        if mesh is not None:
+            shard = lambda leaf: jax.device_put(
+                leaf, NamedSharding(mesh, P(entity_axis, *([None] * (leaf.ndim - 1))))
+            )
+            batches = jax.tree.map(shard, batches)
+            w0 = shard(w0)
+            local_mask = shard(local_mask)
+
+        models, result = _fit_bucket_jitted(problem, batches, w0, local_mask)
+        coefs_out.append(models.coefficients.means[:orig_e])
+        if want_var:
+            var_out.append(models.coefficients.variances[:orig_e])
+        results.append(jax.tree.map(lambda a: a[:orig_e], result))
+
+    model = RandomEffectModel(
+        re_type=dataset.re_type,
+        task=problem.task,
+        bucket_coefs=coefs_out,
+        bucket_proj=[b.proj for b in dataset.buckets],
+        bucket_entity_ids=[b.entity_ids for b in dataset.buckets],
+        entity_keys=dataset.entity_keys,
+        entity_to_slot=dataset.entity_to_slot,
+        global_dim=dataset.global_dim,
+        bucket_variances=var_out if want_var else None,
+    )
+    return model, results

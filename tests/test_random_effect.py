@@ -1,0 +1,210 @@
+"""Random-effect dataset building + vmapped per-entity training.
+
+Golden standard (SURVEY.md §4 numerical-parity tier): each entity's vmapped
+masked solve must match fitting that entity alone, and the whole path must be
+invariant to bucketing, padding, and mesh sharding.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+from photon_tpu.data.random_effect import build_random_effect_dataset
+from photon_tpu.functions.problem import GLMOptimizationProblem
+from photon_tpu.game import train_random_effects
+from photon_tpu.optim import OptimizerConfig, OptimizerType, RegularizationContext, RegularizationType
+from photon_tpu.parallel.mesh import make_mesh
+from photon_tpu.types import TaskType
+
+L2 = RegularizationContext(RegularizationType.L2)
+
+
+def _make_entity_data(rng, n_entities=9, global_dim=50, k=6):
+    """Rows with entity keys; per-entity sample counts vary to force several
+    buckets. Returns global ELL arrays + per-row entity keys."""
+    rows_per_entity = rng.integers(3, 40, size=n_entities)
+    idx_rows, val_rows, labels, keys = [], [], [], []
+    true_w = rng.normal(size=(n_entities, global_dim))
+    for e in range(n_entities):
+        # each entity touches its own feature subset
+        support = rng.choice(global_dim, size=rng.integers(4, 12), replace=False)
+        for _ in range(rows_per_entity[e]):
+            nnz = rng.integers(2, k + 1)
+            cols = rng.choice(support, size=min(nnz, len(support)), replace=False)
+            vals = rng.normal(size=len(cols))
+            z = float(np.dot(vals, true_w[e][cols]))
+            y = float(rng.random() < 1 / (1 + np.exp(-z)))
+            idx_row = np.full(k, global_dim, np.int64)
+            val_row = np.zeros(k)
+            idx_row[: len(cols)] = cols
+            val_row[: len(cols)] = vals
+            idx_rows.append(idx_row)
+            val_rows.append(val_row)
+            labels.append(y)
+            keys.append(f"user_{e}")
+    order = rng.permutation(len(labels))  # interleave entities
+    return (
+        np.asarray(idx_rows)[order],
+        np.asarray(val_rows)[order],
+        np.asarray(labels, np.float64)[order],
+        np.asarray(keys)[order],
+    )
+
+
+@pytest.fixture
+def problem():
+    return GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=60),
+        regularization=L2,
+        reg_weight=0.5,
+    )
+
+
+def _fit_single_entity(problem, dataset, offsets, dense_id):
+    """Reference: solve one entity's local problem directly (no vmap)."""
+    b_i, lane = dataset.entity_to_slot[dense_id]
+    b = dataset.buckets[b_i]
+    batch = b.local_batches(jnp.asarray(offsets))
+    one = jax.tree.map(lambda a: a[lane], batch)
+    w0 = jnp.zeros((b.local_dim,), b.val.dtype)
+    model, _ = problem.run(one, w0)
+    return np.asarray(model.coefficients.means)
+
+
+def test_dataset_structure(rng):
+    idx, val, labels, keys = _make_entity_data(rng)
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
+    assert ds.n_entities == len(np.unique(keys))
+    assert ds.n_rows == len(labels)
+    # every row appears exactly once across buckets
+    all_rows = np.concatenate(
+        [np.asarray(b.row_ids).ravel() for b in ds.buckets])
+    real = all_rows[all_rows < ds.n_rows]
+    assert sorted(real.tolist()) == list(range(ds.n_rows))
+    # local indices within bounds; padded slots map to local ghost
+    for b in ds.buckets:
+        assert int(jnp.max(b.idx)) <= b.local_dim
+        proj = np.asarray(b.proj)
+        valid = proj < 50
+        # projection columns strictly increasing per entity (sorted unique)
+        for lane in range(b.n_entities):
+            cols = proj[lane][valid[lane]]
+            assert np.all(np.diff(cols) > 0)
+
+
+def test_vmapped_solves_match_individual(rng, problem):
+    idx, val, labels, keys = _make_entity_data(rng)
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
+    offsets = np.zeros(ds.n_rows)
+    model, results = train_random_effects(problem, ds, jnp.asarray(offsets))
+    assert len(model.bucket_coefs) == len(ds.buckets)
+    for dense_id in range(0, ds.n_entities, 3):  # spot-check a third
+        b_i, lane = ds.entity_to_slot[dense_id]
+        got = np.asarray(model.bucket_coefs[b_i][lane])
+        want = _fit_single_entity(problem, ds, offsets, dense_id)
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_scores_match_manual(rng, problem):
+    idx, val, labels, keys = _make_entity_data(rng)
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
+    model, _ = train_random_effects(problem, ds, jnp.zeros(ds.n_rows))
+    scores = np.asarray(model.score_dataset(ds))
+    # manual: per row, w_entity · x_row in the global space
+    key_list = list(model.entity_keys)
+    for r in range(0, ds.n_rows, 7):
+        gi, gv = model.coefficients_for(keys[r])
+        w_global = np.zeros(51)
+        w_global[gi] = gv
+        expect = float(np.sum(w_global[np.minimum(idx[r], 50)] * val[r]))
+        np.testing.assert_allclose(scores[r], expect, atol=1e-5)
+
+
+def test_mesh_sharded_matches_single_device(rng, problem):
+    idx, val, labels, keys = _make_entity_data(rng, n_entities=11)
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
+    offsets = jnp.zeros(ds.n_rows)
+    m_single, _ = train_random_effects(problem, ds, offsets)
+    mesh = make_mesh()
+    m_mesh, _ = train_random_effects(problem, ds, offsets, mesh=mesh)
+    for a, b in zip(m_single.bucket_coefs, m_mesh.bucket_coefs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-8)
+
+
+def test_active_passive_split(rng, problem):
+    idx, val, labels, keys = _make_entity_data(rng)
+    bound = 5
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, active_bound=bound,
+        dtype=np.float64)
+    # train_weights beyond bound are zero; weights stay 1
+    for b in ds.buckets:
+        tw = np.asarray(b.train_weights)
+        w = np.asarray(b.weights)
+        assert np.all(tw.sum(axis=1) <= bound + 1e-9)
+        assert np.all((tw > 0) <= (w > 0))
+    # passive rows are still scored
+    model, _ = train_random_effects(problem, ds, jnp.zeros(ds.n_rows))
+    scores = np.asarray(model.score_dataset(ds))
+    assert np.all(np.isfinite(scores))
+
+
+def test_offsets_affect_training(rng, problem):
+    idx, val, labels, keys = _make_entity_data(rng)
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
+    m0, _ = train_random_effects(problem, ds, jnp.zeros(ds.n_rows))
+    m1, _ = train_random_effects(
+        problem, ds, jnp.asarray(rng.normal(size=ds.n_rows)))
+    diffs = [
+        float(np.abs(np.asarray(a) - np.asarray(b)).max())
+        for a, b in zip(m0.bucket_coefs, m1.bucket_coefs)
+    ]
+    assert max(diffs) > 1e-3
+
+
+def test_reg_mask_projection(rng):
+    # intercept column 0 force-included and excluded from L2
+    idx, val, labels, keys = _make_entity_data(rng)
+    # add an intercept column to every row (replace last ELL slot)
+    idx[:, -1] = 0
+    val[:, -1] = 1.0
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, intercept_index=0,
+        dtype=np.float64)
+    prob = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=60),
+        regularization=L2, reg_weight=100.0,  # heavy L2 shrinks all but intercept
+    )
+    mask = jnp.ones(50).at[0].set(0.0)
+    model, _ = train_random_effects(
+        prob, ds, jnp.zeros(ds.n_rows), global_reg_mask=mask)
+    # intercepts (local slot of global col 0) should not be shrunk to ~0
+    some_nonzero = 0
+    for b_i, b in enumerate(ds.buckets):
+        proj = np.asarray(b.proj)
+        coefs = np.asarray(model.bucket_coefs[b_i])
+        for lane in range(b.n_entities):
+            slot = np.where(proj[lane] == 0)[0]
+            assert len(slot) == 1
+            others = np.delete(coefs[lane], slot[0])
+            if abs(coefs[lane][slot[0]]) > 0.05:
+                some_nonzero += 1
+            assert np.all(np.abs(others) < 0.5)  # heavily shrunk
+    assert some_nonzero > 0
+
+
+def test_unseen_entity_scores_zero(rng, problem):
+    idx, val, labels, keys = _make_entity_data(rng)
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
+    model, _ = train_random_effects(problem, ds, jnp.zeros(ds.n_rows))
+    gi, gv = model.coefficients_for("user_never_seen")
+    assert len(gi) == 0 and len(gv) == 0
