@@ -7,3 +7,23 @@ from photon_tpu.data.batch import (  # noqa: F401
     ell_from_rows,
     make_dense_batch,
 )
+from photon_tpu.data.normalization import (  # noqa: F401
+    NormalizationContext,
+    NormalizationType,
+    context_from_statistics,
+    identity_context,
+)
+from photon_tpu.data.sampling import (  # noqa: F401
+    BinaryClassificationDownSampler,
+    DownSampler,
+    down_sampler_for_task,
+)
+from photon_tpu.data.statistics import (  # noqa: F401
+    FeatureDataStatistics,
+    compute_feature_statistics,
+)
+from photon_tpu.data.validators import (  # noqa: F401
+    DataValidationError,
+    DataValidationType,
+    sanity_check_data,
+)

@@ -1,0 +1,175 @@
+"""Feature normalization contexts: optimize in a scaled space, report back.
+
+Parity: reference ⟦photon-api/.../normalization/NormalizationType.scala,
+NormalizationContext.scala⟧ (SURVEY.md §2.2 "Normalization", §7 hard-part #5):
+
+* ``NONE`` — identity.
+* ``SCALE_WITH_STANDARD_DEVIATION`` — factor 1/σⱼ, no shift.
+* ``SCALE_WITH_MAX_MAGNITUDE`` — factor 1/max|xⱼ|, no shift.
+* ``STANDARDIZATION`` — factor 1/σⱼ AND shift μⱼ (requires an intercept).
+
+The reference's key trick is preserved: **data is never transformed** (that
+would densify sparse features). Instead the coefficient vector is mapped
+between spaces around each margin computation. With transformed features
+x' = (x − s)∘f, a transformed-space model (w', b') scores
+
+    z = w'ᵀx' + b' = (w'∘f)ᵀ x + (b' − (w'∘f)ᵀ s)
+
+so the original-space equivalents are w = w'∘f and b = b' − (w'∘f)ᵀs — a
+linear map applied to coefficients once per objective evaluation, while the
+sparse matvec runs on the raw features. The intercept is excluded from both
+factor and shift (its factor is 1, shift 0), and shifts are only legal when an
+intercept exists to absorb them — both reference invariants, enforced here.
+
+Regularization applies to transformed-space coefficients (what the optimizer
+sees), again matching the reference.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.statistics import FeatureDataStatistics
+
+Array = jax.Array
+
+
+class NormalizationType(enum.Enum):
+    """Reference ⟦NormalizationType⟧."""
+
+    NONE = "NONE"
+    SCALE_WITH_STANDARD_DEVIATION = "SCALE_WITH_STANDARD_DEVIATION"
+    SCALE_WITH_MAX_MAGNITUDE = "SCALE_WITH_MAX_MAGNITUDE"
+    STANDARDIZATION = "STANDARDIZATION"
+
+    @classmethod
+    def parse(cls, s: str) -> "NormalizationType":
+        return cls(s.strip().upper())
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class NormalizationContext:
+    """factors[D] / shifts[D] (either may be None = identity).
+
+    ``intercept_index`` is static; factor there is forced to 1 and shift to 0.
+    """
+
+    factors: Optional[Array]
+    shifts: Optional[Array]
+    intercept_index: Optional[int] = dataclasses.field(
+        default=None, metadata=dict(static=True)
+    )
+
+    def __post_init__(self):
+        if self.shifts is not None and self.intercept_index is None:
+            raise ValueError(
+                "shifts require an intercept to absorb them (reference "
+                "NormalizationContext invariant)"
+            )
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    # -- coefficient-space maps (see module docstring for the algebra) ------
+
+    def coef_to_original(self, w: Array) -> Array:
+        """Transformed-space model → original-space model (w = w'∘f; intercept
+        absorbs −(w'∘f)ᵀs)."""
+        out = w if self.factors is None else w * self.factors
+        if self.shifts is not None:
+            corr = jnp.sum(out * self.shifts)
+            out = out.at[self.intercept_index].add(-corr)
+        return out
+
+    def coef_to_transformed(self, w: Array) -> Array:
+        """Original-space model → transformed-space model (inverse map)."""
+        out = w
+        if self.shifts is not None:
+            corr = jnp.sum(out * self.shifts)
+            out = out.at[self.intercept_index].add(corr)
+        if self.factors is not None:
+            out = out / self.factors
+        return out
+
+    def wrap_value_and_grad(
+        self, vg: Callable[[Array], tuple[Array, Array]]
+    ) -> Callable[[Array], tuple[Array, Array]]:
+        """Lift an original-space (value, grad) closure to transformed space.
+
+        The chain rule through the linear map ``coef_to_original`` is its
+        transpose, obtained exactly via ``jax.vjp`` — no hand-derived
+        adjoint to get silently wrong (SURVEY.md §7 hard-part #5).
+        """
+        if self.is_identity:
+            return vg
+
+        def wrapped(wp: Array) -> tuple[Array, Array]:
+            w, pullback = jax.vjp(self.coef_to_original, wp)
+            v, g = vg(w)
+            return v, pullback(g)[0]
+
+        return wrapped
+
+    def wrap_hvp(
+        self, hvp: Callable[[Array, Array], Array]
+    ) -> Callable[[Array, Array], Array]:
+        """Transformed-space HVP: H' = Aᵀ H A for the linear map A."""
+        if self.is_identity:
+            return hvp
+
+        def wrapped(wp: Array, vp: Array) -> Array:
+            w = self.coef_to_original(wp)
+            av = self.coef_to_original(vp)  # A is linear: A·v
+            _, pullback = jax.vjp(self.coef_to_original, wp)
+            return pullback(hvp(w, av))[0]
+
+        return wrapped
+
+
+def identity_context(intercept_index: Optional[int] = None) -> NormalizationContext:
+    return NormalizationContext(factors=None, shifts=None, intercept_index=intercept_index)
+
+
+def context_from_statistics(
+    stats: FeatureDataStatistics,
+    ntype: NormalizationType,
+    intercept_index: Optional[int] = None,
+) -> NormalizationContext:
+    """Build a context the way the reference's driver does from the feature
+    summary (⟦NormalizationContext.apply(normalizationType, summary,
+    interceptIdOpt)⟧). Zero-σ / zero-magnitude columns get factor 1."""
+    if ntype == NormalizationType.NONE:
+        return identity_context(intercept_index)
+
+    def safe_inv(x: Array) -> Array:
+        return jnp.where(x > 0, 1.0 / jnp.where(x > 0, x, 1.0), 1.0)
+
+    factors = shifts = None
+    if ntype == NormalizationType.SCALE_WITH_STANDARD_DEVIATION:
+        factors = safe_inv(stats.std())
+    elif ntype == NormalizationType.SCALE_WITH_MAX_MAGNITUDE:
+        factors = safe_inv(stats.max_magnitude())
+    elif ntype == NormalizationType.STANDARDIZATION:
+        if intercept_index is None:
+            raise ValueError(
+                "STANDARDIZATION shifts features and therefore requires an "
+                "intercept column (reference invariant)"
+            )
+        factors = safe_inv(stats.std())
+        shifts = stats.mean
+    else:  # pragma: no cover - enum is closed
+        raise ValueError(f"unknown normalization type {ntype}")
+
+    if intercept_index is not None:
+        factors = factors.at[intercept_index].set(1.0)
+        if shifts is not None:
+            shifts = shifts.at[intercept_index].set(0.0)
+    return NormalizationContext(
+        factors=factors, shifts=shifts, intercept_index=intercept_index
+    )

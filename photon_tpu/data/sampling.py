@@ -1,0 +1,112 @@
+"""Down-sampling for class imbalance, per coordinate.
+
+Parity: reference ⟦photon-api/.../sampling/DownSampler.scala,
+BinaryClassificationDownSampler, DefaultDownSampler⟧ (SURVEY.md §2.2
+"Down-sampling"): the fixed-effect coordinate may down-sample its training
+data per optimization config; dropped examples' weight mass is restored by
+re-scaling kept examples by 1/rate so the objective stays an unbiased
+estimate. The binary-classification variant keeps every positive and
+down-samples only negatives.
+
+TPU-first: shapes under jit are static, so "dropping" a row means zeroing its
+weight (weight 0 ≡ the row does not exist for loss/grad/Hessian — exactly the
+padded-row convention of ``LabeledBatch``) and the mask is drawn with
+``jax.random`` on-device. This keeps down-sampling inside the jitted training
+step with zero host round-trips. For genuine memory savings a host-side
+``compact`` helper physically repacks the kept rows into a smaller batch
+(bucketed to limit recompilation), which is what the reference's RDD filter
+achieves.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.batch import DenseFeatures, LabeledBatch, SparseFeatures
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class DownSampler:
+    """Uniform down-sampling at ``rate`` ∈ (0, 1]; weight rescale 1/rate.
+
+    Reference ⟦DefaultDownSampler⟧.
+    """
+
+    rate: float
+
+    def __post_init__(self):
+        if not (0.0 < self.rate <= 1.0):
+            raise ValueError(f"down-sampling rate must be in (0, 1], got {self.rate}")
+
+    def _keep_mask(self, key: Array, batch: LabeledBatch) -> Array:
+        return jax.random.uniform(key, (batch.n_rows,)) < self.rate
+
+    def down_sample(self, key: Array, batch: LabeledBatch) -> LabeledBatch:
+        """Jit-safe: zero dropped rows' weights, rescale kept rows."""
+        keep = self._keep_mask(key, batch)
+        new_w = jnp.where(keep, batch.weights / self.rate, 0.0)
+        return dataclasses.replace(batch, weights=new_w)
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryClassificationDownSampler(DownSampler):
+    """Keep all positives; down-sample negatives at ``rate``, re-weighting
+    kept negatives by 1/rate. Reference ⟦BinaryClassificationDownSampler⟧."""
+
+    def down_sample(self, key: Array, batch: LabeledBatch) -> LabeledBatch:
+        keep_draw = jax.random.uniform(key, (batch.n_rows,)) < self.rate
+        is_pos = batch.labels > 0
+        keep = is_pos | keep_draw
+        scale = jnp.where(is_pos, 1.0, 1.0 / self.rate)
+        new_w = jnp.where(keep, batch.weights * scale, 0.0)
+        return dataclasses.replace(batch, weights=new_w)
+
+
+def down_sampler_for_task(task: TaskType, rate: float) -> DownSampler:
+    """Reference ⟦DownSampler.apply⟧: binary tasks get the class-aware
+    sampler, everything else the default."""
+    if task in (TaskType.LOGISTIC_REGRESSION, TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM):
+        return BinaryClassificationDownSampler(rate)
+    return DownSampler(rate)
+
+
+def compact(batch: LabeledBatch, row_multiple: int = 128) -> LabeledBatch:
+    """Host-side repack: physically drop weight-0 rows, pad up to a multiple
+    of ``row_multiple`` (bounds the number of distinct compiled shapes)."""
+    w = np.asarray(jax.device_get(batch.weights))
+    keep = np.nonzero(w != 0)[0]
+    n = max(int(len(keep)), 1)
+    n_pad = -n % row_multiple
+    total = n + n_pad
+
+    def take(arr):
+        a = np.asarray(jax.device_get(arr))
+        out = np.zeros((total,) + a.shape[1:], a.dtype)
+        out[: len(keep)] = a[keep]
+        return jnp.asarray(out)
+
+    feats = batch.features
+    if isinstance(feats, DenseFeatures):
+        new_feats = DenseFeatures(take(feats.x))
+    elif isinstance(feats, SparseFeatures):
+        idx = np.asarray(jax.device_get(feats.idx))
+        pad_idx = np.full((total, idx.shape[1]), feats.dim, idx.dtype)
+        pad_idx[: len(keep)] = idx[keep]
+        new_feats = SparseFeatures(
+            idx=jnp.asarray(pad_idx), val=take(feats.val), dim=feats.dim
+        )
+    else:  # pragma: no cover - Features union is closed
+        raise TypeError(f"unknown feature container {type(feats)}")
+
+    return LabeledBatch(
+        features=new_feats,
+        labels=take(batch.labels),
+        offsets=take(batch.offsets),
+        weights=take(batch.weights),
+    )

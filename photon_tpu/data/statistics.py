@@ -1,0 +1,103 @@
+"""Per-feature summary statistics for normalization and summarization output.
+
+Parity: reference ⟦photon-api/.../stat/FeatureDataStatistics.scala⟧ /
+``BasicStatisticalSummary`` (wraps Spark's ``MultivariateStatisticalSummary``;
+SURVEY.md §2.2 "Statistics"). Mean / variance / min / max / nnz per feature
+column, computed over all examples of a feature shard.
+
+TPU-first: one jitted pass over the fixed-shape batch. Sparse (ELL) columns
+get exact moments including implicit zeros — Σx and Σx² come from
+``segment_sum`` over the index arrays, and the zero-count correction adjusts
+min/max/variance, mirroring what Spark's summarizer does streaming-wise.
+Padded rows (weight == 0) are excluded, matching the reference iterating only
+real examples.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import DenseFeatures, LabeledBatch, SparseFeatures
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FeatureDataStatistics:
+    """Summary over one feature shard. All arrays are [D]."""
+
+    mean: Array
+    variance: Array
+    min: Array
+    max: Array
+    num_nonzeros: Array   # float counts (jit-friendly)
+    count: Array          # scalar: number of (unpadded) examples
+
+    @property
+    def dim(self) -> int:
+        return self.mean.shape[-1]
+
+    def std(self) -> Array:
+        return jnp.sqrt(jnp.maximum(self.variance, 0.0))
+
+    def max_magnitude(self) -> Array:
+        return jnp.maximum(jnp.abs(self.min), jnp.abs(self.max))
+
+
+@jax.jit
+def compute_feature_statistics(batch: LabeledBatch) -> FeatureDataStatistics:
+    """One-pass per-feature summary; mask = rows with weight > 0."""
+    mask = (batch.weights > 0).astype(jnp.float32)
+    n = jnp.sum(mask)
+    n_safe = jnp.maximum(n, 1.0)
+    feats = batch.features
+
+    if isinstance(feats, DenseFeatures):
+        x = feats.x * mask[:, None]
+        s1 = jnp.sum(x, axis=0)
+        s2 = jnp.sum(x * x, axis=0)
+        # Masked-out rows read as +inf/-inf so they never win min/max.
+        big = jnp.inf
+        xm = jnp.where(mask[:, None] > 0, feats.x, big)
+        xM = jnp.where(mask[:, None] > 0, feats.x, -big)
+        mn = jnp.min(xm, axis=0)
+        mx = jnp.max(xM, axis=0)
+        # All rows masked out → no observations; report 0 like the sparse path.
+        mn = jnp.where(jnp.isinf(mn), 0.0, mn)
+        mx = jnp.where(jnp.isinf(mx), 0.0, mx)
+        nnz = jnp.sum((feats.x != 0) & (mask[:, None] > 0), axis=0).astype(jnp.float32)
+    elif isinstance(feats, SparseFeatures):
+        d = feats.dim
+        w_row = mask[:, None]
+        vals = feats.val * w_row
+        flat_idx = feats.idx.ravel()
+        s1 = jax.ops.segment_sum(vals.ravel(), flat_idx, num_segments=d + 1)[:d]
+        s2 = jax.ops.segment_sum((vals * feats.val).ravel(), flat_idx, num_segments=d + 1)[:d]
+        present = ((feats.val != 0) & (w_row > 0)).astype(jnp.float32)
+        nnz = jax.ops.segment_sum(present.ravel(), flat_idx, num_segments=d + 1)[:d]
+        # Min/max over explicit values; padding/masked slots neutralized.
+        big = jnp.float32(jnp.inf)
+        vm = jnp.where(present > 0, feats.val, big).ravel()
+        vM = jnp.where(present > 0, feats.val, -big).ravel()
+        mn = jax.ops.segment_min(vm, flat_idx, num_segments=d + 1)[:d]
+        mx = jax.ops.segment_max(vM, flat_idx, num_segments=d + 1)[:d]
+        # Implicit zeros: any column with fewer explicit nonzeros than rows
+        # also contains 0.
+        has_zero = nnz < n
+        mn = jnp.where(has_zero, jnp.minimum(mn, 0.0), mn)
+        mx = jnp.where(has_zero, jnp.maximum(mx, 0.0), mx)
+        # Columns never touched at all: min=max=0.
+        mn = jnp.where(jnp.isinf(mn), 0.0, mn)
+        mx = jnp.where(jnp.isinf(mx), 0.0, mx)
+    else:  # pragma: no cover - Features union is closed
+        raise TypeError(f"unknown feature container {type(feats)}")
+
+    mean = s1 / n_safe
+    # Sample variance with Bessel correction, as Spark's summarizer reports.
+    var = jnp.maximum(s2 - n * mean * mean, 0.0) / jnp.maximum(n - 1.0, 1.0)
+    return FeatureDataStatistics(
+        mean=mean, variance=var, min=mn, max=mx, num_nonzeros=nnz, count=n
+    )

@@ -1,0 +1,126 @@
+"""Pre-training data sanity checks.
+
+Parity: reference ⟦photon-api/.../data/DataValidators.scala⟧ +
+``DataValidationType`` (SURVEY.md §2.2 "Data validation"): finite features /
+offsets / weights, task-specific label checks (binary for logistic &
+smoothed-hinge SVM, finite for linear, non-negative for Poisson), with
+VALIDATE_FULL / VALIDATE_SAMPLE / VALIDATE_DISABLED modes.
+
+TPU-first: each check is one jitted reduction over the fixed-shape batch —
+all checks fuse into a single device pass returning a small vector of
+violation counts; only that vector crosses to the host, where failures raise
+``DataValidationError`` listing every failed check (the reference logs and
+aggregates all failures before throwing, so callers see the full list).
+Padded rows (weight == 0) are skipped. SAMPLE mode validates a deterministic
+row slice, standing in for the reference's RDD sample.
+"""
+from __future__ import annotations
+
+import enum
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import DenseFeatures, LabeledBatch, SparseFeatures
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+class DataValidationType(enum.Enum):
+    """Reference ⟦DataValidationType⟧."""
+
+    VALIDATE_FULL = "VALIDATE_FULL"
+    VALIDATE_SAMPLE = "VALIDATE_SAMPLE"
+    VALIDATE_DISABLED = "VALIDATE_DISABLED"
+
+    @classmethod
+    def parse(cls, s: str) -> "DataValidationType":
+        return cls(s.strip().upper())
+
+
+class DataValidationError(ValueError):
+    """Raised with the complete list of failed checks."""
+
+    def __init__(self, failures: list[str]):
+        self.failures = failures
+        super().__init__("data validation failed: " + "; ".join(failures))
+
+
+_CHECKS = (
+    "features are not all finite",
+    "offsets are not all finite",
+    "weights are not all finite and non-negative",
+    "labels are not all finite",
+    "labels are not all binary (0/1) as required by the task",
+    "labels are not all non-negative as required by Poisson regression",
+)
+
+
+@partial(jax.jit, static_argnums=1)
+def _violation_counts(batch: LabeledBatch, task: TaskType) -> Array:
+    """[len(_CHECKS)] counts of violating rows (0 where check passes/skipped)."""
+    mask = batch.weights != 0
+
+    feats = batch.features
+    if isinstance(feats, DenseFeatures):
+        row_finite = jnp.all(jnp.isfinite(feats.x), axis=-1)
+    elif isinstance(feats, SparseFeatures):
+        row_finite = jnp.all(jnp.isfinite(feats.val), axis=-1)
+    else:  # pragma: no cover - Features union is closed
+        raise TypeError(f"unknown feature container {type(feats)}")
+
+    def count(bad: Array) -> Array:
+        return jnp.sum(jnp.where(mask, bad, False).astype(jnp.int32))
+
+    binary_tasks = (
+        TaskType.LOGISTIC_REGRESSION,
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+    )
+    zero = jnp.zeros((), jnp.int32)
+    return jnp.stack(
+        [
+            count(~row_finite),
+            count(~jnp.isfinite(batch.offsets)),
+            jnp.sum(
+                (~jnp.isfinite(batch.weights) | (batch.weights < 0)).astype(jnp.int32)
+            ),
+            count(~jnp.isfinite(batch.labels)),
+            count((batch.labels != 0) & (batch.labels != 1))
+            if task in binary_tasks
+            else zero,
+            count(batch.labels < 0)
+            if task == TaskType.POISSON_REGRESSION
+            else zero,
+        ]
+    )
+
+
+def sanity_check_data(
+    batch: LabeledBatch,
+    task: TaskType,
+    validation_type: DataValidationType = DataValidationType.VALIDATE_FULL,
+    sample_rows: int = 1024,
+) -> None:
+    """Raise ``DataValidationError`` listing every failed check.
+
+    Reference ⟦DataValidators.sanityCheckDataFrameForTraining⟧ semantics:
+    run all applicable checks, aggregate, throw once with the full list.
+    """
+    if validation_type == DataValidationType.VALIDATE_DISABLED:
+        return
+    if validation_type == DataValidationType.VALIDATE_SAMPLE:
+        n = min(sample_rows, batch.n_rows)
+        batch = LabeledBatch(
+            features=batch.features.row_slice(0, n),
+            labels=batch.labels[:n],
+            offsets=batch.offsets[:n],
+            weights=batch.weights[:n],
+        )
+    counts = jax.device_get(_violation_counts(batch, task))
+    failures = [
+        f"{msg} ({int(c)} rows)" for msg, c in zip(_CHECKS, counts) if int(c) > 0
+    ]
+    if failures:
+        raise DataValidationError(failures)

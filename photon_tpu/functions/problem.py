@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from photon_tpu.data.batch import LabeledBatch
+from photon_tpu.data.normalization import NormalizationContext
 from photon_tpu.functions.objective import GLMObjective
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import GeneralizedLinearModel
@@ -72,7 +73,11 @@ class GLMOptimizationProblem:
         )
 
     def fit(
-        self, batch: LabeledBatch, w0: Array, reg_mask: Optional[Array] = None
+        self,
+        batch: LabeledBatch,
+        w0: Array,
+        reg_mask: Optional[Array] = None,
+        normalization: Optional["NormalizationContext"] = None,
     ) -> tuple[GeneralizedLinearModel, OptimizerResult]:
         """Jitted ``run`` with a process-wide compilation cache.
 
@@ -87,16 +92,40 @@ class GLMOptimizationProblem:
             if self.reg_mask is not None
             else self
         )
-        return _fit_jitted(key, batch, w0, mask)
+        return _fit_jitted(key, batch, w0, mask, normalization)
 
     def run(
-        self, batch: LabeledBatch, w0: Array, reg_mask: Optional[Array] = None
+        self,
+        batch: LabeledBatch,
+        w0: Array,
+        reg_mask: Optional[Array] = None,
+        normalization: Optional["NormalizationContext"] = None,
     ) -> tuple[GeneralizedLinearModel, OptimizerResult]:
         """Full solve. ``reg_mask`` overrides the static ``self.reg_mask`` —
         used by random effects, where each vmapped entity solve carries its
-        own projected per-feature penalty mask."""
+        own projected per-feature penalty mask.
+
+        With a non-identity ``normalization``, the optimizer runs in the
+        transformed feature space (regularization applies there, as in the
+        reference — SURVEY.md §7 hard-part #5) against the *raw* sparse
+        features, and the returned model is mapped back to original space.
+        """
         obj = self.objective(reg_mask)
-        vg = obj.bind(batch)
+        norm = normalization if normalization is not None and not normalization.is_identity else None
+        if norm is None:
+            vg = obj.bind(batch)
+        else:
+            # Data term evaluated through the coefficient-space map; the L2
+            # term applies directly to the transformed-space coefficients.
+            data_obj = dataclasses.replace(obj, l2_weight=0.0)
+            inner = norm.wrap_value_and_grad(data_obj.bind(batch))
+
+            def vg(wp: Array) -> tuple[Array, Array]:
+                v, g = inner(wp)
+                lam = obj._l2_vec(wp)
+                return v + 0.5 * jnp.sum(lam * wp * wp), g + lam * wp
+
+            w0 = norm.coef_to_transformed(w0)
 
         # Reference parity: L1 (and the L1 part of elastic net) is only
         # handled by OWL-QN; pairing it with a smooth optimizer would
@@ -117,13 +146,24 @@ class GLMOptimizationProblem:
             mask = obj.reg_mask if obj.reg_mask is not None else jnp.ones_like(w0)
             result = OWLQN(self.optimizer_config).optimize(vg, w0, l1 * mask)
         elif self.optimizer_type == OptimizerType.TRON:
-            result = TRON(self.optimizer_config).optimize(vg, w0, obj.bind_hvp(batch))
+            if norm is None:
+                hvp = obj.bind_hvp(batch)
+            else:
+                data_obj = dataclasses.replace(obj, l2_weight=0.0)
+                inner_hvp = norm.wrap_hvp(data_obj.bind_hvp(batch))
+
+                def hvp(wp: Array, vp: Array) -> Array:
+                    return inner_hvp(wp, vp) + obj._l2_vec(vp) * vp
+
+            result = TRON(self.optimizer_config).optimize(vg, w0, hvp)
         else:  # pragma: no cover - enum is closed
             raise ValueError(f"unknown optimizer {self.optimizer_type}")
 
-        variances = self._variances(obj, result.x, batch)
+        x = result.x if norm is None else norm.coef_to_original(result.x)
+        # Variances are reported for the original-space coefficients.
+        variances = self._variances(obj, x, batch)
         model = GeneralizedLinearModel(
-            Coefficients(means=result.x, variances=variances), self.task
+            Coefficients(means=x, variances=variances), self.task
         )
         return model, result
 
@@ -144,5 +184,5 @@ class GLMOptimizationProblem:
 
 
 @partial(jax.jit, static_argnums=0)
-def _fit_jitted(problem: GLMOptimizationProblem, batch, w0, reg_mask):
-    return problem.run(batch, w0, reg_mask)
+def _fit_jitted(problem: GLMOptimizationProblem, batch, w0, reg_mask, normalization):
+    return problem.run(batch, w0, reg_mask, normalization)

@@ -14,6 +14,7 @@ reference's embarrassing parallelism, without the shuffle).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Optional, Sequence
 
@@ -54,11 +55,14 @@ class RandomEffectModel:
     def n_entities(self) -> int:
         return len(self.entity_keys)
 
+    @functools.cached_property
+    def _key_to_dense(self) -> dict:
+        return {k: i for i, k in enumerate(self.entity_keys)}
+
     def coefficients_for(self, entity_key) -> tuple[np.ndarray, np.ndarray]:
         """(global_indices, values) sparse coefficient vector for one entity
         (host-side; for model export and cross-dataset scoring)."""
-        keys = {k: i for i, k in enumerate(self.entity_keys)}
-        dense = keys.get(entity_key)
+        dense = self._key_to_dense.get(entity_key)
         if dense is None:
             return np.zeros(0, np.int64), np.zeros(0, np.float32)
         b, lane = self.entity_to_slot[dense]
@@ -80,7 +84,7 @@ class RandomEffectModel:
         subspaces (validation / scoring data). Host-side per-entity remap —
         the reference's model-RDD join by REId (SURVEY.md §3.6); entities
         unseen at training time get the zero model."""
-        key_to_dense = {k: i for i, k in enumerate(self.entity_keys)}
+        key_to_dense = self._key_to_dense
         old_proj = [np.asarray(p) for p in self.bucket_proj]
         old_coefs = [np.asarray(c) for c in self.bucket_coefs]
         out = []

@@ -24,6 +24,7 @@ on an 8-device mesh (the reference's `local[*]` equivalent).
 """
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
 
 import jax
@@ -45,6 +46,7 @@ def fit_data_parallel(
     w0: Array,
     mesh,
     data_axis: str = DATA_AXIS,
+    normalization=None,
 ):
     """Run the full solve with the batch row-sharded over ``data_axis``.
 
@@ -57,23 +59,22 @@ def fit_data_parallel(
     axis_size = mesh.shape[data_axis]
     if batch.n_rows % axis_size:
         batch = pad_rows_to_multiple(batch, axis_size)
-    import dataclasses
 
     batch = shard_batch_pytree(batch, mesh, data_axis)
     rep = replicated(mesh)
     w0 = jax.device_put(w0, rep)
-    # Array-valued reg_mask can't be part of the static jit key; pass it
-    # dynamically (same convention as GLMOptimizationProblem.fit).
+    # Array-valued reg_mask / normalization can't be part of the static jit
+    # key; pass them dynamically (same convention as GLMOptimizationProblem.fit).
     mask = problem.reg_mask
     key = dataclasses.replace(problem, reg_mask=None) if mask is not None else problem
-    return _fit_dp_jitted(key, rep, batch, w0, mask)
+    return _fit_dp_jitted(key, rep, batch, w0, mask, normalization)
 
 
 @partial(jax.jit, static_argnums=(0, 1))
-def _fit_dp_jitted(problem, out_sharding, batch, w0, reg_mask):
+def _fit_dp_jitted(problem, out_sharding, batch, w0, reg_mask, normalization):
     # out_sharding (a NamedSharding: hashable) is applied via lax constraint
     # so the whole (problem, sharding) pair stays one cached executable.
-    model, result = problem.run(batch, w0, reg_mask)
+    model, result = problem.run(batch, w0, reg_mask, normalization)
     return jax.tree.map(
         lambda a: jax.lax.with_sharding_constraint(a, out_sharding),
         (model, result),

@@ -1,0 +1,309 @@
+"""Tests for statistics, normalization, validators, and down-sampling.
+
+Mirrors the reference's unit tiers for ⟦stat/⟧, ⟦normalization/⟧,
+⟦data/DataValidators⟧, ⟦sampling/⟧ (SURVEY.md §4): statistics vs numpy ground
+truth (dense and sparse agree), normalization round-trips and — the critical
+property (SURVEY.md §7 hard-part #5) — training with a NormalizationContext
+on raw data equals training on explicitly pre-transformed data.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.batch import (
+    DenseFeatures,
+    LabeledBatch,
+    ell_from_rows,
+    make_dense_batch,
+)
+from photon_tpu.data.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    context_from_statistics,
+)
+from photon_tpu.data.sampling import (
+    BinaryClassificationDownSampler,
+    DownSampler,
+    compact,
+    down_sampler_for_task,
+)
+from photon_tpu.data.statistics import compute_feature_statistics
+from photon_tpu.data.validators import (
+    DataValidationError,
+    DataValidationType,
+    sanity_check_data,
+)
+from photon_tpu.functions.objective import intercept_reg_mask
+from photon_tpu.functions.problem import GLMOptimizationProblem
+from photon_tpu.optim import OptimizerConfig, OptimizerType
+from photon_tpu.types import TaskType
+
+
+def _sparse_batch_from_dense(x, labels, dtype=jnp.float64):
+    rows = []
+    for r in x:
+        nz = np.nonzero(r)[0]
+        rows.append((nz.astype(np.int32), r[nz]))
+    feats = ell_from_rows(rows, dim=x.shape[1], dtype=dtype)
+    n = x.shape[0]
+    return LabeledBatch(
+        features=feats,
+        labels=jnp.asarray(labels, dtype),
+        offsets=jnp.zeros((n,), dtype),
+        weights=jnp.ones((n,), dtype),
+    )
+
+
+class TestStatistics:
+    def test_dense_matches_numpy(self, rng):
+        x = rng.normal(size=(50, 7))
+        x[x < -0.5] = 0.0  # some zeros for nnz
+        batch = make_dense_batch(x, np.zeros(50), dtype=jnp.float64)
+        s = compute_feature_statistics(batch)
+        np.testing.assert_allclose(s.mean, x.mean(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(s.variance, x.var(axis=0, ddof=1), rtol=1e-6)
+        np.testing.assert_allclose(s.min, x.min(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(s.max, x.max(axis=0), rtol=1e-6)
+        np.testing.assert_allclose(s.num_nonzeros, (x != 0).sum(axis=0))
+        assert int(s.count) == 50
+
+    def test_sparse_matches_dense(self, rng):
+        x = rng.normal(size=(40, 9))
+        x[x < 0.2] = 0.0
+        dense = compute_feature_statistics(
+            make_dense_batch(x, np.zeros(40), dtype=jnp.float64)
+        )
+        sparse = compute_feature_statistics(
+            _sparse_batch_from_dense(x, np.zeros(40))
+        )
+        for field in ("mean", "variance", "min", "max", "num_nonzeros"):
+            np.testing.assert_allclose(
+                getattr(sparse, field), getattr(dense, field), rtol=1e-6,
+                err_msg=field,
+            )
+
+    def test_padded_rows_excluded(self, rng):
+        x = rng.normal(size=(30, 4))
+        batch = make_dense_batch(x, np.zeros(30), dtype=jnp.float64)
+        padded = dataclasses.replace(
+            batch, weights=batch.weights.at[20:].set(0.0)
+        )
+        s = compute_feature_statistics(padded)
+        np.testing.assert_allclose(s.mean, x[:20].mean(axis=0), rtol=1e-6)
+        assert int(s.count) == 20
+
+
+class TestNormalization:
+    def test_coef_roundtrip(self, rng):
+        d = 8
+        f = jnp.asarray(rng.uniform(0.5, 2.0, size=d))
+        s = jnp.asarray(rng.normal(size=d)).at[0].set(0.0)
+        ctx = NormalizationContext(
+            factors=f.at[0].set(1.0), shifts=s, intercept_index=0
+        )
+        w = jnp.asarray(rng.normal(size=d))
+        np.testing.assert_allclose(
+            ctx.coef_to_transformed(ctx.coef_to_original(w)), w, rtol=1e-6
+        )
+
+    def test_shifts_require_intercept(self):
+        with pytest.raises(ValueError):
+            NormalizationContext(
+                factors=None, shifts=jnp.ones(3), intercept_index=None
+            )
+
+    def test_score_equivalence(self, rng):
+        """Original-space model from a transformed-space model scores raw x
+        identically to the transformed model scoring transformed x."""
+        n, d = 20, 6
+        x = rng.normal(size=(n, d))
+        x[:, 0] = 1.0  # intercept column
+        stats = compute_feature_statistics(
+            make_dense_batch(x, np.zeros(n), dtype=jnp.float64)
+        )
+        ctx = context_from_statistics(
+            stats, NormalizationType.STANDARDIZATION, intercept_index=0
+        )
+        f = np.asarray(ctx.factors)
+        sh = np.asarray(ctx.shifts)
+        xt = (x - sh) * f
+        xt[:, 0] = 1.0
+        wp = rng.normal(size=d)
+        z_t = xt @ wp
+        w = ctx.coef_to_original(jnp.asarray(wp))
+        z_o = x @ np.asarray(w)
+        np.testing.assert_allclose(z_o, z_t, rtol=1e-8)
+
+    @pytest.mark.parametrize(
+        "ntype",
+        [
+            NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+            NormalizationType.SCALE_WITH_MAX_MAGNITUDE,
+            NormalizationType.STANDARDIZATION,
+        ],
+    )
+    def test_training_parity_with_explicit_transform(self, rng, ntype):
+        """Fit(raw data, NormalizationContext) == Fit(pre-transformed data):
+        the reference's exact semantics — same optimum in transformed space,
+        coefficients reported back in original space."""
+        n, d = 300, 5
+        x = rng.normal(size=(n, d)) * np.array([1.0, 10.0, 0.1, 5.0, 2.0])
+        x += np.array([0.0, 3.0, -1.0, 0.0, 1.0])
+        y = (rng.uniform(size=n) < 0.5).astype(float)
+        xd = np.concatenate([np.ones((n, 1)), x], axis=1)
+        batch = make_dense_batch(xd, y, dtype=jnp.float64)
+        stats = compute_feature_statistics(batch)
+        ctx = context_from_statistics(stats, ntype, intercept_index=0)
+
+        prob = GLMOptimizationProblem(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_type=OptimizerType.LBFGS,
+            optimizer_config=OptimizerConfig(max_iterations=200, tolerance=1e-12),
+            reg_weight=0.5,
+            reg_mask=intercept_reg_mask(d + 1, 0),
+        )
+        model_a, _ = prob.run(
+            batch, jnp.zeros(d + 1, jnp.float64), normalization=ctx
+        )
+
+        # Explicitly transform the dense matrix and fit without a context.
+        f = np.asarray(ctx.factors)
+        sh = np.zeros(d + 1) if ctx.shifts is None else np.asarray(ctx.shifts)
+        xt = (xd - sh) * f
+        xt[:, 0] = 1.0
+        batch_t = make_dense_batch(xt, y, dtype=jnp.float64)
+        model_b, _ = prob.run(batch_t, jnp.zeros(d + 1, jnp.float64))
+
+        # model_b lives in transformed space; map back for comparison.
+        w_b = ctx.coef_to_original(model_b.coefficients.means)
+        np.testing.assert_allclose(
+            model_a.coefficients.means, w_b, rtol=1e-5, atol=1e-8
+        )
+
+    def test_tron_with_normalization(self, rng):
+        n, d = 200, 4
+        x = rng.normal(size=(n, d)) * np.array([1.0, 20.0, 0.05, 3.0])
+        y = (rng.uniform(size=n) < 0.5).astype(float)
+        xd = np.concatenate([np.ones((n, 1)), x], axis=1)
+        batch = make_dense_batch(xd, y, dtype=jnp.float64)
+        stats = compute_feature_statistics(batch)
+        ctx = context_from_statistics(
+            stats, NormalizationType.STANDARDIZATION, intercept_index=0
+        )
+        common = dict(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=OptimizerConfig(max_iterations=100, tolerance=1e-12),
+            reg_weight=1.0,
+            reg_mask=intercept_reg_mask(d + 1, 0),
+        )
+        m_tron, _ = GLMOptimizationProblem(
+            optimizer_type=OptimizerType.TRON, **common
+        ).run(batch, jnp.zeros(d + 1, jnp.float64), normalization=ctx)
+        m_lbfgs, _ = GLMOptimizationProblem(
+            optimizer_type=OptimizerType.LBFGS, **common
+        ).run(batch, jnp.zeros(d + 1, jnp.float64), normalization=ctx)
+        np.testing.assert_allclose(
+            m_tron.coefficients.means, m_lbfgs.coefficients.means,
+            rtol=1e-4, atol=1e-6,
+        )
+
+
+class TestValidators:
+    def _batch(self, rng, labels=None):
+        x = rng.normal(size=(20, 3))
+        y = (rng.uniform(size=20) < 0.5).astype(float) if labels is None else labels
+        return make_dense_batch(x, y, dtype=jnp.float64)
+
+    def test_clean_data_passes(self, rng):
+        sanity_check_data(self._batch(rng), TaskType.LOGISTIC_REGRESSION)
+
+    def test_nan_features_fail(self, rng):
+        b = self._batch(rng)
+        feats = DenseFeatures(b.features.x.at[3, 1].set(jnp.nan))
+        bad = dataclasses.replace(b, features=feats)
+        with pytest.raises(DataValidationError, match="features"):
+            sanity_check_data(bad, TaskType.LOGISTIC_REGRESSION)
+
+    def test_nonbinary_labels_fail_logistic_only(self, rng):
+        y = np.full(20, 2.5)
+        bad = self._batch(rng, labels=y)
+        with pytest.raises(DataValidationError, match="binary"):
+            sanity_check_data(bad, TaskType.LOGISTIC_REGRESSION)
+        sanity_check_data(bad, TaskType.LINEAR_REGRESSION)  # fine there
+
+    def test_negative_labels_fail_poisson(self, rng):
+        y = np.full(20, -1.0)
+        bad = self._batch(rng, labels=y)
+        with pytest.raises(DataValidationError, match="non-negative"):
+            sanity_check_data(bad, TaskType.POISSON_REGRESSION)
+
+    def test_all_failures_reported(self, rng):
+        b = self._batch(rng, labels=np.full(20, np.nan))
+        feats = DenseFeatures(b.features.x.at[0, 0].set(jnp.inf))
+        bad = dataclasses.replace(b, features=feats)
+        with pytest.raises(DataValidationError) as ei:
+            sanity_check_data(bad, TaskType.LOGISTIC_REGRESSION)
+        assert len(ei.value.failures) >= 2
+
+    def test_disabled_skips(self, rng):
+        bad = self._batch(rng, labels=np.full(20, np.nan))
+        sanity_check_data(
+            bad, TaskType.LOGISTIC_REGRESSION, DataValidationType.VALIDATE_DISABLED
+        )
+
+
+class TestDownSampling:
+    def test_weight_mass_preserved_in_expectation(self, rng):
+        n = 20000
+        x = rng.normal(size=(n, 2))
+        y = (rng.uniform(size=n) < 0.3).astype(float)
+        batch = make_dense_batch(x, y, dtype=jnp.float64)
+        ds = DownSampler(rate=0.25)
+        out = ds.down_sample(jax.random.key(0), batch)
+        total = float(jnp.sum(out.weights))
+        assert abs(total - n) / n < 0.05  # E[total] = n
+
+    def test_binary_keeps_positives(self, rng):
+        n = 5000
+        x = rng.normal(size=(n, 2))
+        y = (rng.uniform(size=n) < 0.5).astype(float)
+        batch = make_dense_batch(x, y, dtype=jnp.float64)
+        ds = BinaryClassificationDownSampler(rate=0.1)
+        out = ds.down_sample(jax.random.key(1), batch)
+        w = np.asarray(out.weights)
+        assert np.all(w[y == 1] == 1.0)  # positives untouched
+        kept_neg = w[(y == 0) & (w > 0)]
+        np.testing.assert_allclose(kept_neg, 10.0)
+        # negative weight mass approximately preserved
+        assert abs(kept_neg.sum() - (y == 0).sum()) / (y == 0).sum() < 0.1
+
+    def test_factory(self):
+        assert isinstance(
+            down_sampler_for_task(TaskType.LOGISTIC_REGRESSION, 0.5),
+            BinaryClassificationDownSampler,
+        )
+        assert not isinstance(
+            down_sampler_for_task(TaskType.LINEAR_REGRESSION, 0.5),
+            BinaryClassificationDownSampler,
+        )
+        with pytest.raises(ValueError):
+            DownSampler(rate=0.0)
+
+    def test_compact_repacks(self, rng):
+        n = 100
+        x = rng.normal(size=(n, 3))
+        y = np.zeros(n)
+        batch = make_dense_batch(x, y, dtype=jnp.float64)
+        sampled = dataclasses.replace(
+            batch, weights=batch.weights.at[::2].set(0.0)
+        )
+        small = compact(sampled, row_multiple=16)
+        assert small.n_rows == 64  # 50 kept → padded to 64
+        assert float(jnp.sum(small.weights)) == 50.0
+        # kept rows preserved in order
+        np.testing.assert_allclose(
+            np.asarray(small.features.x[:50]), x[1::2], rtol=1e-12
+        )
