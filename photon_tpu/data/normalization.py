@@ -78,23 +78,40 @@ class NormalizationContext:
 
     # -- coefficient-space maps (see module docstring for the algebra) ------
 
+    def _effective(self) -> tuple[Optional[Array], Optional[Array]]:
+        """Factors/shifts with the intercept slot forced to (1, 0).
+
+        ``context_from_statistics`` already sanitizes these, but a directly
+        constructed context must obey the same invariant or the two coef maps
+        stop being inverses; forcing here is tracer-safe (a value check in
+        ``__post_init__`` would fail under jit)."""
+        f, s = self.factors, self.shifts
+        if self.intercept_index is not None:
+            if f is not None:
+                f = f.at[self.intercept_index].set(1.0)
+            if s is not None:
+                s = s.at[self.intercept_index].set(0.0)
+        return f, s
+
     def coef_to_original(self, w: Array) -> Array:
         """Transformed-space model → original-space model (w = w'∘f; intercept
         absorbs −(w'∘f)ᵀs)."""
-        out = w if self.factors is None else w * self.factors
-        if self.shifts is not None:
-            corr = jnp.sum(out * self.shifts)
+        f, s = self._effective()
+        out = w if f is None else w * f
+        if s is not None:
+            corr = jnp.sum(out * s)
             out = out.at[self.intercept_index].add(-corr)
         return out
 
     def coef_to_transformed(self, w: Array) -> Array:
         """Original-space model → transformed-space model (inverse map)."""
+        f, s = self._effective()
         out = w
-        if self.shifts is not None:
-            corr = jnp.sum(out * self.shifts)
+        if s is not None:
+            corr = jnp.sum(out * s)
             out = out.at[self.intercept_index].add(corr)
-        if self.factors is not None:
-            out = out / self.factors
+        if f is not None:
+            out = out / f
         return out
 
     def wrap_value_and_grad(

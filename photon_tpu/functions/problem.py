@@ -160,26 +160,42 @@ class GLMOptimizationProblem:
             raise ValueError(f"unknown optimizer {self.optimizer_type}")
 
         x = result.x if norm is None else norm.coef_to_original(result.x)
-        # Variances are reported for the original-space coefficients.
-        variances = self._variances(obj, x, batch)
+        # Variances are reported for the original-space coefficients, from
+        # the curvature of the objective actually minimized.
+        variances = self._variances(obj, x, batch, norm)
         model = GeneralizedLinearModel(
             Coefficients(means=x, variances=variances), self.task
         )
         return model, result
 
     def _variances(
-        self, obj: GLMObjective, w: Array, batch: LabeledBatch
+        self,
+        obj: GLMObjective,
+        w: Array,
+        batch: LabeledBatch,
+        norm: Optional[NormalizationContext] = None,
     ) -> Optional[Array]:
         if self.variance_type == VarianceComputationType.NONE:
             return None
+        # Under normalization the minimized objective's L2 term is λ‖w'‖²
+        # with w'_j = w_j / f_j, i.e. an effective per-coefficient penalty
+        # λ/f_j² in original space (the intercept is shift-corrected but
+        # normally reg-masked). Use that effective penalty so the reported
+        # curvature matches the trained objective.
+        data_obj = dataclasses.replace(obj, l2_weight=0.0)
+        lam = obj._l2_vec(w)
+        if norm is not None and norm.factors is not None:
+            f, _ = norm._effective()
+            lam = lam / (f * f)
         if self.variance_type == VarianceComputationType.SIMPLE:
-            return 1.0 / jnp.maximum(obj.hessian_diagonal(w, batch), 1e-12)
+            diag = data_obj.hessian_diagonal(w, batch) + lam
+            return 1.0 / jnp.maximum(diag, 1e-12)
         # FULL: materialize H column-by-column via HVPs and invert. Only
         # sensible for moderate D (same caveat as the reference's full
         # Hessian inverse).
         eye = jnp.eye(w.shape[0], dtype=w.dtype)
-        h = jax.vmap(lambda v: obj.hessian_vector(w, v, batch))(eye)
-        h = 0.5 * (h + h.T)
+        h = jax.vmap(lambda v: data_obj.hessian_vector(w, v, batch))(eye)
+        h = 0.5 * (h + h.T) + jnp.diag(lam)
         return jnp.diag(jnp.linalg.inv(h + 1e-12 * eye))
 
 

@@ -1,0 +1,12 @@
+"""Feature index: (name, term) → column index maps."""
+from photon_tpu.index.index_map import (  # noqa: F401
+    DELIMITER,
+    INTERCEPT_NAME,
+    INTERCEPT_TERM,
+    DefaultIndexMap,
+    IndexMap,
+    MmapIndexMap,
+    build_index_from_features,
+    build_mmap_index,
+    feature_key,
+)

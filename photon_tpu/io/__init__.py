@@ -1,0 +1,21 @@
+"""I/O layer: Avro codec, data reading, model persistence."""
+from photon_tpu.io.avro import (  # noqa: F401
+    Decoder,
+    Encoder,
+    read_container,
+    read_records,
+    write_container,
+)
+from photon_tpu.io.data_reader import (  # noqa: F401
+    AvroDataReader,
+    FeatureShardConfig,
+    GameDataBundle,
+    InputColumnNames,
+    build_index_from_avro,
+)
+from photon_tpu.io.model_io import (  # noqa: F401
+    load_game_model,
+    save_feature_summary,
+    save_game_model,
+    save_scores,
+)

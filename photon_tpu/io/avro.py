@@ -1,0 +1,420 @@
+"""Avro binary codec + object container files, from scratch.
+
+Parity: the reference stores ALL data and models as Avro on HDFS
+(⟦photon-client/.../data/avro/AvroUtils.scala⟧, ⟦photon-avro-schemas/⟧ —
+SURVEY.md §2.3/§2.4). No Avro library ships in this image, so this module
+implements the Avro 1.x specification directly:
+
+* primitive binary encodings — zigzag-varint ``int``/``long``, little-endian
+  IEEE ``float``/``double``, length-prefixed ``bytes``/``string``;
+* complex types — records (fields in declaration order), enums (index),
+  arrays/maps (blocks terminated by count 0), unions (branch index then
+  value), fixed;
+* object container files — ``Obj\\x01`` magic, file-metadata map carrying the
+  writer schema JSON + codec, 16-byte sync marker, and data blocks of
+  (record count, byte length, payload, sync); ``null`` and ``deflate``
+  (raw zlib) codecs.
+
+Python values map naturally: records ↔ dicts, arrays ↔ lists, maps ↔ dicts,
+enums ↔ strings, null union branches ↔ None. Schemas are plain parsed-JSON
+dicts; named-type references are resolved through a registry so photon's
+nested ``NameTermValueAvro`` reuse works.
+
+The hot decode path (billions of training rows) has a C++ twin in
+``photon_tpu/native`` — this module is the reference implementation and the
+always-available fallback.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+import zlib
+from typing import Any, BinaryIO, Iterable, Iterator, Optional, Union
+
+MAGIC = b"Obj\x01"
+SYNC_SIZE = 16
+
+_PRIMITIVES = frozenset(
+    ("null", "boolean", "int", "long", "float", "double", "bytes", "string")
+)
+
+Schema = Union[str, dict, list]
+
+
+# ---------------------------------------------------------------------------
+# schema handling
+
+
+class SchemaError(ValueError):
+    pass
+
+
+def parse_schema(schema: Union[str, Schema]) -> Schema:
+    """Accept a JSON string or an already-parsed schema object."""
+    if isinstance(schema, str) and schema.lstrip().startswith(("{", "[", '"')):
+        return json.loads(schema)
+    return schema
+
+
+def _collect_names(schema: Schema, names: dict) -> None:
+    """Register named types (record/enum/fixed) for by-name references."""
+    if isinstance(schema, list):
+        for s in schema:
+            _collect_names(s, names)
+    elif isinstance(schema, dict):
+        t = schema.get("type")
+        if t in ("record", "enum", "fixed"):
+            name = schema["name"]
+            ns = schema.get("namespace")
+            full = f"{ns}.{name}" if ns and "." not in name else name
+            names[full] = schema
+            names[name.split(".")[-1]] = schema
+        if t == "record":
+            for f in schema.get("fields", ()):
+                _collect_names(f["type"], names)
+        elif t == "array":
+            _collect_names(schema["items"], names)
+        elif t == "map":
+            _collect_names(schema["values"], names)
+
+
+def _resolve(schema: Schema, names: dict) -> Schema:
+    if isinstance(schema, str) and schema not in _PRIMITIVES:
+        try:
+            return names[schema]
+        except KeyError:
+            raise SchemaError(f"unresolved named type {schema!r}") from None
+    if isinstance(schema, dict) and isinstance(schema.get("type"), str) and (
+        schema["type"] not in _PRIMITIVES
+        and schema["type"] not in ("record", "enum", "fixed", "array", "map")
+    ):
+        return _resolve(schema["type"], names)
+    return schema
+
+
+# ---------------------------------------------------------------------------
+# primitive binary encoding
+
+
+def _write_long(out: BinaryIO, n: int) -> None:
+    n = (n << 1) ^ (n >> 63)  # zigzag
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.write(bytes((b | 0x80,)))
+        else:
+            out.write(bytes((b,)))
+            return
+
+
+def _read_long(buf: memoryview, pos: int) -> tuple[int, int]:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        acc |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1), pos
+
+
+# ---------------------------------------------------------------------------
+# schema-driven encode
+
+
+class Encoder:
+    def __init__(self, schema: Union[str, Schema]):
+        self.schema = parse_schema(schema)
+        self.names: dict = {}
+        _collect_names(self.schema, self.names)
+
+    def encode(self, value: Any, out: Optional[BinaryIO] = None) -> bytes:
+        buf = out or io.BytesIO()
+        self._enc(self.schema, value, buf)
+        return b"" if out is not None else buf.getvalue()
+
+    def _enc(self, schema: Schema, v: Any, out: BinaryIO) -> None:
+        schema = _resolve(schema, self.names)
+        if isinstance(schema, list):  # union
+            for i, branch in enumerate(schema):
+                if _union_match(_resolve(branch, self.names), v):
+                    _write_long(out, i)
+                    self._enc(branch, v, out)
+                    return
+            raise SchemaError(f"value {v!r} matches no union branch {schema}")
+        t = schema if isinstance(schema, str) else schema["type"]
+        if t == "null":
+            return
+        if t == "boolean":
+            out.write(b"\x01" if v else b"\x00")
+        elif t in ("int", "long"):
+            _write_long(out, int(v))
+        elif t == "float":
+            out.write(struct.pack("<f", float(v)))
+        elif t == "double":
+            out.write(struct.pack("<d", float(v)))
+        elif t == "bytes":
+            _write_long(out, len(v))
+            out.write(v)
+        elif t == "string":
+            b = v.encode("utf-8")
+            _write_long(out, len(b))
+            out.write(b)
+        elif t == "fixed":
+            if len(v) != schema["size"]:
+                raise SchemaError("fixed size mismatch")
+            out.write(v)
+        elif t == "enum":
+            _write_long(out, schema["symbols"].index(v))
+        elif t == "array":
+            if v:
+                _write_long(out, len(v))
+                for item in v:
+                    self._enc(schema["items"], item, out)
+            _write_long(out, 0)
+        elif t == "map":
+            if v:
+                _write_long(out, len(v))
+                for k, item in v.items():
+                    self._enc("string", k, out)
+                    self._enc(schema["values"], item, out)
+            _write_long(out, 0)
+        elif t == "record":
+            for f in schema["fields"]:
+                name = f["name"]
+                if name in v:
+                    fv = v[name]
+                elif "default" in f:
+                    fv = f["default"]
+                else:
+                    raise SchemaError(f"missing field {name!r} with no default")
+                self._enc(f["type"], fv, out)
+        else:
+            raise SchemaError(f"unknown type {t!r}")
+
+
+def _union_match(schema: Schema, v: Any) -> bool:
+    t = schema if isinstance(schema, str) else (
+        schema[0] if isinstance(schema, list) else schema["type"]
+    )
+    if t == "null":
+        return v is None
+    if v is None:
+        return False
+    if t == "boolean":
+        return isinstance(v, bool)
+    if t in ("int", "long"):
+        return isinstance(v, int) and not isinstance(v, bool)
+    if t in ("float", "double"):
+        return isinstance(v, float) or (
+            isinstance(v, int) and not isinstance(v, bool)
+        )
+    if t in ("bytes", "fixed"):
+        return isinstance(v, (bytes, bytearray))
+    if t in ("string", "enum"):
+        return isinstance(v, str)
+    if t == "array":
+        return isinstance(v, (list, tuple))
+    if t in ("map", "record"):
+        return isinstance(v, dict)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# schema-driven decode
+
+
+class Decoder:
+    def __init__(self, schema: Union[str, Schema]):
+        self.schema = parse_schema(schema)
+        self.names: dict = {}
+        _collect_names(self.schema, self.names)
+
+    def decode(self, data: Union[bytes, memoryview], pos: int = 0) -> tuple[Any, int]:
+        return self._dec(self.schema, memoryview(data), pos)
+
+    def _dec(self, schema: Schema, buf: memoryview, pos: int) -> tuple[Any, int]:
+        schema = _resolve(schema, self.names)
+        if isinstance(schema, list):  # union
+            idx, pos = _read_long(buf, pos)
+            return self._dec(schema[idx], buf, pos)
+        t = schema if isinstance(schema, str) else schema["type"]
+        if t == "null":
+            return None, pos
+        if t == "boolean":
+            return buf[pos] != 0, pos + 1
+        if t in ("int", "long"):
+            return _read_long(buf, pos)
+        if t == "float":
+            return struct.unpack_from("<f", buf, pos)[0], pos + 4
+        if t == "double":
+            return struct.unpack_from("<d", buf, pos)[0], pos + 8
+        if t == "bytes":
+            n, pos = _read_long(buf, pos)
+            return bytes(buf[pos : pos + n]), pos + n
+        if t == "string":
+            n, pos = _read_long(buf, pos)
+            return str(buf[pos : pos + n], "utf-8"), pos + n
+        if t == "fixed":
+            n = schema["size"]
+            return bytes(buf[pos : pos + n]), pos + n
+        if t == "enum":
+            i, pos = _read_long(buf, pos)
+            return schema["symbols"][i], pos
+        if t == "array":
+            out = []
+            while True:
+                count, pos = _read_long(buf, pos)
+                if count == 0:
+                    return out, pos
+                if count < 0:  # block with byte size
+                    _, pos = _read_long(buf, pos)
+                    count = -count
+                for _ in range(count):
+                    item, pos = self._dec(schema["items"], buf, pos)
+                    out.append(item)
+        if t == "map":
+            out = {}
+            while True:
+                count, pos = _read_long(buf, pos)
+                if count == 0:
+                    return out, pos
+                if count < 0:
+                    _, pos = _read_long(buf, pos)
+                    count = -count
+                for _ in range(count):
+                    k, pos = self._dec("string", buf, pos)
+                    out[k], pos = self._dec(schema["values"], buf, pos)
+        if t == "record":
+            rec = {}
+            for f in schema["fields"]:
+                rec[f["name"]], pos = self._dec(f["type"], buf, pos)
+            return rec, pos
+        raise SchemaError(f"unknown type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# object container files
+
+
+def write_container(
+    path: str,
+    schema: Union[str, Schema],
+    records: Iterable[Any],
+    codec: str = "null",
+    block_records: int = 4096,
+    sync: Optional[bytes] = None,
+) -> int:
+    """Write an Avro object container file; returns the record count."""
+    schema = parse_schema(schema)
+    enc = Encoder(schema)
+    sync = sync or os.urandom(SYNC_SIZE)
+    if codec not in ("null", "deflate"):
+        raise SchemaError(f"unsupported codec {codec!r}")
+    n_written = 0
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        meta = {
+            "avro.schema": json.dumps(schema).encode(),
+            "avro.codec": codec.encode(),
+        }
+        menc = Encoder({"type": "map", "values": "bytes"})
+        f.write(menc.encode(meta))
+        f.write(sync)
+
+        block = io.BytesIO()
+        count = 0
+
+        def flush():
+            nonlocal count
+            if count == 0:
+                return
+            payload = block.getvalue()
+            if codec == "deflate":
+                payload = zlib.compress(payload)[2:-4]  # raw deflate, no hdr/cksum
+            hdr = io.BytesIO()
+            _write_long(hdr, count)
+            _write_long(hdr, len(payload))
+            f.write(hdr.getvalue())
+            f.write(payload)
+            f.write(sync)
+            block.seek(0)
+            block.truncate()
+            count = 0
+
+        for rec in records:
+            enc.encode(rec, out=block)
+            count += 1
+            n_written += 1
+            if count >= block_records:
+                flush()
+        flush()
+    return n_written
+
+
+def read_container(path: str) -> tuple[Schema, Iterator[Any]]:
+    """Read an Avro object container file → (writer schema, record iterator)."""
+    f = open(path, "rb")
+    if f.read(4) != MAGIC:
+        f.close()
+        raise SchemaError(f"{path}: not an Avro object container file")
+    # Decode the metadata map incrementally from the head of the file.
+    head = f.read(1 << 16)
+    mdec = Decoder({"type": "map", "values": "bytes"})
+    while True:
+        try:
+            meta, pos = mdec.decode(head)
+            break
+        except IndexError:  # metadata longer than the head buffer
+            more = f.read(1 << 16)
+            if not more:
+                f.close()
+                raise SchemaError(f"{path}: truncated container header") from None
+            head += more
+    schema = json.loads(meta["avro.schema"])
+    codec = meta.get("avro.codec", b"null").decode()
+    if codec not in ("null", "deflate"):
+        f.close()
+        raise SchemaError(f"unsupported codec {codec!r}")
+    f.seek(4 + pos)
+    sync = f.read(SYNC_SIZE)
+    dec = Decoder(schema)
+
+    def records() -> Iterator[Any]:
+        with f:
+            while True:
+                hdr = f.read(1)
+                if not hdr:
+                    return
+                # varint record count (non-zigzag read needs the raw stream)
+                buf = bytearray(hdr)
+                while buf[-1] & 0x80:
+                    buf += f.read(1)
+                count, _ = _read_long(memoryview(bytes(buf)), 0)
+                buf = bytearray(f.read(1))
+                while buf[-1] & 0x80:
+                    buf += f.read(1)
+                size, _ = _read_long(memoryview(bytes(buf)), 0)
+                payload = f.read(size)
+                if codec == "deflate":
+                    payload = zlib.decompress(payload, wbits=-15)
+                mv = memoryview(payload)
+                pos = 0
+                for _ in range(count):
+                    rec, pos = dec.decode(mv, pos)
+                    yield rec
+                if f.read(SYNC_SIZE) != sync:
+                    raise SchemaError(f"{path}: sync marker mismatch (corrupt block)")
+
+    return schema, records()
+
+
+def read_records(path: str) -> list[Any]:
+    """Convenience: fully materialize a container file's records."""
+    _, it = read_container(path)
+    return list(it)

@@ -1,0 +1,213 @@
+"""Avro training data → fixed-shape device batches per feature shard.
+
+Parity: reference ⟦photon-client/.../data/avro/AvroDataReader.scala,
+DataReader, InputColumnsNames⟧ (SURVEY.md §2.3): read
+``TrainingExampleAvro``-shaped records, look every ``(name, term)`` feature up
+in the shard's index map, and assemble one sparse feature vector per shard,
+carrying response / offset / weight / uid / entity-id columns alongside.
+
+TPU-first: the output is not a DataFrame but a ``GameDataBundle`` — padded
+ELL arrays per shard (``ell_from_rows``) in a fixed global row order, plus
+host-side numpy id columns. Entity ids for random effects are taken from the
+record's ``metadataMap`` (or a top-level field of the same name), exactly the
+two places the reference's ``GameConverters`` looks.
+
+Feature bags: a shard assembles from one or more record fields of
+``FeatureAvro`` lists (reference: feature-shard-id → feature-bag-keys map),
+plus an optional intercept.
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob as globlib
+import os
+from typing import Iterable, Mapping, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures, ell_from_rows
+from photon_tpu.index.index_map import (
+    INTERCEPT_NAME,
+    INTERCEPT_TERM,
+    IndexMap,
+    build_index_from_features,
+)
+from photon_tpu.io.avro import read_container
+
+
+@dataclasses.dataclass(frozen=True)
+class InputColumnNames:
+    """Reference ⟦InputColumnsNames⟧ defaults."""
+
+    uid: str = "uid"
+    response: str = "response"
+    offset: str = "offset"
+    weight: str = "weight"
+    features: str = "features"
+    # Reference data often uses "label" instead of "response".
+    response_aliases: tuple = ("response", "label")
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureShardConfig:
+    """Which feature bags make up one shard — reference
+    ⟦featureShardIdToFeatureSectionKeysMap⟧ + per-shard intercept switch."""
+
+    feature_bags: tuple = ("features",)
+    add_intercept: bool = True
+
+
+@dataclasses.dataclass
+class GameDataBundle:
+    """All rows of a dataset in one fixed global order.
+
+    ``features[shard]`` are padded ELL arrays; ``id_tags[column]`` are numpy
+    string arrays (entity ids for random effects, query ids for grouped
+    evaluation — reference GameDatum's idTagToValueMap).
+    """
+
+    features: dict
+    labels: np.ndarray
+    offsets: np.ndarray
+    weights: np.ndarray
+    uids: np.ndarray
+    id_tags: dict
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.labels)
+
+    def batch(self, shard: str, dtype=jnp.float32) -> LabeledBatch:
+        feats = self.features[shard]
+        return LabeledBatch(
+            features=feats,
+            labels=jnp.asarray(self.labels, dtype),
+            offsets=jnp.asarray(self.offsets, dtype),
+            weights=jnp.asarray(self.weights, dtype),
+        )
+
+
+def _expand_paths(paths) -> list[str]:
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            out.extend(sorted(globlib.glob(os.path.join(p, "*.avro"))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(globlib.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no avro files under {paths}")
+    return out
+
+
+class AvroDataReader:
+    """Read avro records into a GameDataBundle through per-shard index maps."""
+
+    def __init__(
+        self,
+        index_maps: Mapping[str, IndexMap],
+        shard_configs: Optional[Mapping[str, FeatureShardConfig]] = None,
+        columns: InputColumnNames = InputColumnNames(),
+        id_tag_columns: Sequence[str] = (),
+    ):
+        self.index_maps = dict(index_maps)
+        self.shard_configs = dict(shard_configs) if shard_configs else {
+            s: FeatureShardConfig() for s in self.index_maps
+        }
+        if set(self.shard_configs) != set(self.index_maps):
+            raise ValueError(
+                f"shard configs {set(self.shard_configs)} != index maps "
+                f"{set(self.index_maps)}"
+            )
+        self.columns = columns
+        self.id_tag_columns = tuple(id_tag_columns)
+
+    def read(self, paths, dtype=jnp.float32) -> GameDataBundle:
+        cols = self.columns
+        labels, offsets, weights, uids = [], [], [], []
+        tags: dict[str, list] = {t: [] for t in self.id_tag_columns}
+        shard_rows: dict[str, list] = {s: [] for s in self.index_maps}
+
+        for rec in _iter_records(_expand_paths(paths)):
+            labels.append(_first(rec, cols.response_aliases, required=True))
+            offsets.append(rec.get(cols.offset) or 0.0)
+            w = rec.get(cols.weight)
+            weights.append(1.0 if w is None else w)
+            uids.append(rec.get(cols.uid) or "")
+            meta = rec.get("metadataMap") or {}
+            for t in self.id_tag_columns:
+                v = rec.get(t)
+                if v is None:  # absent OR null top-level field → metadataMap
+                    v = meta.get(t)
+                if v is None:
+                    raise ValueError(
+                        f"id tag column {t!r} missing from record and metadataMap"
+                    )
+                tags[t].append(str(v))
+
+            for shard, cfg in self.shard_configs.items():
+                imap = self.index_maps[shard]
+                idxs, vals = [], []
+                if cfg.add_intercept:
+                    ii = imap.get_index(INTERCEPT_NAME, INTERCEPT_TERM)
+                    if ii >= 0:
+                        idxs.append(ii)
+                        vals.append(1.0)
+                for bag in cfg.feature_bags:
+                    for feat in rec.get(bag) or ():
+                        i = imap.get_index(feat["name"], feat.get("term"))
+                        if i >= 0:  # unindexed features dropped, as reference
+                            idxs.append(i)
+                            vals.append(feat["value"])
+                shard_rows[shard].append((idxs, vals))
+
+        features = {
+            shard: ell_from_rows(rows, dim=len(self.index_maps[shard]), dtype=dtype)
+            for shard, rows in shard_rows.items()
+        }
+        return GameDataBundle(
+            features=features,
+            labels=np.asarray(labels, np.float64),
+            offsets=np.asarray(offsets, np.float64),
+            weights=np.asarray(weights, np.float64),
+            uids=np.asarray(uids, object),
+            id_tags={t: np.asarray(v, object) for t, v in tags.items()},
+        )
+
+
+def _iter_records(files: list[str]) -> Iterable[dict]:
+    for path in files:
+        _, it = read_container(path)
+        yield from it
+
+
+def _first(rec: dict, names, required: bool = False):
+    for n in names:
+        v = rec.get(n)
+        if v is not None:
+            return v
+    if required:
+        raise ValueError(f"record missing required column (any of {names}): {rec}")
+    return None
+
+
+def build_index_from_avro(
+    paths,
+    feature_bags: Sequence[str] = ("features",),
+    add_intercept: bool = True,
+):
+    """Scan avro files and index every (name, term) seen — the in-memory core
+    of the reference's ⟦FeatureIndexingDriver⟧."""
+
+    def pairs():
+        for rec in _iter_records(_expand_paths(paths)):
+            for bag in feature_bags:
+                for feat in rec.get(bag) or ():
+                    yield feat["name"], feat.get("term")
+
+    return build_index_from_features(pairs(), add_intercept=add_intercept)

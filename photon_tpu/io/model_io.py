@@ -1,0 +1,293 @@
+"""GAME model directory save/load in the reference's Avro layout.
+
+Parity: reference ⟦photon-client/.../data/avro/ModelProcessingUtils.scala,
+AvroUtils, ScoreProcessingUtils⟧ (SURVEY.md §2.3 "Model I/O"):
+
+    model-dir/
+      game-metadata.json                      (coordinate → type/shard/task)
+      fixed-effect/<coord>/coefficients.avro  1 BayesianLinearModelAvro
+      random-effect/<coord>/part-00000.avro   1 record per entity
+      scores .avro via save_scores            ScoringResultAvro
+      feature summary via save_feature_summary
+
+Coefficients are stored as (name, term, value) lists resolved through the
+shard's IndexMap — the on-disk format is index-free, so models survive
+re-indexing, exactly the property the reference's Avro layout provides.
+Loading a random-effect coordinate reconstructs a ``RandomEffectModel`` with
+one synthetic bucket (per-entity sparse vectors padded to a common width);
+all scoring/projection paths accept it like a trained model.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+from typing import Mapping, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.game.coordinates import FixedEffectModel
+from photon_tpu.game.descent import GameModel
+from photon_tpu.game.random_effect import RandomEffectModel
+from photon_tpu.index.index_map import IndexMap
+from photon_tpu.io.avro import read_records, write_container
+from photon_tpu.io.schemas import (
+    BAYESIAN_LINEAR_MODEL_AVRO,
+    FEATURE_SUMMARIZATION_RESULT_AVRO,
+    SCORING_RESULT_AVRO,
+)
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.types import TaskType
+
+_MODEL_CLASS = {
+    TaskType.LOGISTIC_REGRESSION:
+        "com.linkedin.photon.ml.supervised.classification.LogisticRegressionModel",
+    TaskType.LINEAR_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.LinearRegressionModel",
+    TaskType.POISSON_REGRESSION:
+        "com.linkedin.photon.ml.supervised.regression.PoissonRegressionModel",
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM:
+        "com.linkedin.photon.ml.supervised.classification.SmoothedHingeLossLinearSVMModel",
+}
+_CLASS_TO_TASK = {v: k for k, v in _MODEL_CLASS.items()}
+
+_META = "game-metadata.json"
+
+
+def _nt_list(imap: IndexMap, indices, values) -> list[dict]:
+    out = []
+    for i, v in zip(indices, values):
+        v = float(v)
+        if v == 0.0 or math.isnan(v):
+            continue
+        name, term = imap.get_feature(int(i))
+        out.append({"name": name, "term": term, "value": v})
+    return out
+
+
+def _from_nt_list(imap: IndexMap, items) -> tuple[np.ndarray, np.ndarray]:
+    idx, val = [], []
+    for it in items:
+        i = imap.get_index(it["name"], it.get("term"))
+        if i >= 0:
+            idx.append(i)
+            val.append(it["value"])
+    return np.asarray(idx, np.int64), np.asarray(val, np.float64)
+
+
+def save_game_model(
+    model_dir: str,
+    model: GameModel,
+    index_maps: Mapping[str, IndexMap],
+    shard_by_coordinate: Optional[Mapping[str, str]] = None,
+) -> None:
+    """Write every coordinate of a GameModel in the reference layout."""
+    os.makedirs(model_dir, exist_ok=True)
+    meta: dict = {"coordinates": {}}
+    shard_by_coordinate = dict(shard_by_coordinate or {})
+
+    for cid in model.keys():
+        m = model[cid]
+        if isinstance(m, FixedEffectModel):
+            shard = shard_by_coordinate.get(cid, m.feature_shard)
+            imap = index_maps[shard]
+            cdir = os.path.join(model_dir, "fixed-effect", cid)
+            os.makedirs(cdir, exist_ok=True)
+            coefs = np.asarray(m.model.coefficients.means)
+            nz = np.nonzero(coefs)[0]
+            rec = {
+                "modelId": cid,
+                "modelClass": _MODEL_CLASS[m.model.task],
+                "lossFunction": m.model.task.value,
+                "means": _nt_list(imap, nz, coefs[nz]),
+                "variances": None,
+            }
+            if m.model.coefficients.variances is not None:
+                var = np.asarray(m.model.coefficients.variances)
+                # A coefficient can be exactly 0 (e.g. OWL-QN) with a finite
+                # posterior variance — keep every nonzero variance entry.
+                vnz = np.nonzero(var)[0]
+                rec["variances"] = _nt_list(imap, vnz, var[vnz])
+            write_container(
+                os.path.join(cdir, "coefficients.avro"),
+                BAYESIAN_LINEAR_MODEL_AVRO,
+                [rec],
+            )
+            meta["coordinates"][cid] = {
+                "type": "fixed",
+                "feature_shard": shard,
+                "task": m.model.task.value,
+            }
+        elif isinstance(m, RandomEffectModel):
+            shard = shard_by_coordinate.get(cid, "global")
+            imap = index_maps[shard]
+            cdir = os.path.join(model_dir, "random-effect", cid)
+            os.makedirs(cdir, exist_ok=True)
+
+            def entity_records(m=m, imap=imap):
+                for key in m.entity_keys:
+                    gi, gv = m.coefficients_for(key)
+                    yield {
+                        "modelId": str(key),
+                        "modelClass": _MODEL_CLASS[m.task],
+                        "lossFunction": m.task.value,
+                        "means": _nt_list(imap, gi, gv),
+                        "variances": None,
+                    }
+
+            write_container(
+                os.path.join(cdir, "part-00000.avro"),
+                BAYESIAN_LINEAR_MODEL_AVRO,
+                entity_records(),
+            )
+            meta["coordinates"][cid] = {
+                "type": "random",
+                "feature_shard": shard,
+                "task": m.task.value,
+                "re_type": m.re_type,
+            }
+        else:
+            raise TypeError(f"coordinate {cid}: unknown model type {type(m)}")
+
+    with open(os.path.join(model_dir, _META), "w") as f:
+        json.dump(meta, f, indent=2)
+
+
+def load_game_model(
+    model_dir: str, index_maps: Mapping[str, IndexMap]
+) -> tuple[GameModel, dict]:
+    """Load a model directory → (GameModel, metadata dict).
+
+    Reference ⟦ModelProcessingUtils.loadGameModelFromHDFS⟧ (SURVEY.md §3.6).
+    """
+    with open(os.path.join(model_dir, _META)) as f:
+        meta = json.load(f)
+    models: dict = {}
+    for cid, info in meta["coordinates"].items():
+        imap = index_maps[info["feature_shard"]]
+        task = TaskType(info["task"])
+        if info["type"] == "fixed":
+            recs = read_records(
+                os.path.join(model_dir, "fixed-effect", cid, "coefficients.avro")
+            )
+            if len(recs) != 1:
+                raise ValueError(f"{cid}: expected 1 model record, got {len(recs)}")
+            gi, gv = _from_nt_list(imap, recs[0]["means"])
+            w = np.zeros(len(imap), np.float64)
+            w[gi] = gv
+            variances = None
+            if recs[0].get("variances"):
+                vi, vv = _from_nt_list(imap, recs[0]["variances"])
+                variances = np.zeros(len(imap), np.float64)
+                variances[vi] = vv
+                variances = jnp.asarray(variances, jnp.float32)
+            glm = GeneralizedLinearModel(
+                Coefficients(
+                    means=jnp.asarray(w, jnp.float32), variances=variances
+                ),
+                task,
+            )
+            models[cid] = FixedEffectModel(glm, info["feature_shard"])
+        elif info["type"] == "random":
+            cdir = os.path.join(model_dir, "random-effect", cid)
+            parts = sorted(
+                os.path.join(cdir, p)
+                for p in os.listdir(cdir)
+                if p.endswith(".avro")
+            )
+            entity_keys, sparse = [], []
+            for part in parts:
+                for rec in read_records(part):
+                    entity_keys.append(rec["modelId"])
+                    sparse.append(_from_nt_list(imap, rec["means"]))
+            models[cid] = _synthetic_random_effect_model(
+                info.get("re_type", cid), task, entity_keys, sparse, len(imap)
+            )
+        else:
+            raise ValueError(f"{cid}: unknown coordinate type {info['type']}")
+    return GameModel(models), meta
+
+
+def _synthetic_random_effect_model(
+    re_type: str,
+    task: TaskType,
+    entity_keys: list,
+    sparse: list,
+    global_dim: int,
+) -> RandomEffectModel:
+    """Pack loaded per-entity sparse vectors into a single padded bucket."""
+    p = max((len(gi) for gi, _ in sparse), default=1)
+    p = max(p, 1)
+    e = max(len(entity_keys), 1)
+    proj = np.full((e, p), global_dim, np.int32)
+    coefs = np.zeros((e, p), np.float32)
+    for lane, (gi, gv) in enumerate(sparse):
+        order = np.argsort(gi)  # projection maps are sorted by global column
+        proj[lane, : len(gi)] = gi[order]
+        coefs[lane, : len(gi)] = gv[order]
+    return RandomEffectModel(
+        re_type=re_type,
+        task=task,
+        bucket_coefs=[jnp.asarray(coefs)],
+        bucket_proj=[jnp.asarray(proj)],
+        bucket_entity_ids=[jnp.arange(e, dtype=jnp.int32)],
+        entity_keys=list(entity_keys),
+        entity_to_slot={i: (0, i) for i in range(len(entity_keys))},
+        global_dim=global_dim,
+    )
+
+
+def save_scores(
+    path: str,
+    scores,
+    uids=None,
+    labels=None,
+) -> None:
+    """Write per-row scores as ScoringResultAvro — reference
+    ⟦ScoreProcessingUtils.saveScoresToHDFS⟧."""
+    scores = np.asarray(scores, np.float64)
+    n = len(scores)
+    uids = [None] * n if uids is None else [str(u) if u else None for u in uids]
+    labels = [None] * n if labels is None else [float(l) for l in labels]
+
+    def recs():
+        for i in range(n):
+            yield {
+                "uid": uids[i],
+                "predictionScore": float(scores[i]),
+                "label": labels[i],
+                "metadataMap": None,
+            }
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    write_container(path, SCORING_RESULT_AVRO, recs())
+
+
+def save_feature_summary(path: str, imap: IndexMap, stats) -> None:
+    """Write per-feature summary — reference ⟦FeatureSummarizationResultAvro⟧
+    output of the driver's feature-summarization stage."""
+    mean = np.asarray(stats.mean)
+    var = np.asarray(stats.variance)
+    mn = np.asarray(stats.min)
+    mx = np.asarray(stats.max)
+    nnz = np.asarray(stats.num_nonzeros)
+
+    def recs():
+        for i in range(len(mean)):
+            name, term = imap.get_feature(i)
+            yield {
+                "featureName": name,
+                "featureTerm": term,
+                "metrics": {
+                    "mean": float(mean[i]),
+                    "variance": float(var[i]),
+                    "min": float(mn[i]),
+                    "max": float(mx[i]),
+                    "numNonzeros": float(nnz[i]),
+                },
+            }
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    write_container(path, FEATURE_SUMMARIZATION_RESULT_AVRO, recs())
