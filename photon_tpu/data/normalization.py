@@ -149,6 +149,93 @@ class NormalizationContext:
         return wrapped
 
 
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class LocalNormalizationContext:
+    """Per-entity-subspace normalization for random effects (vmappable).
+
+    The reference applies one NormalizationContext per feature shard to BOTH
+    the fixed effect and every per-entity random-effect solve. Each entity
+    sees only its projected feature subspace, so the shard-level factors /
+    shifts are gathered through the entity's local→global projection, and the
+    intercept position — which varies per entity — is carried as a one-hot
+    vector instead of a static index so the whole context batches under
+    ``vmap`` (leaves ``[E, P]`` → per-lane ``[P]``).
+
+    Same coefficient-space algebra as ``NormalizationContext`` with the
+    one-hot h replacing indexed updates: w = w'∘f − h·(w'∘f)ᵀs.
+    Ghost slots (projection padding) carry factor 1 / shift 0, so they stay
+    inert. Instances are only built for non-identity shard contexts.
+    """
+
+    factors: Optional[Array]          # [P] (or [E, P] before vmap)
+    shifts: Optional[Array]
+    intercept_onehot: Optional[Array]
+
+    @property
+    def is_identity(self) -> bool:
+        return self.factors is None and self.shifts is None
+
+    def _effective(self) -> tuple[Optional[Array], Optional[Array]]:
+        # Sanitized at construction (project_context); nothing to force.
+        return self.factors, self.shifts
+
+    def coef_to_original(self, w: Array) -> Array:
+        f, s = self.factors, self.shifts
+        out = w if f is None else w * f
+        if s is not None:
+            out = out - self.intercept_onehot * jnp.sum(out * s)
+        return out
+
+    def coef_to_transformed(self, w: Array) -> Array:
+        f, s = self.factors, self.shifts
+        out = w
+        if s is not None:
+            out = out + self.intercept_onehot * jnp.sum(out * s)
+        if f is not None:
+            out = out / f
+        return out
+
+    # Same lifting as NormalizationContext (duck-typed in problem.run).
+    wrap_value_and_grad = NormalizationContext.wrap_value_and_grad
+    wrap_hvp = NormalizationContext.wrap_hvp
+
+
+def project_context(
+    ctx: NormalizationContext,
+    proj: Array,
+    global_dim: int,
+) -> Optional[LocalNormalizationContext]:
+    """Gather a shard-level context into local subspace(s) through ``proj``
+    (``[..., P]`` local→global column map; ghost slots hold ``global_dim``).
+
+    Returns None for identity contexts. The shard context's intercept column
+    (if any) becomes a one-hot over local slots.
+    """
+    if ctx.is_identity:
+        return None
+    f, s = ctx._effective()
+
+    def gather(vec: Optional[Array], ghost_fill: float) -> Optional[Array]:
+        if vec is None:
+            return None
+        ext = jnp.concatenate(
+            [vec, jnp.full((1,), ghost_fill, vec.dtype)]
+        )
+        return ext[proj]
+
+    onehot = None
+    if ctx.intercept_index is not None:
+        onehot = (proj == ctx.intercept_index).astype(
+            f.dtype if f is not None else s.dtype
+        )
+    if s is not None and onehot is None:  # pragma: no cover - ctx invariant
+        raise ValueError("shifts require an intercept (NormalizationContext)")
+    return LocalNormalizationContext(
+        factors=gather(f, 1.0), shifts=gather(s, 0.0), intercept_onehot=onehot
+    )
+
+
 def identity_context(intercept_index: Optional[int] = None) -> NormalizationContext:
     return NormalizationContext(factors=None, shifts=None, intercept_index=intercept_index)
 

@@ -1,0 +1,117 @@
+"""Per-coordinate configuration objects for the GAME estimator.
+
+Parity: reference ⟦photon-api/.../optimization/game/
+CoordinateOptimizationConfiguration.scala, FixedEffectOptimizationConfiguration,
+RandomEffectOptimizationConfiguration, GLMOptimizationConfiguration⟧ and the
+per-coordinate dataset configs ⟦FixedEffectDataConfiguration,
+RandomEffectDataConfiguration⟧ (SURVEY.md §2.2 "Coordinate configs").
+
+The estimator separates *what data a coordinate trains on* (a data config,
+fixed per estimator) from *how it optimizes* (an optimization config, swept
+over by ``GameEstimator.fit`` — the reference's multi-reg-weight sweep).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Mapping, Optional, Sequence, Union
+
+from photon_tpu.functions.problem import (
+    GLMOptimizationProblem,
+    VarianceComputationType,
+)
+from photon_tpu.optim import OptimizerConfig, OptimizerType
+from photon_tpu.optim.regularization import RegularizationContext
+from photon_tpu.types import TaskType
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedEffectDataConfig:
+    """Train one population-level GLM on every row of one feature shard —
+    reference ⟦FixedEffectDataConfiguration(featureShardId, minPartitions)⟧
+    (partition count is meaningless on a mesh and dropped)."""
+
+    feature_shard: str = "global"
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomEffectDataConfig:
+    """Per-entity GLMs grouped by an id column — reference
+    ⟦RandomEffectDataConfiguration(randomEffectType, featureShardId,
+    numActiveDataPointsUpperBound, numActiveDataPointsLowerBound, ...)⟧.
+
+    ``active_bound`` caps rows used for *training* per entity (rows beyond it
+    become passive: scored, not trained on); ``min_entity_rows`` drops
+    entities with too little data (they fall back to the zero model).
+    """
+
+    re_type: str
+    feature_shard: str = "global"
+    active_bound: Optional[int] = None
+    min_entity_rows: int = 1
+
+
+CoordinateDataConfig = Union[FixedEffectDataConfig, RandomEffectDataConfig]
+
+
+@dataclasses.dataclass(frozen=True)
+class GLMOptimizationConfiguration:
+    """One coordinate's optimization recipe — reference
+    ⟦GLMOptimizationConfiguration(optimizerConfig, regularizationContext,
+    regularizationWeight, downSamplingRate)⟧ + variance mode from the
+    coordinate-level config."""
+
+    optimizer_type: OptimizerType = OptimizerType.LBFGS
+    max_iterations: int = 80
+    tolerance: float = 1e-7
+    regularization: RegularizationContext = RegularizationContext()
+    reg_weight: float = 0.0
+    down_sampling_rate: float = 1.0
+    variance_type: VarianceComputationType = VarianceComputationType.NONE
+
+    def __post_init__(self):
+        if not (0.0 < self.down_sampling_rate <= 1.0):
+            raise ValueError(
+                f"down_sampling_rate must be in (0, 1], got {self.down_sampling_rate}"
+            )
+
+    def problem(self, task: TaskType) -> GLMOptimizationProblem:
+        return GLMOptimizationProblem(
+            task=task,
+            optimizer_type=self.optimizer_type,
+            optimizer_config=OptimizerConfig(
+                max_iterations=self.max_iterations, tolerance=self.tolerance
+            ),
+            regularization=self.regularization,
+            reg_weight=self.reg_weight,
+            variance_type=self.variance_type,
+        )
+
+    def with_reg_weight(self, w: float) -> "GLMOptimizationConfiguration":
+        return dataclasses.replace(self, reg_weight=w)
+
+
+# One full GAME optimization configuration: coordinate id -> its opt config.
+GameOptimizationConfiguration = Mapping[str, GLMOptimizationConfiguration]
+
+
+def reg_weight_sweep(
+    base: GameOptimizationConfiguration,
+    reg_weights: Mapping[str, Sequence[float]],
+) -> list[dict[str, GLMOptimizationConfiguration]]:
+    """Expand a base configuration into the cartesian product of per-coordinate
+    regularization weights — how the reference's driver turns
+    ``coordinate-config regularization weights {1, 10, 100}`` flags into the
+    ``Seq[GameOptimizationConfiguration]`` passed to ``GameEstimator.fit``."""
+    for cid in reg_weights:
+        if cid not in base:
+            raise ValueError(f"reg_weights names unknown coordinate {cid!r}")
+    cids = sorted(reg_weights)
+    combos = itertools.product(*(reg_weights[c] for c in cids))
+    out = []
+    for combo in combos:
+        cfg = dict(base)
+        for cid, w in zip(cids, combo):
+            cfg[cid] = cfg[cid].with_reg_weight(w)
+        out.append(cfg)
+    return out

@@ -1,0 +1,377 @@
+"""GameEstimator: end-to-end GAME fit over a configuration sweep.
+
+Parity: reference ⟦photon-api/.../estimators/GameEstimator.scala⟧ (SURVEY.md
+§3.2): DataFrame → per-coordinate datasets (built ONCE, reused across every
+optimization configuration) → for each configuration, coordinate descent →
+``Seq[(GameModel, Option[EvaluationResults], GameOptimizationConfiguration)]``.
+
+TPU-first differences from the reference:
+* per-coordinate datasets are device arrays (fixed-effect ``LabeledBatch``,
+  bucketed ``RandomEffectDataset``) in one fixed global row order — the
+  reference's GameDatum RDD partitioning/persist bookkeeping disappears;
+* validation scoring per coordinate is a closure over pre-built validation
+  structures, so coordinate descent's per-step evaluation does no joins;
+* normalization contexts are computed from on-device feature statistics
+  (one ``sq_rmatvec`` pass) instead of a Spark summarizer job.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Mapping, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.data.batch import LabeledBatch
+from photon_tpu.data.normalization import (
+    NormalizationContext,
+    NormalizationType,
+    context_from_statistics,
+)
+from photon_tpu.data.random_effect import (
+    RandomEffectDataset,
+    build_random_effect_dataset,
+)
+from photon_tpu.data.sampling import down_sampler_for_task
+from photon_tpu.data.statistics import compute_feature_statistics
+from photon_tpu.estimators.config import (
+    CoordinateDataConfig,
+    FixedEffectDataConfig,
+    GameOptimizationConfiguration,
+    GLMOptimizationConfiguration,
+    RandomEffectDataConfig,
+)
+from photon_tpu.evaluation import EvaluationResults, EvaluationSuite
+from photon_tpu.functions.objective import intercept_reg_mask
+from photon_tpu.game.coordinates import (
+    Coordinate,
+    FixedEffectCoordinate,
+    RandomEffectCoordinate,
+)
+from photon_tpu.game.descent import (
+    CoordinateDescent,
+    CoordinateStepRecord,
+    GameModel,
+    ValidationData,
+)
+from photon_tpu.io.data_reader import GameDataBundle
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+logger = logging.getLogger("photon_tpu.estimators")
+
+
+@dataclasses.dataclass(frozen=True)
+class GameFitResult:
+    """One entry of the estimator's output sequence — reference
+    ⟦(GameModel, Option[EvaluationResults], GameOptimizationConfiguration)⟧
+    plus the per-step tracker."""
+
+    model: GameModel
+    evaluation: Optional[EvaluationResults]
+    config: GameOptimizationConfiguration
+    tracker: Sequence[CoordinateStepRecord]
+
+
+def build_re_dataset_from_bundle(
+    bundle: GameDataBundle,
+    cfg: RandomEffectDataConfig,
+    intercept_index: Optional[int] = None,
+    for_scoring: bool = False,
+) -> RandomEffectDataset:
+    """Group a bundle's rows by ``cfg.re_type`` into a bucketed per-entity
+    dataset. For scoring/validation datasets every entity is kept (rows of
+    entities unseen at training time score 0 — the reference's zero-model
+    fallback) and no active/passive split applies."""
+    sf = bundle.features[cfg.feature_shard]
+    if cfg.re_type not in bundle.id_tags:
+        raise ValueError(
+            f"random effect {cfg.re_type!r} needs id tag column "
+            f"{cfg.re_type!r}; bundle has {sorted(bundle.id_tags)}"
+        )
+    return build_random_effect_dataset(
+        re_type=cfg.re_type,
+        entity_keys_per_row=bundle.id_tags[cfg.re_type],
+        idx=np.asarray(jax.device_get(sf.idx)),
+        val=np.asarray(jax.device_get(sf.val)),
+        labels=bundle.labels,
+        global_dim=sf.dim,
+        weights=bundle.weights,
+        active_bound=None if for_scoring else cfg.active_bound,
+        min_entity_rows=1 if for_scoring else cfg.min_entity_rows,
+        intercept_index=intercept_index,
+    )
+
+
+def _factorize_group_ids(values: np.ndarray) -> tuple[Array, int]:
+    keys, inv = np.unique(values, return_inverse=True)
+    return jnp.asarray(inv.astype(np.int32)), len(keys)
+
+
+@dataclasses.dataclass
+class GameEstimator:
+    """Configured GAME trainer; ``fit`` runs the configuration sweep.
+
+    ``coordinate_data_configs`` fixes each coordinate's dataset; the
+    ``update_sequence`` (default: insertion order) and sweep count mirror the
+    reference params ⟦coordinateUpdateSequence, coordinateDescentIterations⟧.
+    ``intercept_indices`` (shard → column) excludes intercepts from
+    regularization and anchors normalization shifts, as the reference derives
+    from its index maps.
+    """
+
+    task: TaskType
+    coordinate_data_configs: Mapping[str, CoordinateDataConfig]
+    update_sequence: Optional[Sequence[str]] = None
+    n_sweeps: int = 1
+    evaluator_specs: Sequence[str] = ()
+    normalization: NormalizationType = NormalizationType.NONE
+    intercept_indices: Optional[Mapping[str, int]] = None
+    mesh: Optional[object] = None
+    data_axis: str = "data"
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.update_sequence is None:
+            self.update_sequence = tuple(self.coordinate_data_configs)
+        for cid in self.update_sequence:
+            if cid not in self.coordinate_data_configs:
+                raise ValueError(
+                    f"update sequence names unknown coordinate {cid!r}"
+                )
+
+    # ------------------------------------------------------------------ fit
+
+    def fit(
+        self,
+        data: GameDataBundle,
+        validation_data: Optional[GameDataBundle] = None,
+        configs: Sequence[GameOptimizationConfiguration] = (),
+        initial_model: Optional[GameModel] = None,
+    ) -> list[GameFitResult]:
+        """Train one GameModel per optimization configuration.
+
+        Datasets, normalization contexts, and validation structures are built
+        once and shared across the sweep (reference: datasets persist across
+        the config loop and unpersist after). ``initial_model`` warm-starts
+        every configuration (reference ⟦modelInputDirectory⟧).
+        """
+        if not configs:
+            raise ValueError("at least one GameOptimizationConfiguration required")
+        for cfg in configs:
+            missing = [c for c in self.update_sequence if c not in cfg]
+            if missing:
+                raise ValueError(f"configuration missing coordinates {missing}")
+
+        suite = (
+            EvaluationSuite.parse(self.evaluator_specs)
+            if self.evaluator_specs
+            else None
+        )
+        if validation_data is not None and suite is None:
+            raise ValueError("validation data provided but no evaluator_specs")
+
+        prep = self._prepare(data)
+        validation = (
+            self._prepare_validation(validation_data, prep, suite)
+            if validation_data is not None
+            else None
+        )
+
+        results: list[GameFitResult] = []
+        for i, cfg in enumerate(configs):
+            logger.info("=== configuration %d/%d ===", i + 1, len(configs))
+            coordinates = self._build_coordinates(prep, cfg, config_index=i)
+            descent = CoordinateDescent(
+                update_sequence=tuple(self.update_sequence),
+                n_sweeps=self.n_sweeps,
+            )
+            model, tracker = descent.run(
+                coordinates,
+                n_rows=data.n_rows,
+                base_offsets=jnp.asarray(data.offsets, jnp.float32),
+                validation=validation,
+                suite=suite,
+                initial_models=dict(initial_model.models) if initial_model else None,
+            )
+            evaluation = (
+                self._evaluate(model, validation, suite)
+                if validation is not None
+                else None
+            )
+            results.append(GameFitResult(model, evaluation, cfg, tracker))
+        return results
+
+    # ----------------------------------------------------------- internals
+
+    def _intercept_for(self, shard: str) -> Optional[int]:
+        if self.intercept_indices is None:
+            return None
+        return self.intercept_indices.get(shard)
+
+    def _prepare(self, data: GameDataBundle) -> dict:
+        """Build per-coordinate datasets + per-shard normalization ONCE."""
+        prep: dict = {"train": {}, "norm": {}, "batches": {}}
+        shards_used = {
+            c.feature_shard for c in self.coordinate_data_configs.values()
+        }
+        for shard in sorted(shards_used):
+            batch = data.batch(shard)
+            prep["batches"][shard] = batch
+            if self.normalization != NormalizationType.NONE:
+                stats = compute_feature_statistics(batch)
+                prep["norm"][shard] = context_from_statistics(
+                    stats, self.normalization, self._intercept_for(shard)
+                )
+            else:
+                prep["norm"][shard] = None
+
+        for cid, dcfg in self.coordinate_data_configs.items():
+            if isinstance(dcfg, FixedEffectDataConfig):
+                prep["train"][cid] = prep["batches"][dcfg.feature_shard]
+            elif isinstance(dcfg, RandomEffectDataConfig):
+                prep["train"][cid] = build_re_dataset_from_bundle(
+                    data, dcfg, self._intercept_for(dcfg.feature_shard)
+                )
+            else:  # pragma: no cover - union is closed
+                raise TypeError(f"unknown data config {type(dcfg)}")
+        return prep
+
+    def _build_coordinates(
+        self,
+        prep: dict,
+        cfg: GameOptimizationConfiguration,
+        config_index: int,
+    ) -> dict[str, Coordinate]:
+        # Coordinates are built for EVERY data config, not just the update
+        # sequence: coordinates outside the sequence are scoring-only (locked
+        # warm-start models — reference partial retraining) and use a default
+        # problem that never runs.
+        coordinates: dict[str, Coordinate] = {}
+        for cid in self.coordinate_data_configs:
+            dcfg = self.coordinate_data_configs[cid]
+            ocfg = cfg.get(cid, GLMOptimizationConfiguration())
+            problem = ocfg.problem(self.task)
+            intercept = self._intercept_for(dcfg.feature_shard)
+
+            if isinstance(dcfg, FixedEffectDataConfig):
+                batch: LabeledBatch = prep["train"][cid]
+                mask = intercept_reg_mask(batch.dim, intercept)
+                if mask is not None:
+                    problem = dataclasses.replace(problem, reg_mask=mask)
+                if ocfg.down_sampling_rate < 1.0:
+                    # Per-(config, coordinate) derived key, reproducible.
+                    key = jax.random.fold_in(
+                        jax.random.fold_in(
+                            jax.random.PRNGKey(self.seed), config_index
+                        ),
+                        len(coordinates),
+                    )
+                    sampler = down_sampler_for_task(
+                        self.task, ocfg.down_sampling_rate
+                    )
+                    batch = sampler.down_sample(key, batch)
+                coordinates[cid] = FixedEffectCoordinate(
+                    batch=batch,
+                    problem=problem,
+                    feature_shard=dcfg.feature_shard,
+                    mesh=self.mesh,
+                    data_axis=self.data_axis,
+                    normalization=prep["norm"][dcfg.feature_shard],
+                )
+            else:
+                mask = intercept_reg_mask(
+                    prep["train"][cid].global_dim, intercept
+                )
+                coordinates[cid] = RandomEffectCoordinate(
+                    dataset=prep["train"][cid],
+                    problem=problem,
+                    mesh=self.mesh,
+                    entity_axis=self.data_axis,
+                    global_reg_mask=mask,
+                    normalization=prep["norm"][dcfg.feature_shard],
+                )
+        return coordinates
+
+    def _prepare_validation(
+        self,
+        vdata: GameDataBundle,
+        prep: dict,
+        suite: EvaluationSuite,
+    ) -> ValidationData:
+        """Validation rows + per-coordinate scorers + grouped-eval ids."""
+        v_batches = {
+            s: vdata.batch(s)
+            for s in {c.feature_shard for c in self.coordinate_data_configs.values()}
+        }
+        scorers: dict = {}
+        for cid, dcfg in self.coordinate_data_configs.items():
+            if isinstance(dcfg, FixedEffectDataConfig):
+                vb = v_batches[dcfg.feature_shard]
+                scorers[cid] = lambda m, vb=vb: m.score_batch(vb)
+            else:
+                v_ds = build_re_dataset_from_bundle(
+                    vdata,
+                    dcfg,
+                    self._intercept_for(dcfg.feature_shard),
+                    for_scoring=True,
+                )
+                scorers[cid] = lambda m, v_ds=v_ds: m.score_new_dataset(v_ds)
+
+        group_cols = {
+            ev.group_column
+            for ev in suite.evaluators
+            if ev.group_column is not None
+        }
+        gids, ngroups = {}, {}
+        for col in group_cols:
+            if col not in vdata.id_tags:
+                raise ValueError(
+                    f"grouped evaluator needs id tag column {col!r} in "
+                    f"validation data; bundle has {sorted(vdata.id_tags)}"
+                )
+            gids[col], ngroups[col] = _factorize_group_ids(vdata.id_tags[col])
+
+        return ValidationData(
+            labels=jnp.asarray(vdata.labels, jnp.float32),
+            weights=jnp.asarray(vdata.weights, jnp.float32),
+            offsets=jnp.asarray(vdata.offsets, jnp.float32),
+            scorers=scorers,
+            group_ids_by_column=gids or None,
+            num_groups_by_column=ngroups or None,
+        )
+
+    def _evaluate(
+        self,
+        model: GameModel,
+        validation: ValidationData,
+        suite: EvaluationSuite,
+    ) -> EvaluationResults:
+        scores = validation.offsets + sum(
+            validation.scorers[cid](model[cid]) for cid in model.keys()
+        )
+        return suite.evaluate(
+            scores,
+            validation.labels,
+            validation.weights,
+            validation.group_ids_by_column,
+            validation.num_groups_by_column,
+        )
+
+
+def select_best(
+    results: Sequence[GameFitResult], suite: EvaluationSuite
+) -> GameFitResult:
+    """Pick the configuration whose final validation primary metric is best —
+    the reference driver's model-selection step (SURVEY.md §3.1)."""
+    scored = [r for r in results if r.evaluation is not None]
+    if not scored:
+        return results[0]
+    best = scored[0]
+    for r in scored[1:]:
+        if suite.primary.better_than(r.evaluation.primary, best.evaluation.primary):
+            best = r
+    return best

@@ -1,0 +1,103 @@
+"""GameTransformer: score a dataset with a trained GameModel.
+
+Parity: reference ⟦photon-api/.../transformers/GameTransformer.scala⟧
+(SURVEY.md §2.2, §3.6): per coordinate, score the data and sum additively;
+rows whose entity was unseen at training fall back to the zero model; optional
+evaluation when the data carries labels.
+
+TPU-first: fixed-effect scoring is one sparse matvec on the whole batch
+(replication over the mesh replaces the coefficient broadcast); random-effect
+scoring projects trained per-entity coefficients into the scoring dataset's
+bucket structure host-side — the reference's model-RDD join by REId — then
+scores each bucket with one vmapped gather-dot.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.estimators.config import (
+    CoordinateDataConfig,
+    FixedEffectDataConfig,
+    RandomEffectDataConfig,
+)
+from photon_tpu.estimators.game_estimator import (
+    _factorize_group_ids,
+    build_re_dataset_from_bundle,
+)
+from photon_tpu.evaluation import EvaluationResults, EvaluationSuite
+from photon_tpu.game.coordinates import FixedEffectModel
+from photon_tpu.game.descent import GameModel
+from photon_tpu.game.random_effect import RandomEffectModel
+from photon_tpu.io.data_reader import GameDataBundle
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class GameTransformer:
+    """Bind a trained model to the per-coordinate data configs it was
+    trained with (shard names + entity columns)."""
+
+    model: GameModel
+    coordinate_data_configs: Mapping[str, CoordinateDataConfig]
+    intercept_indices: Optional[Mapping[str, int]] = None
+
+    def _intercept_for(self, shard: str) -> Optional[int]:
+        if self.intercept_indices is None:
+            return None
+        return self.intercept_indices.get(shard)
+
+    def transform(self, data: GameDataBundle) -> Array:
+        """Total additive score per row: offsets + Σ coordinate scores."""
+        total = jnp.asarray(data.offsets, jnp.float32)
+        for cid in self.model.keys():
+            dcfg = self.coordinate_data_configs.get(cid)
+            if dcfg is None:
+                raise ValueError(
+                    f"model coordinate {cid!r} has no data config; "
+                    f"configs cover {sorted(self.coordinate_data_configs)}"
+                )
+            m = self.model[cid]
+            if isinstance(dcfg, FixedEffectDataConfig):
+                if not isinstance(m, FixedEffectModel):
+                    raise TypeError(f"{cid!r}: fixed-effect config, {type(m)} model")
+                total = total + m.score_batch(data.batch(dcfg.feature_shard))
+            elif isinstance(dcfg, RandomEffectDataConfig):
+                if not isinstance(m, RandomEffectModel):
+                    raise TypeError(f"{cid!r}: random-effect config, {type(m)} model")
+                ds = build_re_dataset_from_bundle(
+                    data, dcfg,
+                    self._intercept_for(dcfg.feature_shard),
+                    for_scoring=True,
+                )
+                total = total + m.score_new_dataset(ds)
+            else:  # pragma: no cover - union is closed
+                raise TypeError(f"unknown data config {type(dcfg)}")
+        return total
+
+    def transform_and_evaluate(
+        self, data: GameDataBundle, suite: EvaluationSuite
+    ) -> tuple[Array, EvaluationResults]:
+        """Score + evaluate (reference: GameScoringDriver's optional
+        evaluator list over the scored data)."""
+        scores = self.transform(data)
+        group_cols = {
+            ev.group_column for ev in suite.evaluators if ev.group_column
+        }
+        gids, ngroups = {}, {}
+        for col in group_cols:
+            if col not in data.id_tags:
+                raise ValueError(f"grouped evaluator needs id column {col!r}")
+            gids[col], ngroups[col] = _factorize_group_ids(data.id_tags[col])
+        results = suite.evaluate(
+            scores,
+            jnp.asarray(data.labels, jnp.float32),
+            jnp.asarray(data.weights, jnp.float32),
+            gids or None,
+            ngroups or None,
+        )
+        return scores, results

@@ -53,6 +53,7 @@ class FixedEffectCoordinate:
     feature_shard: str = "global"
     mesh: Optional[object] = None
     data_axis: str = "data"
+    normalization: Optional[object] = None   # NormalizationContext or None
 
     def train(self, offsets: Array, init: Optional[FixedEffectModel] = None):
         batch = self.batch.with_offsets(offsets.astype(self.batch.labels.dtype))
@@ -62,10 +63,11 @@ class FixedEffectCoordinate:
             w0 = jnp.zeros((batch.dim,), batch.labels.dtype)
         if self.mesh is not None:
             model, result = fit_data_parallel(
-                self.problem, batch, w0, self.mesh, self.data_axis
+                self.problem, batch, w0, self.mesh, self.data_axis,
+                normalization=self.normalization,
             )
         else:
-            model, result = self.problem.fit(batch, w0)
+            model, result = self.problem.fit(batch, w0, normalization=self.normalization)
         return FixedEffectModel(model, self.feature_shard), result
 
     def score(self, model: FixedEffectModel) -> Array:
@@ -81,16 +83,31 @@ class RandomEffectCoordinate:
     mesh: Optional[object] = None
     entity_axis: str = "data"
     global_reg_mask: Optional[Array] = None
+    normalization: Optional[object] = None   # shard-level NormalizationContext
+
+    def _init_coefs(self, init: Optional[RandomEffectModel]):
+        if init is None:
+            return None
+        # Fast path: a model trained on THIS dataset (every coordinate-descent
+        # sweep) shares bucket structure by object identity. Anything else —
+        # a loaded model, a model from different data — must be re-projected
+        # into this dataset's bucket/subspace structure.
+        same = (
+            len(init.bucket_coefs) == len(self.dataset.buckets)
+            and all(
+                p is b.proj
+                for p, b in zip(init.bucket_proj, self.dataset.buckets)
+            )
+        )
+        return init.bucket_coefs if same else init.project_to(self.dataset)
 
     def train(self, offsets: Array, init: Optional[RandomEffectModel] = None):
-        # Warm start is structural: same dataset -> same buckets, so the
-        # previous coefficient stacks are valid initial points.
-        init_coefs = init.bucket_coefs if init is not None else None
         return train_random_effects(
             self.problem, self.dataset, offsets,
             mesh=self.mesh, entity_axis=self.entity_axis,
             global_reg_mask=self.global_reg_mask,
-            init_coefs=init_coefs,
+            init_coefs=self._init_coefs(init),
+            normalization=self.normalization,
         )
 
     def score(self, model: RandomEffectModel) -> Array:

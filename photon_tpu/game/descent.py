@@ -99,12 +99,30 @@ class CoordinateDescent:
 
         models: dict[str, DatumScoringModel] = dict(initial_models or {})
         scores: dict[str, Array] = {}
-        # Initial scores from warm-start models, else zero.
+        # Initial scores from warm-start models, else zero. Models OUTSIDE the
+        # update sequence are "locked" coordinates (reference partial
+        # retraining): scored so residuals are right, never retrained, kept in
+        # the output model.
         for cid in self.update_sequence:
             if cid in models:
                 scores[cid] = coordinates[cid].score(models[cid])
             else:
                 scores[cid] = jnp.zeros((n_rows,), base.dtype)
+        for cid in sorted(set(models) - set(self.update_sequence)):
+            if cid not in coordinates:
+                raise ValueError(
+                    f"initial model {cid!r} is outside the update sequence "
+                    "and has no coordinate to score it (locked coordinates "
+                    "need a coordinate for residual bookkeeping)"
+                )
+            scores[cid] = coordinates[cid].score(models[cid])
+        if validation is not None:
+            need = set(self.update_sequence) | set(models)
+            missing = sorted(c for c in need if c not in validation.scorers)
+            if missing:
+                raise ValueError(
+                    f"validation scorers missing for coordinates {missing}"
+                )
         total = base + sum(scores.values())
 
         tracker: list[CoordinateStepRecord] = []
@@ -143,8 +161,13 @@ class CoordinateDescent:
                         validation.num_groups_by_column,
                     )
                     primary = record.validation.primary
-                    if best_metric is None or suite.primary.better_than(
-                        primary, best_metric
+                    # Only a complete model (every coordinate trained at least
+                    # once) is eligible for best-model tracking — a partial
+                    # GameModel would break scoring downstream.
+                    complete = all(c in models for c in self.update_sequence)
+                    if complete and (
+                        best_metric is None
+                        or suite.primary.better_than(primary, best_metric)
                     ):
                         best_metric = primary
                         best_models = dict(models)

@@ -151,12 +151,15 @@ def _pad_bucket(
 
 
 @partial(jax.jit, static_argnums=0)
-def _fit_bucket_jitted(problem, batches, w0, local_mask):
+def _fit_bucket_jitted(problem, batches, w0, local_mask, local_norm):
     """One vmapped bucket solve; static problem key keeps the XLA executable
-    cached across coordinate-descent sweeps (same config + bucket shapes)."""
+    cached across coordinate-descent sweeps (same config + bucket shapes).
+    ``local_norm`` is a per-entity LocalNormalizationContext pytree (leaves
+    [E, P]) or None."""
     return jax.vmap(
-        lambda b, w, m: problem.run(b, w, reg_mask=m), in_axes=(0, 0, 0)
-    )(batches, w0, local_mask)
+        lambda b, w, m, nm: problem.run(b, w, reg_mask=m, normalization=nm),
+        in_axes=(0, 0, 0, 0),
+    )(batches, w0, local_mask, local_norm)
 
 
 def train_random_effects(
@@ -167,14 +170,19 @@ def train_random_effects(
     entity_axis: str = "data",
     global_reg_mask: Optional[Array] = None,
     init_coefs: Optional[Sequence[Array]] = None,
+    normalization=None,
 ) -> tuple[RandomEffectModel, list[OptimizerResult]]:
     """Fit one GLM per entity; returns the model + per-bucket solver results.
 
     ``offsets`` is the global per-sample residual score from the other GAME
     coordinates (reference: dataset offsets updated by CoordinateDescent).
     ``global_reg_mask`` (e.g. 0 on the intercept column) is projected into
-    each entity's local subspace.
+    each entity's local subspace, as is the shard-level ``normalization``
+    context (reference: one NormalizationContext per feature shard applies to
+    every per-entity solve too).
     """
+    from photon_tpu.data.normalization import project_context
+
     coefs_out, var_out, results = [], [], []
     want_var = problem.variance_type.name != "NONE"
 
@@ -204,6 +212,11 @@ def train_random_effects(
             local_mask = jnp.ones((e, p), bucket.val.dtype)
 
         batches = bucket.local_batches(offsets)
+        local_norm = (
+            project_context(normalization, bucket.proj, dataset.global_dim)
+            if normalization is not None
+            else None
+        )
 
         if mesh is not None:
             shard = lambda leaf: jax.device_put(
@@ -212,8 +225,9 @@ def train_random_effects(
             batches = jax.tree.map(shard, batches)
             w0 = shard(w0)
             local_mask = shard(local_mask)
+            local_norm = jax.tree.map(shard, local_norm)
 
-        models, result = _fit_bucket_jitted(problem, batches, w0, local_mask)
+        models, result = _fit_bucket_jitted(problem, batches, w0, local_mask, local_norm)
         coefs_out.append(models.coefficients.means[:orig_e])
         if want_var:
             var_out.append(models.coefficients.variances[:orig_e])

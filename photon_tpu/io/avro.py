@@ -357,50 +357,72 @@ def write_container(
     return n_written
 
 
+def _stream_varint(f, first: bytes) -> int:
+    # varint (non-zigzag framing handled by _read_long) from the raw
+    # stream; EOF mid-varint means a truncated container, not a spin.
+    buf = bytearray(first)
+    while buf[-1] & 0x80:
+        b = f.read(1)
+        if not b:
+            raise SchemaError("truncated avro container (EOF mid-varint)")
+        buf += b
+    v, _ = _read_long(memoryview(bytes(buf)), 0)
+    return v
+
+
 def read_container(path: str) -> tuple[Schema, Iterator[Any]]:
-    """Read an Avro object container file → (writer schema, record iterator)."""
-    f = open(path, "rb")
-    if f.read(4) != MAGIC:
-        f.close()
-        raise SchemaError(f"{path}: not an Avro object container file")
-    # Decode the metadata map incrementally from the head of the file.
-    head = f.read(1 << 16)
-    mdec = Decoder({"type": "map", "values": "bytes"})
-    while True:
-        try:
-            meta, pos = mdec.decode(head)
-            break
-        except IndexError:  # metadata longer than the head buffer
-            more = f.read(1 << 16)
-            if not more:
-                f.close()
-                raise SchemaError(f"{path}: truncated container header") from None
-            head += more
-    schema = json.loads(meta["avro.schema"])
-    codec = meta.get("avro.codec", b"null").decode()
-    if codec not in ("null", "deflate"):
-        f.close()
-        raise SchemaError(f"unsupported codec {codec!r}")
-    f.seek(4 + pos)
-    sync = f.read(SYNC_SIZE)
+    """Read an Avro object container file → (writer schema, record iterator).
+
+    The header is parsed eagerly under its own file handle (schema-only
+    callers leak nothing); the returned iterator opens the file again when
+    first advanced.
+    """
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise SchemaError(f"{path}: not an Avro object container file")
+        # Decode the metadata map incrementally from the head of the file.
+        head = f.read(1 << 16)
+        mdec = Decoder({"type": "map", "values": "bytes"})
+        while True:
+            try:
+                meta, pos = mdec.decode(head)
+                break
+            except IndexError:  # metadata longer than the head buffer
+                more = f.read(1 << 16)
+                if not more:
+                    raise SchemaError(f"{path}: truncated container header") from None
+                head += more
+        if "avro.schema" not in meta:
+            raise SchemaError(f"{path}: container header missing avro.schema")
+        schema = json.loads(meta["avro.schema"])
+        codec = meta.get("avro.codec", b"null").decode()
+        if codec not in ("null", "deflate"):
+            raise SchemaError(f"unsupported codec {codec!r}")
+        f.seek(4 + pos)
+        sync = f.read(SYNC_SIZE)
+        data_start = 4 + pos + SYNC_SIZE
     dec = Decoder(schema)
 
     def records() -> Iterator[Any]:
-        with f:
+        with open(path, "rb") as f:
+            f.seek(data_start)
             while True:
                 hdr = f.read(1)
                 if not hdr:
                     return
-                # varint record count (non-zigzag read needs the raw stream)
-                buf = bytearray(hdr)
-                while buf[-1] & 0x80:
-                    buf += f.read(1)
-                count, _ = _read_long(memoryview(bytes(buf)), 0)
-                buf = bytearray(f.read(1))
-                while buf[-1] & 0x80:
-                    buf += f.read(1)
-                size, _ = _read_long(memoryview(bytes(buf)), 0)
+                count = _stream_varint(f, hdr)
+                hdr = f.read(1)
+                if not hdr:
+                    raise SchemaError(
+                        "truncated avro container (EOF before block size)"
+                    )
+                size = _stream_varint(f, hdr)
                 payload = f.read(size)
+                if len(payload) < size:
+                    raise SchemaError(
+                        f"{path}: truncated avro container (block payload "
+                        f"{len(payload)} < {size} bytes)"
+                    )
                 if codec == "deflate":
                     payload = zlib.decompress(payload, wbits=-15)
                 mv = memoryview(payload)

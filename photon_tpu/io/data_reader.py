@@ -117,7 +117,8 @@ class AvroDataReader:
     ):
         self.index_maps = dict(index_maps)
         self.shard_configs = dict(shard_configs) if shard_configs else {
-            s: FeatureShardConfig() for s in self.index_maps
+            s: FeatureShardConfig(feature_bags=(columns.features,))
+            for s in self.index_maps
         }
         if set(self.shard_configs) != set(self.index_maps):
             raise ValueError(
@@ -132,9 +133,24 @@ class AvroDataReader:
         labels, offsets, weights, uids = [], [], [], []
         tags: dict[str, list] = {t: [] for t in self.id_tag_columns}
         shard_rows: dict[str, list] = {s: [] for s in self.index_maps}
+        # An explicitly configured response column is authoritative; the
+        # conventional aliases only apply to the default configuration
+        # (falling back from a custom name could silently read wrong labels).
+        if cols.response in cols.response_aliases:
+            response_cols = (cols.response,) + tuple(
+                a for a in cols.response_aliases if a != cols.response
+            )
+        else:
+            response_cols = (cols.response,)
+        # Intercept indices are per-shard invariants; don't look them up per row.
+        intercepts = {
+            shard: self.index_maps[shard].get_index(INTERCEPT_NAME, INTERCEPT_TERM)
+            for shard, cfg in self.shard_configs.items()
+            if cfg.add_intercept
+        }
 
         for rec in _iter_records(_expand_paths(paths)):
-            labels.append(_first(rec, cols.response_aliases, required=True))
+            labels.append(_first(rec, response_cols, required=True))
             offsets.append(rec.get(cols.offset) or 0.0)
             w = rec.get(cols.weight)
             weights.append(1.0 if w is None else w)
@@ -154,7 +170,7 @@ class AvroDataReader:
                 imap = self.index_maps[shard]
                 idxs, vals = [], []
                 if cfg.add_intercept:
-                    ii = imap.get_index(INTERCEPT_NAME, INTERCEPT_TERM)
+                    ii = intercepts[shard]
                     if ii >= 0:
                         idxs.append(ii)
                         vals.append(1.0)

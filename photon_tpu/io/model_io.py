@@ -249,8 +249,12 @@ def save_scores(
     ⟦ScoreProcessingUtils.saveScoresToHDFS⟧."""
     scores = np.asarray(scores, np.float64)
     n = len(scores)
-    uids = [None] * n if uids is None else [str(u) if u else None for u in uids]
-    labels = [None] * n if labels is None else [float(l) for l in labels]
+    uids = [None] * n if uids is None else [None if u is None else str(u) for u in uids]
+    labels = (
+        [None] * n
+        if labels is None
+        else [None if l is None else float(l) for l in labels]
+    )
 
     def recs():
         for i in range(n):

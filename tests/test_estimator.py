@@ -1,0 +1,213 @@
+"""GameEstimator / GameTransformer API layer (SURVEY.md §3.2, §2.2 L6).
+
+Mirrors the reference's ⟦GameEstimatorIntegTest⟧ tier: fit over a sweep of
+optimization configurations on synthetic GLMix data, validate per-config
+evaluation results, model selection, and the transformer scoring path.
+"""
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.batch import ell_from_rows
+from photon_tpu.data.normalization import NormalizationType
+from photon_tpu.estimators import (
+    FixedEffectDataConfig,
+    GLMOptimizationConfiguration,
+    GameEstimator,
+    GameTransformer,
+    RandomEffectDataConfig,
+    reg_weight_sweep,
+    select_best,
+)
+from photon_tpu.evaluation import EvaluationSuite
+from photon_tpu.io.data_reader import GameDataBundle
+from photon_tpu.optim import RegularizationContext, RegularizationType
+from photon_tpu.types import TaskType
+
+L2 = RegularizationContext(RegularizationType.L2)
+
+
+def _bundle(rng, n_users=10, rows_per_user=24, d_global=6, d_user=4, seed_shift=0):
+    """Synthetic GLMix bundle: 'global' shard for the fixed effect, 'user'
+    shard (block per user) for the per-user random effect."""
+    n = n_users * rows_per_user
+    dim_u = n_users * d_user
+    r2 = np.random.default_rng(1234 + seed_shift)
+    truth = np.random.default_rng(999)  # same ground truth for every bundle
+    w_global = truth.normal(size=d_global)
+    w_users = truth.normal(size=(n_users, d_user)) * 1.5
+
+    users = np.repeat(np.arange(n_users), rows_per_user)
+    perm = r2.permutation(n)
+    users = users[perm]
+
+    g_rows, u_rows = [], []
+    z = np.zeros(n)
+    for i in range(n):
+        xg = r2.normal(size=d_global)
+        xu = r2.normal(size=d_user)
+        u = users[i]
+        g_rows.append((np.arange(d_global), xg))
+        u_rows.append((u * d_user + np.arange(d_user), xu))
+        z[i] = xg @ w_global + xu @ w_users[u]
+    y = (r2.random(n) < 1 / (1 + np.exp(-z))).astype(np.float64)
+
+    return GameDataBundle(
+        features={
+            "global": ell_from_rows(g_rows, d_global),
+            "user": ell_from_rows(u_rows, dim_u),
+        },
+        labels=y,
+        offsets=np.zeros(n),
+        weights=np.ones(n),
+        uids=np.asarray([str(i) for i in range(n)], object),
+        id_tags={"userId": np.asarray([f"u{u}" for u in users], object)},
+    )
+
+
+@pytest.fixture(scope="module")
+def bundles():
+    rng = np.random.default_rng(42)
+    return _bundle(rng), _bundle(rng, seed_shift=1)
+
+
+def _estimator(**kw):
+    defaults = dict(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={
+            "fixed": FixedEffectDataConfig(feature_shard="global"),
+            "perUser": RandomEffectDataConfig(re_type="userId", feature_shard="user"),
+        },
+        n_sweeps=2,
+        evaluator_specs=("AUC", "LOGISTIC_LOSS"),
+    )
+    defaults.update(kw)
+    return GameEstimator(**defaults)
+
+
+BASE = {
+    "fixed": GLMOptimizationConfiguration(
+        max_iterations=40, regularization=L2, reg_weight=1.0),
+    "perUser": GLMOptimizationConfiguration(
+        max_iterations=40, regularization=L2, reg_weight=2.0),
+}
+
+
+def test_fit_sweep_and_model_selection(bundles):
+    train, val = bundles
+    est = _estimator()
+    configs = reg_weight_sweep(BASE, {"fixed": [0.1, 1000.0]})
+    results = est.fit(train, val, configs)
+
+    assert len(results) == 2
+    for r in results:
+        assert r.evaluation is not None
+        assert set(r.model.keys()) == {"fixed", "perUser"}
+        assert len(r.tracker) == 2 * 2  # sweeps x coordinates
+    suite = EvaluationSuite.parse(est.evaluator_specs)
+    best = select_best(results, suite)
+    # Extreme regularization must not win model selection.
+    assert best.config["fixed"].reg_weight == 0.1
+    assert best.evaluation.values["AUC"] > 0.6
+
+
+def test_transformer_matches_estimator_evaluation(bundles):
+    train, val = bundles
+    est = _estimator()
+    results = est.fit(train, val, [BASE])
+    r = results[0]
+
+    tf = GameTransformer(r.model, est.coordinate_data_configs)
+    scores, ev = tf.transform_and_evaluate(
+        val, EvaluationSuite.parse(est.evaluator_specs)
+    )
+    assert scores.shape == (val.n_rows,)
+    for k, v in r.evaluation.values.items():
+        assert ev.values[k] == pytest.approx(v, rel=1e-6), k
+
+
+def test_grouped_evaluators_through_estimator(bundles):
+    train, val = bundles
+    est = _estimator(evaluator_specs=("AUC", "AUC:userId", "PRECISION@5:userId"))
+    results = est.fit(train, val, [BASE])
+    ev = results[0].evaluation
+    assert set(ev.values) == {"AUC", "AUC:userId", "PRECISION@5:userId"}
+    assert 0.0 <= ev.values["PRECISION@5:userId"] <= 1.0
+
+
+def test_normalization_and_downsampling_paths(bundles):
+    train, val = bundles
+    est = _estimator(normalization=NormalizationType.SCALE_WITH_STANDARD_DEVIATION)
+    cfg = {
+        "fixed": dataclasses.replace(BASE["fixed"], down_sampling_rate=0.8),
+        "perUser": BASE["perUser"],
+    }
+    results = est.fit(train, val, [cfg])
+    assert results[0].evaluation.values["AUC"] > 0.55
+
+
+def test_warm_start_initial_model(bundles):
+    train, val = bundles
+    est = _estimator(n_sweeps=1)
+    first = est.fit(train, val, [BASE])[0]
+    warm = est.fit(train, val, [BASE], initial_model=first.model)[0]
+    # Warm-started fit must not be worse than cold on the primary metric
+    # beyond noise (it starts at the cold solution).
+    assert warm.evaluation.values["AUC"] >= first.evaluation.values["AUC"] - 0.02
+
+
+def test_random_effects_add_signal(bundles):
+    train, val = bundles
+    est_full = _estimator()
+    est_fixed_only = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={"fixed": FixedEffectDataConfig("global")},
+        n_sweeps=1,
+        evaluator_specs=("AUC",),
+    )
+    auc_full = est_full.fit(train, val, [BASE])[0].evaluation.values["AUC"]
+    auc_fixed = est_fixed_only.fit(train, val, [{"fixed": BASE["fixed"]}])[
+        0
+    ].evaluation.values["AUC"]
+    assert auc_full > auc_fixed + 0.02
+
+
+def test_config_validation_errors(bundles):
+    train, val = bundles
+    with pytest.raises(ValueError, match="unknown coordinate"):
+        _estimator(update_sequence=("nope",))
+    with pytest.raises(ValueError, match="at least one"):
+        _estimator().fit(train, None, [])
+    with pytest.raises(ValueError, match="missing coordinates"):
+        _estimator().fit(train, None, [{"fixed": BASE["fixed"]}])
+    with pytest.raises(ValueError, match="no evaluator_specs"):
+        _estimator(evaluator_specs=()).fit(train, val, [BASE])
+    with pytest.raises(ValueError, match="unknown coordinate"):
+        reg_weight_sweep(BASE, {"nope": [1.0]})
+
+
+def test_locked_coordinate_partial_retrain(bundles):
+    """Reference partial retraining: a warm-start model for a coordinate
+    outside the update sequence is scored into residuals, never retrained,
+    and kept in the output model."""
+    train, val = bundles
+    full = _estimator(n_sweeps=1).fit(train, val, [BASE])[0]
+
+    est_partial = _estimator(update_sequence=("fixed",), n_sweeps=1)
+    r = est_partial.fit(
+        train, val, [{"fixed": BASE["fixed"]}], initial_model=full.model
+    )[0]
+    assert set(r.model.keys()) == {"fixed", "perUser"}
+    # locked perUser model is bit-identical to the warm start
+    locked, orig = r.model["perUser"], full.model["perUser"]
+    for a, b in zip(locked.bucket_coefs, orig.bucket_coefs):
+        assert a is b
+    # its signal still shows up in evaluation (better than fixed-only)
+    fixed_only = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={"fixed": FixedEffectDataConfig("global")},
+        evaluator_specs=("AUC",),
+    ).fit(train, val, [{"fixed": BASE["fixed"]}])[0]
+    assert r.evaluation.values["AUC"] > fixed_only.evaluation.values["AUC"] + 0.02

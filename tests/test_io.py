@@ -290,3 +290,98 @@ class TestModelIO:
         np.testing.assert_allclose(
             srecs[0]["metrics"]["mean"], x[:, 0].mean(), rtol=1e-6
         )
+
+
+class TestReviewRegressions:
+    def test_truncated_varint_raises(self, tmp_path):
+        from photon_tpu.io.avro import SchemaError, read_records, write_container
+
+        schema = {"type": "record", "name": "R",
+                  "fields": [{"name": "x", "type": "double"}]}
+        p = tmp_path / "t.avro"
+        write_container(str(p), schema, [{"x": float(i)} for i in range(5)])
+        data = p.read_bytes()
+        bad = tmp_path / "bad.avro"
+        bad.write_bytes(data[:-8] + b"\x85")  # continuation bit set at EOF
+        with pytest.raises(SchemaError):
+            read_records(str(bad))
+
+    def test_schema_only_read_leaks_nothing(self, tmp_path):
+        from photon_tpu.io.avro import read_container, write_container
+
+        schema = {"type": "record", "name": "R",
+                  "fields": [{"name": "x", "type": "long"}]}
+        p = tmp_path / "s.avro"
+        write_container(str(p), schema, [{"x": 1}])
+        got, it = read_container(str(p))  # never start the iterator
+        assert got["name"] == "R"
+
+    def test_scores_preserve_falsy_uids_and_none_labels(self, tmp_path):
+        from photon_tpu.io.avro import read_records
+        from photon_tpu.io.model_io import save_scores
+
+        p = tmp_path / "scores"
+        save_scores(str(p), np.asarray([0.5, 1.5]), uids=np.asarray([0, 1]),
+                    labels=[1.0, None])
+        recs = read_records(str(p))
+        assert [r["uid"] for r in recs] == ["0", "1"]
+        assert recs[0]["label"] == 1.0 and recs[1]["label"] is None
+
+    def test_custom_response_column(self, tmp_path):
+        from photon_tpu.index.index_map import build_index_from_features
+        from photon_tpu.io.avro import write_container
+        from photon_tpu.io.data_reader import AvroDataReader, InputColumnNames
+
+        schema = {"type": "record", "name": "R", "fields": [
+            {"name": "target", "type": "double"},
+            {"name": "features", "type": {"type": "array", "items": {
+                "type": "record", "name": "F", "fields": [
+                    {"name": "name", "type": "string"},
+                    {"name": "term", "type": ["null", "string"]},
+                    {"name": "value", "type": "double"}]}}}]}
+        p = tmp_path / "d.avro"
+        write_container(str(p), schema, [
+            {"target": float(i % 2),
+             "features": [{"name": "f", "term": "0", "value": 1.0}]}
+            for i in range(4)])
+        imap = build_index_from_features([("f", "0")], add_intercept=False)
+        bundle = AvroDataReader(
+            {"g": imap}, columns=InputColumnNames(response="target")
+        ).read(str(p))
+        assert bundle.labels.tolist() == [0.0, 1.0, 0.0, 1.0]
+
+    def test_truncated_payload_raises_schema_error(self, tmp_path):
+        from photon_tpu.io.avro import SchemaError, read_records, write_container
+
+        schema = {"type": "record", "name": "R",
+                  "fields": [{"name": "x", "type": "double"}]}
+        p = tmp_path / "t2.avro"
+        write_container(str(p), schema, [{"x": float(i)} for i in range(100)])
+        data = p.read_bytes()
+        bad = tmp_path / "cut.avro"
+        bad.write_bytes(data[:-200])  # cut mid-payload
+        with pytest.raises(SchemaError):
+            read_records(str(bad))
+
+    def test_custom_response_column_does_not_fall_back(self, tmp_path):
+        from photon_tpu.index.index_map import build_index_from_features
+        from photon_tpu.io.avro import write_container
+        from photon_tpu.io.data_reader import AvroDataReader, InputColumnNames
+
+        schema = {"type": "record", "name": "R", "fields": [
+            {"name": "target", "type": ["null", "double"]},
+            {"name": "label", "type": "double"},
+            {"name": "features", "type": {"type": "array", "items": {
+                "type": "record", "name": "F", "fields": [
+                    {"name": "name", "type": "string"},
+                    {"name": "term", "type": ["null", "string"]},
+                    {"name": "value", "type": "double"}]}}}]}
+        p = tmp_path / "d2.avro"
+        write_container(str(p), schema, [
+            {"target": None, "label": 9.0,
+             "features": [{"name": "f", "term": "0", "value": 1.0}]}])
+        imap = build_index_from_features([("f", "0")], add_intercept=False)
+        reader = AvroDataReader(
+            {"g": imap}, columns=InputColumnNames(response="target"))
+        with pytest.raises(ValueError, match="missing required column"):
+            reader.read(str(p))

@@ -208,3 +208,64 @@ def test_unseen_entity_scores_zero(rng, problem):
     model, _ = train_random_effects(problem, ds, jnp.zeros(ds.n_rows))
     gi, gv = model.coefficients_for("user_never_seen")
     assert len(gi) == 0 and len(gv) == 0
+
+
+def test_re_normalization_matches_explicit_scaling(rng):
+    """Factor-only normalization with zero regularization must reach the same
+    original-space optimum as the raw solve (normalization changes
+    conditioning, not the unregularized objective) — SURVEY.md §7 hard-part
+    #5 applied to random effects. Linear task, overdetermined per entity, so
+    the optimum is unique and finite."""
+    from photon_tpu.data.normalization import NormalizationContext
+
+    global_dim, d, n_entities, rows = 15, 5, 4, 40
+    idx_rows, val_rows, labels, keys = [], [], [], []
+    for e in range(n_entities):
+        support = rng.choice(global_dim, size=d, replace=False)
+        w = rng.normal(size=d)
+        for _ in range(rows):
+            x = rng.normal(size=d) * (1 + 3 * rng.random(d))
+            idx_rows.append(support)
+            val_rows.append(x)
+            labels.append(float(x @ w + 0.1 * rng.normal()))
+            keys.append(f"e{e}")
+    prob = GLMOptimizationProblem(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=200, tolerance=1e-12),
+    )
+    ds = build_random_effect_dataset(
+        "userId", np.asarray(keys), np.asarray(idx_rows), np.asarray(val_rows),
+        np.asarray(labels), global_dim=global_dim, dtype=np.float64)
+
+    factors = jnp.asarray(1.0 / (0.5 + rng.random(global_dim)))
+    ctx = NormalizationContext(factors=factors, shifts=None)
+    m_norm, _ = train_random_effects(
+        prob, ds, jnp.zeros(ds.n_rows), normalization=ctx)
+    m_raw, _ = train_random_effects(prob, ds, jnp.zeros(ds.n_rows))
+    for cn, cr in zip(m_norm.bucket_coefs, m_raw.bucket_coefs):
+        np.testing.assert_allclose(np.asarray(cn), np.asarray(cr), atol=1e-3)
+
+
+def test_warm_start_from_foreign_structure(rng, problem):
+    """A warm-start model whose bucket structure differs (e.g. loaded from
+    disk or trained on other data) must be re-projected, not crash —
+    reference modelInputDirectory path."""
+    from photon_tpu.game.coordinates import RandomEffectCoordinate
+
+    idx, val, labels, keys = _make_entity_data(rng)
+    ds_a = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
+    # dataset B: drop some rows -> different per-entity counts/buckets
+    keep = rng.random(len(labels)) < 0.6
+    ds_b = build_random_effect_dataset(
+        "userId", keys[keep], idx[keep], val[keep], labels[keep],
+        global_dim=50, dtype=np.float64)
+
+    model_b, _ = train_random_effects(problem, ds_b, jnp.zeros(ds_b.n_rows))
+    coord = RandomEffectCoordinate(dataset=ds_a, problem=problem)
+    model_a, _ = coord.train(jnp.zeros(ds_a.n_rows), init=model_b)
+    scores = coord.score(model_a)
+    assert np.all(np.isfinite(np.asarray(scores)))
+    # same-structure warm start still takes the fast path (object identity)
+    model_a2, _ = coord.train(jnp.zeros(ds_a.n_rows), init=model_a)
+    assert np.all(np.isfinite(np.asarray(coord.score(model_a2))))
