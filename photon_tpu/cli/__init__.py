@@ -1,0 +1,4 @@
+"""CLI drivers — reference ⟦photon-client/.../cli⟧ (SURVEY.md §1 L7):
+``game_training_driver``, ``game_scoring_driver``, ``feature_indexing_driver``.
+Each exposes ``run(argv) -> summary dict`` for programmatic use and ``main()``
+as the console entry point."""

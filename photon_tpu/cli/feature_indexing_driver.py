@@ -1,0 +1,64 @@
+"""Feature indexing driver: build partitioned mmap index stores from data.
+
+Parity: reference ⟦photon-client/.../index/FeatureIndexingDriver.scala⟧
+(SURVEY.md §2.3): scan the dataset once per feature shard, assign every
+``(name, term)`` pair a dense column id, and persist a partitioned off-heap
+store (reference: PalDB; here: the mmap store of ``index/index_map.py``) that
+training/scoring jobs load in O(1).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional, Sequence
+
+from photon_tpu.cli.params import parse_feature_shard
+from photon_tpu.index.index_map import build_mmap_index
+from photon_tpu.io.data_reader import build_index_from_avro
+from photon_tpu.utils import PhotonLogger, Timed
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="feature-indexing-driver",
+        description="Build per-shard feature index stores from Avro data.",
+    )
+    p.add_argument("--data", nargs="+", required=True)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--feature-shard", action="append", default=None,
+                   metavar="SHARD[:BAG+BAG][:no-intercept]",
+                   help="shard spec (repeatable); default 'global:features'")
+    p.add_argument("--num-partitions", type=int, default=1,
+                   help="hash partitions per store (reference PalDB partitions)")
+    return p
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    args = build_arg_parser().parse_args(argv)
+    os.makedirs(args.output_dir, exist_ok=True)
+    with PhotonLogger(args.output_dir) as logger:
+        sizes = {}
+        for spec in args.feature_shard or ["global:features"]:
+            s = parse_feature_shard(spec)
+            with Timed(f"index shard {s.shard}", logger):
+                imap = build_index_from_avro(
+                    args.data,
+                    feature_bags=s.feature_bags,
+                    add_intercept=s.add_intercept,
+                )
+                build_mmap_index(
+                    imap,
+                    os.path.join(args.output_dir, s.shard),
+                    num_partitions=args.num_partitions,
+                )
+            sizes[s.shard] = len(imap)
+            logger.info("shard %s: %d features", s.shard, len(imap))
+        return {"features_per_shard": sizes}
+
+
+def main() -> None:  # pragma: no cover - console entry
+    run()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

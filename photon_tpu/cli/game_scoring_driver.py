@@ -1,0 +1,145 @@
+"""GAME scoring driver: load a model directory + data → scores Avro.
+
+Parity: reference ⟦photon-client/.../cli/game/scoring/GameScoringDriver.scala⟧
+(SURVEY.md §3.6): read data through the SAME index maps the model was trained
+with, load the GAME model, score additively per coordinate (unseen entities →
+zero model), write ``ScoringResultAvro`` records, optionally evaluate.
+
+The model directory written by the training driver carries its index maps
+(``<output>/index/<shard>``) and per-coordinate metadata, so only
+``--model-dir`` and data paths are required.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.estimators import (
+    FixedEffectDataConfig,
+    GameTransformer,
+    RandomEffectDataConfig,
+)
+from photon_tpu.evaluation import EvaluationSuite
+from photon_tpu.index.index_map import MmapIndexMap
+from photon_tpu.io.data_reader import (
+    AvroDataReader,
+    FeatureShardConfig,
+    InputColumnNames,
+)
+from photon_tpu.io.model_io import load_game_model, save_scores
+from photon_tpu.utils import PhotonLogger, Timed
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="game-scoring-driver",
+        description="Score data with a trained GAME model.",
+    )
+    p.add_argument("--data", nargs="+", required=True)
+    p.add_argument("--model-dir", required=True,
+                   help="a 'best' or 'models/<i>' directory from the training driver")
+    p.add_argument("--index-dir", default=None,
+                   help="per-shard index stores (default: <model-dir>/../index)")
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--evaluators", nargs="+", default=None,
+                   help="optional evaluator specs over the scored data")
+    p.add_argument("--feature-bags", nargs="+", default=["features"],
+                   help="record fields holding feature lists (per training config)")
+    p.add_argument("--response-column", default="response")
+    p.add_argument("--uid-column", default="uid")
+    return p
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    args = build_arg_parser().parse_args(argv)
+    os.makedirs(args.output_dir, exist_ok=True)
+    with PhotonLogger(args.output_dir) as logger:
+        with open(os.path.join(args.model_dir, "game-metadata.json")) as f:
+            meta = json.load(f)
+        shards = {info["feature_shard"] for info in meta["coordinates"].values()}
+
+        index_root = args.index_dir or os.path.join(
+            os.path.dirname(os.path.normpath(args.model_dir)), "index"
+        )
+        index_maps = {
+            s: MmapIndexMap(os.path.join(index_root, s)) for s in sorted(shards)
+        }
+        with Timed("load model", logger):
+            model, meta = load_game_model(args.model_dir, index_maps)
+
+        # Reconstruct per-coordinate data configs from model metadata.
+        data_configs = {}
+        id_tags = set()
+        for cid, info in meta["coordinates"].items():
+            if info["type"] == "fixed":
+                data_configs[cid] = FixedEffectDataConfig(info["feature_shard"])
+            else:
+                data_configs[cid] = RandomEffectDataConfig(
+                    re_type=info["re_type"], feature_shard=info["feature_shard"]
+                )
+                id_tags.add(info["re_type"])
+
+        suite = EvaluationSuite.parse(args.evaluators) if args.evaluators else None
+        if suite:
+            id_tags |= {
+                ev.group_column for ev in suite.evaluators if ev.group_column
+            }
+
+        reader = AvroDataReader(
+            index_maps,
+            {
+                s: FeatureShardConfig(feature_bags=tuple(args.feature_bags))
+                for s in index_maps
+            },
+            columns=InputColumnNames(
+                uid=args.uid_column, response=args.response_column
+            ),
+            id_tag_columns=sorted(id_tags),
+        )
+        with Timed("read data", logger):
+            bundle = reader.read(args.data)
+        logger.info("scoring %d rows", bundle.n_rows)
+
+        transformer = GameTransformer(
+            model,
+            data_configs,
+            intercept_indices={
+                s: im.intercept_index for s, im in index_maps.items()
+            },
+        )
+        evaluation = None
+        with Timed("score", logger):
+            if suite:
+                scores, evaluation = transformer.transform_and_evaluate(
+                    bundle, suite
+                )
+            else:
+                scores = transformer.transform(bundle)
+
+        with Timed("save scores", logger):
+            save_scores(
+                os.path.join(args.output_dir, "scores.avro"),
+                np.asarray(scores),
+                uids=bundle.uids,
+                labels=bundle.labels,
+            )
+        summary = {
+            "n_rows": int(bundle.n_rows),
+            "evaluation": dict(evaluation.values) if evaluation else None,
+        }
+        with open(os.path.join(args.output_dir, "scoring-summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        logger.info("done: %s", summary)
+        return summary
+
+
+def main() -> None:  # pragma: no cover - console entry
+    run()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,315 @@
+"""GAME training driver: the end-to-end CLI training pipeline.
+
+Parity: reference ⟦photon-client/.../cli/game/training/GameTrainingDriver.scala⟧
+(SURVEY.md §3.1): parse params → read Avro training (+validation) data through
+feature index maps → optional normalization from feature statistics → data
+sanity checks → GameEstimator.fit over the optimization-config sweep → select
+best by the primary evaluator → save model(s) + index maps + metrics.
+
+TPU-first: no spark-submit — a plain console entry point; the device mesh
+replaces the executor fleet (``--devices`` chooses how many chips the data
+axis spans). Index maps are saved next to the model so the scoring driver is
+self-contained.
+
+Usage example:
+
+    python -m photon_tpu.cli.game_training_driver \
+      --train-data data/train --validation-data data/val \
+      --output-dir out --task LOGISTIC_REGRESSION \
+      --feature-shard global:features \
+      --coordinate "fixed:type=fixed,shard=global,reg=L2,reg_weights=0.1|1|10" \
+      --coordinate "perUser:type=random,re_type=userId,shard=global,reg=L2,reg_weights=1" \
+      --evaluators AUC LOGISTIC_LOSS --sweeps 2 --output-mode BEST
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.cli.params import (
+    configs_from_specs,
+    parse_coordinates,
+    parse_feature_shard,
+)
+from photon_tpu.data.normalization import NormalizationType
+from photon_tpu.data.validators import DataValidationType, sanity_check_data
+from photon_tpu.estimators import (
+    GameEstimator,
+    RandomEffectDataConfig,
+    select_best,
+)
+from photon_tpu.evaluation import EvaluationSuite
+from photon_tpu.index.index_map import MmapIndexMap, build_mmap_index
+from photon_tpu.io.data_reader import (
+    AvroDataReader,
+    FeatureShardConfig,
+    build_index_from_avro,
+)
+from photon_tpu.io.model_io import save_game_model
+from photon_tpu.types import TaskType
+from photon_tpu.utils import PhotonLogger, Timed, write_metrics_jsonl
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="game-training-driver",
+        description="Train a GAME (GLMix) model on TPU.",
+    )
+    p.add_argument("--train-data", nargs="+", required=True,
+                   help="Avro files/dirs/globs with training data")
+    p.add_argument("--validation-data", nargs="+", default=None)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task", required=True,
+                   choices=[t.name for t in TaskType])
+    p.add_argument("--feature-shard", action="append", default=None,
+                   metavar="SHARD[:BAG+BAG][:no-intercept]",
+                   help="feature shard spec (repeatable); default 'global:features'")
+    p.add_argument("--coordinate", action="append", required=True,
+                   metavar="CID:K=V,...",
+                   help="coordinate spec mini-DSL (repeatable); see cli/params.py")
+    p.add_argument("--update-sequence", default=None,
+                   help="comma-separated coordinate order (default: flag order)")
+    p.add_argument("--sweeps", type=int, default=1,
+                   help="coordinate-descent sweeps (reference coordinateDescentIterations)")
+    p.add_argument("--evaluators", nargs="+", default=None,
+                   help="evaluator specs; first is primary (AUC, RMSE, AUC:col, PRECISION@k:col)")
+    p.add_argument("--normalization", default="NONE",
+                   choices=[n.name for n in NormalizationType])
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.name for v in DataValidationType])
+    p.add_argument("--output-mode", default="BEST", choices=["BEST", "ALL"],
+                   help="save only the selected model or every swept config")
+    p.add_argument("--model-input-dir", default=None,
+                   help="warm-start GAME model directory (reference modelInputDirectory)")
+    p.add_argument("--index-dir", default=None,
+                   help="prebuilt per-shard mmap index maps (else built from training data)")
+    p.add_argument("--devices", type=int, default=0,
+                   help="data-parallel mesh size; 0 = all visible devices, 1 = no mesh")
+    p.add_argument("--offset-column", default="offset")
+    p.add_argument("--weight-column", default="weight")
+    p.add_argument("--response-column", default="response")
+    p.add_argument("--uid-column", default="uid")
+    return p
+
+
+def _load_or_build_indexes(args, shard_specs, logger):
+    shard_cfgs = {
+        s.shard: FeatureShardConfig(
+            feature_bags=s.feature_bags, add_intercept=s.add_intercept
+        )
+        for s in shard_specs
+    }
+    index_maps = {}
+    if args.index_dir:
+        for shard in shard_cfgs:
+            index_maps[shard] = MmapIndexMap(os.path.join(args.index_dir, shard))
+            logger.info("index[%s]: loaded %d features (mmap)",
+                        shard, len(index_maps[shard]))
+    else:
+        for shard, cfg in shard_cfgs.items():
+            index_maps[shard] = build_index_from_avro(
+                args.train_data,
+                feature_bags=cfg.feature_bags,
+                add_intercept=cfg.add_intercept,
+            )
+            logger.info("index[%s]: built %d features from training data",
+                        shard, len(index_maps[shard]))
+    return shard_cfgs, index_maps
+
+
+def _make_mesh(n_devices: int):
+    import jax
+
+    from photon_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    avail = len(jax.devices())
+    n = avail if n_devices == 0 else n_devices
+    if n > avail:
+        raise ValueError(f"--devices {n} > {avail} visible devices")
+    if n <= 1:
+        return None
+    return make_mesh({DATA_AXIS: n}, devices=jax.devices()[:n])
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    """Run training; returns a result summary dict (also written to disk)."""
+    args = build_arg_parser().parse_args(argv)
+    task = TaskType[args.task]
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    with PhotonLogger(args.output_dir) as logger:
+        specs = parse_coordinates(args.coordinate)
+        data_configs, configs = configs_from_specs(specs)
+        update_sequence = (
+            tuple(s.strip() for s in args.update_sequence.split(","))
+            if args.update_sequence
+            else tuple(c.cid for c in specs)
+        )
+        shard_specs = [
+            parse_feature_shard(s)
+            for s in (args.feature_shard or ["global:features"])
+        ]
+        needed = {c.feature_shard for c in data_configs.values()}
+        have = {s.shard for s in shard_specs}
+        if needed - have:
+            raise ValueError(
+                f"coordinates use feature shards {sorted(needed - have)} with no "
+                f"--feature-shard spec (have {sorted(have)})"
+            )
+
+        shard_cfgs, index_maps = _load_or_build_indexes(args, shard_specs, logger)
+
+        id_tags = sorted(
+            {
+                c.re_type
+                for c in data_configs.values()
+                if isinstance(c, RandomEffectDataConfig)
+            }
+            | {
+                ev.group_column
+                for ev in (
+                    EvaluationSuite.parse(args.evaluators).evaluators
+                    if args.evaluators
+                    else ()
+                )
+                if ev.group_column
+            }
+        )
+        from photon_tpu.io.data_reader import InputColumnNames
+
+        reader = AvroDataReader(
+            index_maps,
+            shard_cfgs,
+            columns=InputColumnNames(
+                uid=args.uid_column,
+                response=args.response_column,
+                offset=args.offset_column,
+                weight=args.weight_column,
+            ),
+            id_tag_columns=id_tags,
+        )
+
+        with Timed("read training data", logger) as t:
+            train = reader.read(args.train_data)
+        logger.info("training rows: %d", train.n_rows)
+        validation = None
+        if args.validation_data:
+            with Timed("read validation data", logger):
+                validation = reader.read(args.validation_data)
+            logger.info("validation rows: %d", validation.n_rows)
+
+        vtype = DataValidationType[args.data_validation]
+        with Timed("data validation", logger):
+            for shard in needed:
+                sanity_check_data(train.batch(shard), task, vtype)
+
+        initial_model = None
+        if args.model_input_dir:
+            from photon_tpu.io.model_io import load_game_model
+
+            with Timed("load warm-start model", logger):
+                initial_model, _ = load_game_model(
+                    args.model_input_dir, index_maps
+                )
+
+        mesh = _make_mesh(args.devices)
+        if mesh is not None:
+            logger.info("mesh: %s", mesh)
+
+        estimator = GameEstimator(
+            task=task,
+            coordinate_data_configs=data_configs,
+            update_sequence=update_sequence,
+            n_sweeps=args.sweeps,
+            evaluator_specs=tuple(args.evaluators or ()),
+            normalization=NormalizationType[args.normalization],
+            intercept_indices={
+                s: im.intercept_index for s, im in index_maps.items()
+            },
+            mesh=mesh,
+        )
+
+        with Timed("fit", logger) as fit_timer:
+            results = estimator.fit(
+                train,
+                validation if args.evaluators else None,
+                configs,
+                initial_model=initial_model,
+            )
+
+        suite = (
+            EvaluationSuite.parse(args.evaluators) if args.evaluators else None
+        )
+        best = select_best(results, suite) if suite else results[0]
+        best_i = results.index(best)
+
+        shard_by_coordinate = {
+            cid: c.feature_shard for cid, c in data_configs.items()
+        }
+        saved = {}
+        with Timed("save models", logger):
+            if args.output_mode == "ALL":
+                for i, r in enumerate(results):
+                    mdir = os.path.join(args.output_dir, "models", str(i))
+                    save_game_model(mdir, r.model, index_maps, shard_by_coordinate)
+                    saved[str(i)] = mdir
+            bdir = os.path.join(args.output_dir, "best")
+            save_game_model(bdir, best.model, index_maps, shard_by_coordinate)
+            saved["best"] = bdir
+            for shard, im in index_maps.items():
+                idir = os.path.join(args.output_dir, "index", shard)
+                if isinstance(im, MmapIndexMap):
+                    # already a store on disk: copy it so the output dir is a
+                    # self-contained scoring input
+                    if not os.path.exists(idir):
+                        import shutil
+
+                        shutil.copytree(im._dir, idir)
+                else:
+                    build_mmap_index(im, idir)
+
+        summary = {
+            "task": task.name,
+            "n_configs": len(results),
+            "best_config_index": best_i,
+            "best_config": {
+                cid: dataclasses.asdict(best.config[cid])
+                for cid in best.config
+            },
+            "evaluation": dict(best.evaluation.values) if best.evaluation else None,
+            "fit_seconds": fit_timer.seconds,
+            "model_dirs": saved,
+        }
+        # enums are not JSON-serializable through asdict
+        summary = json.loads(json.dumps(summary, default=lambda o: getattr(o, "name", str(o))))
+        with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        write_metrics_jsonl(
+            os.path.join(args.output_dir, "metrics.jsonl"),
+            (
+                {
+                    "config": i,
+                    "sweep": rec.sweep,
+                    "coordinate": rec.coordinate_id,
+                    "seconds": rec.seconds,
+                    **(rec.validation.values if rec.validation else {}),
+                }
+                for i, r in enumerate(results)
+                for rec in r.tracker
+            ),
+        )
+        logger.info("done; best config %d, evaluation %s", best_i, summary["evaluation"])
+        return summary
+
+
+def main() -> None:  # pragma: no cover - console entry
+    run()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

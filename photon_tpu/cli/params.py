@@ -1,0 +1,171 @@
+"""CLI parameter parsing shared by the GAME drivers.
+
+Parity: reference ⟦photon-client/.../cli/game/GameDriver.scala,
+ScoptGameTrainingParametersParser, ScoptGameScoringParametersParser⟧
+(SURVEY.md §2.3 "Param parsing"): declarative flag → config bridging with
+cross-validation, including the reference's per-coordinate configuration
+mini-DSL.
+
+Coordinate spec mini-DSL (one ``--coordinate`` flag per coordinate):
+
+    <cid>:<k>=<v>,<k>=<v>,...
+
+keys: ``type`` fixed|random (required); ``shard`` feature shard id;
+``re_type`` entity id column (random only, required); ``active_bound`` int;
+``min_rows`` int; ``optimizer`` LBFGS|OWLQN|TRON; ``max_iter`` int; ``tol``
+float; ``reg`` NONE|L1|L2|ELASTIC_NET; ``alpha`` elastic-net α;
+``reg_weights`` '|'-separated floats (sweep, default 0); ``downsample`` rate;
+``variance`` NONE|SIMPLE|FULL.
+
+Example:
+    --coordinate "fixed:type=fixed,shard=global,optimizer=LBFGS,reg=L2,reg_weights=0.1|1|10"
+    --coordinate "perUser:type=random,re_type=userId,shard=user,reg=L2,reg_weights=1"
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+from photon_tpu.estimators.config import (
+    CoordinateDataConfig,
+    FixedEffectDataConfig,
+    GLMOptimizationConfiguration,
+    RandomEffectDataConfig,
+    reg_weight_sweep,
+)
+from photon_tpu.functions.problem import VarianceComputationType
+from photon_tpu.optim import OptimizerType
+from photon_tpu.optim.regularization import (
+    RegularizationContext,
+    RegularizationType,
+    elastic_net_context,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CoordinateSpec:
+    """One parsed ``--coordinate`` flag."""
+
+    cid: str
+    data: CoordinateDataConfig
+    optimization: GLMOptimizationConfiguration
+    reg_weights: tuple[float, ...]
+
+
+_BOOL = {"true": True, "false": False}
+
+
+def parse_coordinate_spec(spec: str) -> CoordinateSpec:
+    cid, sep, body = spec.partition(":")
+    cid = cid.strip()
+    if not sep or not cid:
+        raise ValueError(
+            f"coordinate spec must be '<cid>:k=v,...', got {spec!r}"
+        )
+    kv: dict[str, str] = {}
+    for item in body.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        k, sep, v = item.partition("=")
+        if not sep:
+            raise ValueError(f"coordinate {cid!r}: bad item {item!r} (need k=v)")
+        kv[k.strip()] = v.strip()
+
+    known = {
+        "type", "shard", "re_type", "active_bound", "min_rows", "optimizer",
+        "max_iter", "tol", "reg", "alpha", "reg_weights", "downsample",
+        "variance",
+    }
+    unknown = set(kv) - known
+    if unknown:
+        raise ValueError(f"coordinate {cid!r}: unknown keys {sorted(unknown)}")
+
+    ctype = kv.get("type")
+    if ctype not in ("fixed", "random"):
+        raise ValueError(
+            f"coordinate {cid!r}: type must be 'fixed' or 'random', got {ctype!r}"
+        )
+    shard = kv.get("shard", "global")
+    if ctype == "fixed":
+        for k in ("re_type", "active_bound", "min_rows"):
+            if k in kv:
+                raise ValueError(f"coordinate {cid!r}: {k} is random-effect only")
+        data: CoordinateDataConfig = FixedEffectDataConfig(feature_shard=shard)
+    else:
+        if "re_type" not in kv:
+            raise ValueError(f"coordinate {cid!r}: random effects need re_type")
+        data = RandomEffectDataConfig(
+            re_type=kv["re_type"],
+            feature_shard=shard,
+            active_bound=int(kv["active_bound"]) if "active_bound" in kv else None,
+            min_entity_rows=int(kv.get("min_rows", 1)),
+        )
+
+    reg_type = RegularizationType(kv.get("reg", "NONE").upper())
+    if reg_type == RegularizationType.ELASTIC_NET:
+        reg_ctx = elastic_net_context(float(kv.get("alpha", 0.5)))
+    else:
+        reg_ctx = RegularizationContext(reg_type)
+
+    opt = GLMOptimizationConfiguration(
+        optimizer_type=OptimizerType(kv.get("optimizer", "LBFGS").upper()),
+        max_iterations=int(kv.get("max_iter", 80)),
+        tolerance=float(kv.get("tol", 1e-7)),
+        regularization=reg_ctx,
+        down_sampling_rate=float(kv.get("downsample", 1.0)),
+        variance_type=VarianceComputationType(kv.get("variance", "NONE").upper()),
+    )
+    weights = tuple(
+        float(w) for w in kv.get("reg_weights", "0").split("|") if w != ""
+    )
+    if not weights:
+        weights = (0.0,)
+    return CoordinateSpec(cid=cid, data=data, optimization=opt, reg_weights=weights)
+
+
+def parse_coordinates(specs: Sequence[str]) -> list[CoordinateSpec]:
+    out = [parse_coordinate_spec(s) for s in specs]
+    seen = set()
+    for c in out:
+        if c.cid in seen:
+            raise ValueError(f"duplicate coordinate id {c.cid!r}")
+        seen.add(c.cid)
+    return out
+
+
+def configs_from_specs(specs: Sequence[CoordinateSpec]):
+    """(data configs by cid, optimization-config sweep) from parsed specs —
+    the reference's Seq[GameOptimizationConfiguration] expansion."""
+    data_configs = {c.cid: c.data for c in specs}
+    base = {c.cid: c.optimization.with_reg_weight(c.reg_weights[0]) for c in specs}
+    sweep_axes = {
+        c.cid: list(c.reg_weights) for c in specs if len(c.reg_weights) > 1
+    }
+    configs = reg_weight_sweep(base, sweep_axes) if sweep_axes else [base]
+    return data_configs, configs
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureShardSpec:
+    """One parsed ``--feature-shard`` flag: ``<shard>:<bag>[+<bag>...][:no-intercept]``."""
+
+    shard: str
+    feature_bags: tuple[str, ...]
+    add_intercept: bool
+
+
+def parse_feature_shard(spec: str) -> FeatureShardSpec:
+    parts = spec.split(":")
+    if not (1 <= len(parts) <= 3) or not parts[0]:
+        raise ValueError(
+            f"feature shard spec must be '<shard>[:<bag>+<bag>][:no-intercept]', got {spec!r}"
+        )
+    shard = parts[0]
+    bags = tuple((parts[1] if len(parts) > 1 and parts[1] else "features").split("+"))
+    add_intercept = True
+    if len(parts) == 3:
+        if parts[2] != "no-intercept":
+            raise ValueError(f"feature shard {shard!r}: expected 'no-intercept', got {parts[2]!r}")
+        add_intercept = False
+    return FeatureShardSpec(shard, bags, add_intercept)

@@ -215,6 +215,12 @@ def project_context(
     if ctx.is_identity:
         return None
     f, s = ctx._effective()
+    for vec in (f, s):
+        if vec is not None and vec.shape[-1] != global_dim:
+            raise ValueError(
+                f"normalization context is {vec.shape[-1]}-dim but the "
+                f"projection's global feature space is {global_dim}-dim"
+            )
 
     def gather(vec: Optional[Array], ghost_fill: float) -> Optional[Array]:
         if vec is None:

@@ -130,6 +130,23 @@ class RandomEffectDataset:
         return out[: self.n_rows]
 
 
+def down_sample_dataset(
+    dataset: RandomEffectDataset, sampler, key
+) -> RandomEffectDataset:
+    """Down-sample training weights per entity bucket (reference: per-config
+    down-sampling applies to random-effect coordinates too). Only
+    ``train_weights`` change — scoring weights and the active/passive split
+    are untouched, and already-zero (padded/passive) slots stay zero."""
+    import jax as _jax
+
+    new_buckets = []
+    for i, b in enumerate(dataset.buckets):
+        k = _jax.random.fold_in(key, i)
+        tw = sampler.down_sample_weights(k, b.labels, b.train_weights)
+        new_buckets.append(dataclasses.replace(b, train_weights=tw))
+    return dataclasses.replace(dataset, buckets=tuple(new_buckets))
+
+
 def build_random_effect_dataset(
     re_type: str,
     entity_keys_per_row: np.ndarray,

@@ -44,13 +44,18 @@ class DownSampler:
         if not (0.0 < self.rate <= 1.0):
             raise ValueError(f"down-sampling rate must be in (0, 1], got {self.rate}")
 
-    def _keep_mask(self, key: Array, batch: LabeledBatch) -> Array:
-        return jax.random.uniform(key, (batch.n_rows,)) < self.rate
+    def down_sample_weights(
+        self, key: Array, labels: Array, weights: Array
+    ) -> Array:
+        """Weight-level core (any shape): zero dropped rows, rescale kept.
+        Shared by the fixed-effect batch path and the per-entity
+        random-effect train-weight path."""
+        keep = jax.random.uniform(key, labels.shape) < self.rate
+        return jnp.where(keep, weights / self.rate, 0.0)
 
     def down_sample(self, key: Array, batch: LabeledBatch) -> LabeledBatch:
         """Jit-safe: zero dropped rows' weights, rescale kept rows."""
-        keep = self._keep_mask(key, batch)
-        new_w = jnp.where(keep, batch.weights / self.rate, 0.0)
+        new_w = self.down_sample_weights(key, batch.labels, batch.weights)
         return dataclasses.replace(batch, weights=new_w)
 
 
@@ -59,12 +64,17 @@ class BinaryClassificationDownSampler(DownSampler):
     """Keep all positives; down-sample negatives at ``rate``, re-weighting
     kept negatives by 1/rate. Reference ⟦BinaryClassificationDownSampler⟧."""
 
-    def down_sample(self, key: Array, batch: LabeledBatch) -> LabeledBatch:
-        keep_draw = jax.random.uniform(key, (batch.n_rows,)) < self.rate
-        is_pos = batch.labels > 0
+    def down_sample_weights(
+        self, key: Array, labels: Array, weights: Array
+    ) -> Array:
+        keep_draw = jax.random.uniform(key, labels.shape) < self.rate
+        is_pos = labels > 0
         keep = is_pos | keep_draw
         scale = jnp.where(is_pos, 1.0, 1.0 / self.rate)
-        new_w = jnp.where(keep, batch.weights * scale, 0.0)
+        return jnp.where(keep, weights * scale, 0.0)
+
+    def down_sample(self, key: Array, batch: LabeledBatch) -> LabeledBatch:
+        new_w = self.down_sample_weights(key, batch.labels, batch.weights)
         return dataclasses.replace(batch, weights=new_w)
 
 

@@ -176,7 +176,7 @@ class GameEstimator:
 
         prep = self._prepare(data)
         validation = (
-            self._prepare_validation(validation_data, prep, suite)
+            self._prepare_validation(validation_data, suite)
             if validation_data is not None
             else None
         )
@@ -283,11 +283,24 @@ class GameEstimator:
                     normalization=prep["norm"][dcfg.feature_shard],
                 )
             else:
-                mask = intercept_reg_mask(
-                    prep["train"][cid].global_dim, intercept
-                )
+                dataset = prep["train"][cid]
+                if ocfg.down_sampling_rate < 1.0:
+                    from photon_tpu.data.random_effect import down_sample_dataset
+
+                    key = jax.random.fold_in(
+                        jax.random.fold_in(
+                            jax.random.PRNGKey(self.seed), config_index
+                        ),
+                        len(coordinates),
+                    )
+                    dataset = down_sample_dataset(
+                        dataset,
+                        down_sampler_for_task(self.task, ocfg.down_sampling_rate),
+                        key,
+                    )
+                mask = intercept_reg_mask(dataset.global_dim, intercept)
                 coordinates[cid] = RandomEffectCoordinate(
-                    dataset=prep["train"][cid],
+                    dataset=dataset,
                     problem=problem,
                     mesh=self.mesh,
                     entity_axis=self.data_axis,
@@ -299,7 +312,6 @@ class GameEstimator:
     def _prepare_validation(
         self,
         vdata: GameDataBundle,
-        prep: dict,
         suite: EvaluationSuite,
     ) -> ValidationData:
         """Validation rows + per-coordinate scorers + grouped-eval ids."""

@@ -85,21 +85,23 @@ class RandomEffectCoordinate:
     global_reg_mask: Optional[Array] = None
     normalization: Optional[object] = None   # shard-level NormalizationContext
 
+    def _same_structure(self, model: RandomEffectModel) -> bool:
+        # A model trained on THIS dataset (every coordinate-descent sweep)
+        # shares bucket structure by object identity. Anything else — a
+        # loaded model, a model from different data — must be re-projected
+        # into this dataset's bucket/subspace structure.
+        return len(model.bucket_coefs) == len(self.dataset.buckets) and all(
+            p is b.proj for p, b in zip(model.bucket_proj, self.dataset.buckets)
+        )
+
     def _init_coefs(self, init: Optional[RandomEffectModel]):
         if init is None:
             return None
-        # Fast path: a model trained on THIS dataset (every coordinate-descent
-        # sweep) shares bucket structure by object identity. Anything else —
-        # a loaded model, a model from different data — must be re-projected
-        # into this dataset's bucket/subspace structure.
-        same = (
-            len(init.bucket_coefs) == len(self.dataset.buckets)
-            and all(
-                p is b.proj
-                for p, b in zip(init.bucket_proj, self.dataset.buckets)
-            )
+        return (
+            init.bucket_coefs
+            if self._same_structure(init)
+            else init.project_to(self.dataset)
         )
-        return init.bucket_coefs if same else init.project_to(self.dataset)
 
     def train(self, offsets: Array, init: Optional[RandomEffectModel] = None):
         return train_random_effects(
@@ -111,7 +113,11 @@ class RandomEffectCoordinate:
         )
 
     def score(self, model: RandomEffectModel) -> Array:
-        return model.score_dataset(self.dataset)
+        if self._same_structure(model):
+            return model.score_dataset(self.dataset)
+        # Foreign model (loaded warm start / locked coordinate): project its
+        # per-entity coefficients into this dataset's structure first.
+        return model.score_new_dataset(self.dataset)
 
 
 Coordinate = Union[FixedEffectCoordinate, RandomEffectCoordinate]

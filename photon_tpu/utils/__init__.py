@@ -1,0 +1,4 @@
+"""Cross-cutting utilities — reference ⟦photon-api/.../util⟧ (SURVEY.md §5)."""
+from photon_tpu.utils.logging import PhotonLogger, Timed, write_metrics_jsonl
+
+__all__ = ["PhotonLogger", "Timed", "write_metrics_jsonl"]

@@ -1,0 +1,218 @@
+"""End-to-end CLI driver runs on tiny Avro fixtures (SURVEY.md §4 E2E tier).
+
+Mirrors the reference's ⟦GameTrainingDriverIntegTest / GameScoringDriverIntegTest
+/ FeatureIndexingDriverIntegTest⟧: full driver invocations against small Avro
+datasets in a temp dir; assert outputs exist, parse, and metrics are sane.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from photon_tpu.cli import feature_indexing_driver, game_scoring_driver, game_training_driver
+from photon_tpu.cli.params import parse_coordinate_spec, parse_feature_shard
+from photon_tpu.io.avro import read_records, write_container
+
+RECORD_SCHEMA = {
+    "type": "record",
+    "name": "TrainingExampleAvro",
+    "fields": [
+        {"name": "uid", "type": ["null", "string"], "default": None},
+        {"name": "response", "type": "double"},
+        {"name": "offset", "type": ["null", "double"], "default": None},
+        {"name": "weight", "type": ["null", "double"], "default": None},
+        {"name": "features", "type": {"type": "array", "items": {
+            "type": "record", "name": "FeatureAvro", "fields": [
+                {"name": "name", "type": "string"},
+                {"name": "term", "type": ["null", "string"], "default": None},
+                {"name": "value", "type": "double"},
+            ]}}},
+        {"name": "metadataMap",
+         "type": ["null", {"type": "map", "values": "string"}], "default": None},
+    ],
+}
+
+
+def _write_game_avro(path, seed, n_users=8, rows_per_user=24, d_global=5, d_user=3):
+    """GLMix data: global features f0..f4 + per-user block features."""
+    truth = np.random.default_rng(77)
+    wg = truth.normal(size=d_global)
+    wu = truth.normal(size=(n_users, d_user)) * 1.5
+    rng = np.random.default_rng(seed)
+    n = n_users * rows_per_user
+    users = rng.permutation(np.repeat(np.arange(n_users), rows_per_user))
+    recs = []
+    for i in range(n):
+        u = int(users[i])
+        xg = rng.normal(size=d_global)
+        xu = rng.normal(size=d_user)
+        z = xg @ wg + xu @ wu[u]
+        y = float(rng.random() < 1 / (1 + np.exp(-z)))
+        feats = [
+            {"name": "g", "term": str(j), "value": float(xg[j])}
+            for j in range(d_global)
+        ] + [
+            {"name": "u", "term": f"{u}_{j}", "value": float(xu[j])}
+            for j in range(d_user)
+        ]
+        recs.append({
+            "uid": str(i),
+            "response": y,
+            "offset": None,
+            "weight": None,
+            "features": feats,
+            "metadataMap": {"userId": f"user{u}"},
+        })
+    write_container(str(path), RECORD_SCHEMA, recs)
+    return n
+
+
+@pytest.fixture(scope="module")
+def game_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("gamedata")
+    n_train = _write_game_avro(d / "train.avro", seed=1)
+    n_val = _write_game_avro(d / "val.avro", seed=2)
+    return d, n_train, n_val
+
+
+def test_feature_indexing_driver(game_data, tmp_path):
+    d, _, _ = game_data
+    out = tmp_path / "index"
+    summary = feature_indexing_driver.run([
+        "--data", str(d / "train.avro"),
+        "--output-dir", str(out),
+        "--feature-shard", "global:features",
+        "--num-partitions", "2",
+    ])
+    # 5 global + 8*3 user features + intercept
+    assert summary["features_per_shard"]["global"] == 5 + 24 + 1
+    from photon_tpu.index.index_map import MmapIndexMap
+
+    imap = MmapIndexMap(str(out / "global"))
+    assert imap.get_index("g", "0") >= 0
+    assert imap.intercept_index is not None
+
+
+def test_training_and_scoring_drivers_end_to_end(game_data, tmp_path):
+    d, n_train, n_val = game_data
+    out = tmp_path / "train_out"
+    summary = game_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--validation-data", str(d / "val.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate",
+        "fixed:type=fixed,shard=global,reg=L2,max_iter=40,reg_weights=0.1|100",
+        "--coordinate",
+        "perUser:type=random,re_type=userId,shard=global,reg=L2,max_iter=40,reg_weights=1",
+        "--evaluators", "AUC", "LOGISTIC_LOSS",
+        "--sweeps", "2",
+        "--output-mode", "ALL",
+        "--devices", "1",
+    ])
+    assert summary["n_configs"] == 2
+    assert summary["evaluation"]["AUC"] > 0.6
+    assert os.path.exists(out / "best" / "game-metadata.json")
+    assert os.path.exists(out / "models" / "0")
+    assert os.path.exists(out / "index" / "global")
+    assert os.path.exists(out / "photon.log")
+    metrics = [json.loads(l) for l in open(out / "metrics.jsonl")]
+    assert len(metrics) == 2 * 2 * 2  # configs x sweeps x coordinates
+    assert all("AUC" in m for m in metrics)
+
+    # scoring driver on validation data with the trained model
+    score_out = tmp_path / "score_out"
+    ssum = game_scoring_driver.run([
+        "--data", str(d / "val.avro"),
+        "--model-dir", str(out / "best"),
+        "--output-dir", str(score_out),
+        "--evaluators", "AUC",
+    ])
+    assert ssum["n_rows"] == n_val
+    # scoring-path evaluation should match training-side validation closely
+    assert ssum["evaluation"]["AUC"] == pytest.approx(
+        summary["evaluation"]["AUC"], abs=1e-6
+    )
+    recs = read_records(str(score_out / "scores.avro"))
+    assert len(recs) == n_val
+    assert all(np.isfinite(r["predictionScore"]) for r in recs)
+
+
+def test_training_driver_warm_start(game_data, tmp_path):
+    d, _, _ = game_data
+    out1 = tmp_path / "o1"
+    args = [
+        "--train-data", str(d / "train.avro"),
+        "--validation-data", str(d / "val.avro"),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate", "fixed:type=fixed,shard=global,reg=L2,max_iter=30,reg_weights=1",
+        "--coordinate",
+        "perUser:type=random,re_type=userId,shard=global,reg=L2,max_iter=30,reg_weights=1",
+        "--evaluators", "AUC",
+        "--devices", "1",
+    ]
+    s1 = game_training_driver.run(args + ["--output-dir", str(out1)])
+    out2 = tmp_path / "o2"
+    s2 = game_training_driver.run(
+        args + ["--output-dir", str(out2),
+                "--model-input-dir", str(out1 / "best")]
+    )
+    assert s2["evaluation"]["AUC"] >= s1["evaluation"]["AUC"] - 0.02
+
+
+def test_prebuilt_index_dir_path(game_data, tmp_path):
+    d, _, n_val = game_data
+    idx = tmp_path / "idx"
+    feature_indexing_driver.run([
+        "--data", str(d / "train.avro"),
+        "--output-dir", str(idx),
+        "--feature-shard", "global:features",
+    ])
+    out = tmp_path / "to"
+    s = game_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate", "fixed:type=fixed,shard=global,reg=L2,max_iter=20,reg_weights=1",
+        "--index-dir", str(idx),
+        "--devices", "1",
+    ])
+    assert s["evaluation"] is None
+    assert os.path.exists(out / "index" / "global" / "index-meta.json")
+
+
+class TestParamParsing:
+    def test_coordinate_spec_full(self):
+        c = parse_coordinate_spec(
+            "re:type=random,re_type=userId,shard=u,active_bound=100,min_rows=2,"
+            "optimizer=TRON,max_iter=7,tol=1e-3,reg=ELASTIC_NET,alpha=0.3,"
+            "reg_weights=1|2|3,downsample=0.5,variance=SIMPLE"
+        )
+        assert c.cid == "re"
+        assert c.data.re_type == "userId"
+        assert c.data.active_bound == 100
+        assert c.optimization.optimizer_type.name == "TRON"
+        assert c.optimization.regularization.elastic_net_alpha == 0.3
+        assert c.reg_weights == (1.0, 2.0, 3.0)
+        assert c.optimization.variance_type.name == "SIMPLE"
+
+    def test_coordinate_spec_errors(self):
+        with pytest.raises(ValueError, match="type must be"):
+            parse_coordinate_spec("x:shard=g")
+        with pytest.raises(ValueError, match="unknown keys"):
+            parse_coordinate_spec("x:type=fixed,bogus=1")
+        with pytest.raises(ValueError, match="need re_type"):
+            parse_coordinate_spec("x:type=random")
+        with pytest.raises(ValueError, match="random-effect only"):
+            parse_coordinate_spec("x:type=fixed,re_type=u")
+
+    def test_feature_shard_spec(self):
+        s = parse_feature_shard("myShard:bagA+bagB:no-intercept")
+        assert s.shard == "myShard"
+        assert s.feature_bags == ("bagA", "bagB")
+        assert s.add_intercept is False
+        assert parse_feature_shard("g").feature_bags == ("features",)

@@ -211,3 +211,51 @@ def test_locked_coordinate_partial_retrain(bundles):
         evaluator_specs=("AUC",),
     ).fit(train, val, [{"fixed": BASE["fixed"]}])[0]
     assert r.evaluation.values["AUC"] > fixed_only.evaluation.values["AUC"] + 0.02
+
+
+def test_loaded_model_warm_start_scores_via_projection(bundles, tmp_path):
+    """A model saved + loaded from disk (single synthetic bucket structure)
+    must warm-start fit without structural crashes — both its initial scoring
+    and its use as an init point re-project into this run's buckets."""
+    from photon_tpu.index.index_map import build_index_from_features
+    from photon_tpu.io.model_io import load_game_model, save_game_model
+
+    train, val = bundles
+    est = _estimator(n_sweeps=1)
+    first = est.fit(train, val, [BASE])[0]
+
+    index_maps = {
+        "global": build_index_from_features(
+            [("g", str(j)) for j in range(6)], add_intercept=False),
+        "user": build_index_from_features(
+            [("u", str(j)) for j in range(40)], add_intercept=False),
+    }
+    mdir = tmp_path / "model"
+    save_game_model(str(mdir), first.model, index_maps,
+                    {"fixed": "global", "perUser": "user"})
+    loaded, _ = load_game_model(str(mdir), index_maps)
+
+    warm = est.fit(train, val, [BASE], initial_model=loaded)[0]
+    assert warm.evaluation.values["AUC"] >= first.evaluation.values["AUC"] - 0.03
+
+
+def test_re_down_sampling_reduces_training_mass(bundles):
+    """down_sampling_rate on a random-effect coordinate must actually change
+    per-entity training weights (regression: silently ignored)."""
+    import jax
+
+    from photon_tpu.data.random_effect import down_sample_dataset
+    from photon_tpu.data.sampling import DownSampler
+    from photon_tpu.estimators.game_estimator import build_re_dataset_from_bundle
+
+    train, _ = bundles
+    ds = build_re_dataset_from_bundle(
+        train, RandomEffectDataConfig(re_type="userId", feature_shard="user"))
+    sampled = down_sample_dataset(ds, DownSampler(0.5), jax.random.PRNGKey(0))
+    orig_nnz = sum(int((np.asarray(b.train_weights) > 0).sum()) for b in ds.buckets)
+    new_nnz = sum(int((np.asarray(b.train_weights) > 0).sum()) for b in sampled.buckets)
+    assert new_nnz < orig_nnz
+    # kept rows re-weighted by 1/rate
+    kept_mass = sum(float(np.asarray(b.train_weights).sum()) for b in sampled.buckets)
+    orig_mass = sum(float(np.asarray(b.train_weights).sum()) for b in ds.buckets)
+    assert kept_mass == pytest.approx(orig_mass, rel=0.15)
