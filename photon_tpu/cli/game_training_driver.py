@@ -86,6 +86,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="save only the selected model or every swept config")
     p.add_argument("--model-input-dir", default=None,
                    help="warm-start GAME model directory (reference modelInputDirectory)")
+    p.add_argument("--tuning", default=None, choices=["gp", "random"],
+                   help="auto-tune per-coordinate reg weights instead of grid sweep")
+    p.add_argument("--tuning-iterations", type=int, default=10)
+    p.add_argument("--tuning-range", action="append", default=None,
+                   metavar="CID:MIN:MAX",
+                   help="reg-weight search range per coordinate (repeatable; log scale)")
     p.add_argument("--index-dir", default=None,
                    help="prebuilt per-shard mmap index maps (else built from training data)")
     p.add_argument("--devices", type=int, default=0,
@@ -234,13 +240,44 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             mesh=mesh,
         )
 
-        with Timed("fit", logger) as fit_timer:
-            results = estimator.fit(
-                train,
-                validation if args.evaluators else None,
-                configs,
-                initial_model=initial_model,
+        if args.tuning:
+            if not (args.evaluators and validation is not None):
+                raise ValueError("--tuning needs --evaluators and --validation-data")
+            if not args.tuning_range:
+                raise ValueError("--tuning needs at least one --tuning-range CID:MIN:MAX")
+            if len(configs) > 1:
+                raise ValueError(
+                    "--tuning replaces the reg-weight grid sweep; remove the "
+                    "multi-value reg_weights axes from --coordinate specs"
+                )
+            from photon_tpu.hyperparameter import tune_regularization
+
+            ranges = {}
+            for spec in args.tuning_range:
+                cid, lo, hi = spec.split(":")
+                ranges[cid] = (float(lo), float(hi))
+            with Timed("hyperparameter tuning", logger) as fit_timer:
+                tuning = tune_regularization(
+                    estimator, train, validation, configs[0], ranges,
+                    n_iterations=args.tuning_iterations,
+                    strategy=args.tuning, seed=0,
+                    initial_model=initial_model,
+                )
+            logger.info(
+                "tuning best params %s -> %.6g",
+                dict(zip(sorted(ranges), tuning.best_params)),
+                tuning.search.best_value,
             )
+            # The best config's model was already trained during the search.
+            results = [tuning.best_result]
+        else:
+            with Timed("fit", logger) as fit_timer:
+                results = estimator.fit(
+                    train,
+                    validation if args.evaluators else None,
+                    configs,
+                    initial_model=initial_model,
+                )
 
         suite = (
             EvaluationSuite.parse(args.evaluators) if args.evaluators else None

@@ -174,9 +174,9 @@ class GameEstimator:
         if validation_data is not None and suite is None:
             raise ValueError("validation data provided but no evaluator_specs")
 
-        prep = self._prepare(data)
+        prep = self._prepare_cached(data)
         validation = (
-            self._prepare_validation(validation_data, suite)
+            self._prepare_validation_cached(validation_data, suite)
             if validation_data is not None
             else None
         )
@@ -211,6 +211,28 @@ class GameEstimator:
         if self.intercept_indices is None:
             return None
         return self.intercept_indices.get(shard)
+
+    def _prepare_cached(self, data: GameDataBundle) -> dict:
+        """Per-bundle preparation cache (size 1, identity-keyed): repeated
+        fits on the same bundle — hyperparameter tuning calls fit once per
+        proposed config — reuse the datasets/statistics instead of
+        regrouping random effects every iteration."""
+        cached = getattr(self, "_prep_cache", None)
+        if cached is not None and cached[0] is data:
+            return cached[1]
+        prep = self._prepare(data)
+        self._prep_cache = (data, prep)
+        return prep
+
+    def _prepare_validation_cached(
+        self, vdata: GameDataBundle, suite: EvaluationSuite
+    ) -> ValidationData:
+        cached = getattr(self, "_validation_cache", None)
+        if cached is not None and cached[0] is vdata and cached[1] == suite:
+            return cached[2]
+        v = self._prepare_validation(vdata, suite)
+        self._validation_cache = (vdata, suite, v)
+        return v
 
     def _prepare(self, data: GameDataBundle) -> dict:
         """Build per-coordinate datasets + per-shard normalization ONCE."""

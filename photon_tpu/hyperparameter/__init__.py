@@ -1,0 +1,44 @@
+"""Hyperparameter tuning — reference ⟦photon-lib/.../hyperparameter⟧
+(SURVEY.md §1 H, §2.1): GP surrogate (Matérn-5/2 / RBF), Expected
+Improvement, slice-sampled GP hyperparameters, random search, range
+rescaling/serialization, and the GAME reg-weight tuner."""
+from photon_tpu.hyperparameter.acquisition import expected_improvement
+from photon_tpu.hyperparameter.gp import (
+    GaussianProcessEstimator,
+    GaussianProcessModel,
+    predict_mean_var,
+)
+from photon_tpu.hyperparameter.kernels import KERNELS, Matern52, RBF
+from photon_tpu.hyperparameter.rescaling import (
+    ParamRange,
+    VectorRescaling,
+    ranges_from_json,
+    ranges_to_json,
+)
+from photon_tpu.hyperparameter.search import (
+    GaussianProcessSearch,
+    RandomSearch,
+    SearchResult,
+)
+from photon_tpu.hyperparameter.slice_sampler import SliceSampler
+from photon_tpu.hyperparameter.tuner import TuningResult, tune_regularization
+
+__all__ = [
+    "expected_improvement",
+    "GaussianProcessEstimator",
+    "GaussianProcessModel",
+    "predict_mean_var",
+    "KERNELS",
+    "Matern52",
+    "RBF",
+    "ParamRange",
+    "VectorRescaling",
+    "ranges_from_json",
+    "ranges_to_json",
+    "GaussianProcessSearch",
+    "RandomSearch",
+    "SearchResult",
+    "SliceSampler",
+    "TuningResult",
+    "tune_regularization",
+]

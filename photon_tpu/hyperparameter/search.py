@@ -1,0 +1,130 @@
+"""Search strategies: pure random and GP-guided Bayesian optimization.
+
+Parity: reference ⟦photon-lib/.../hyperparameter/search/RandomSearch.scala,
+GaussianProcessSearch.scala, EvaluationFunction.scala⟧ (SURVEY.md §2.1): an
+``EvaluationFunction`` maps a native-unit parameter vector to a scalar to
+**minimize**; searches propose, evaluate, observe, repeat, and return the full
+history. GaussianProcessSearch seeds with random points, then maximizes
+Expected Improvement over a random candidate pool under the slice-sampled GP
+posterior — the reference's exact loop, minus Spark plumbing.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.hyperparameter.acquisition import expected_improvement
+from photon_tpu.hyperparameter.gp import (
+    GaussianProcessEstimator,
+    predict_mean_var,
+)
+from photon_tpu.hyperparameter.kernels import Matern52
+from photon_tpu.hyperparameter.rescaling import VectorRescaling
+
+logger = logging.getLogger("photon_tpu.hyperparameter")
+
+# vector (native units) -> value to minimize
+EvaluationFunction = Callable[[np.ndarray], float]
+
+
+@dataclasses.dataclass(frozen=True)
+class SearchResult:
+    """Full history + incumbent."""
+
+    points: np.ndarray     # [n, d] native units
+    values: np.ndarray     # [n]
+
+    @property
+    def best_index(self) -> int:
+        return int(np.argmin(self.values))
+
+    @property
+    def best_point(self) -> np.ndarray:
+        return self.points[self.best_index]
+
+    @property
+    def best_value(self) -> float:
+        return float(self.values[self.best_index])
+
+
+@dataclasses.dataclass
+class RandomSearch:
+    """Uniform search in the (scaled) range cube — reference ⟦RandomSearch⟧."""
+
+    rescaling: VectorRescaling
+    seed: int = 0
+
+    def search(self, evaluate: EvaluationFunction, n: int) -> SearchResult:
+        rng = np.random.default_rng(self.seed)
+        pts = self.rescaling.sample(rng, n)
+        vals = np.asarray([evaluate(p) for p in pts], float)
+        return SearchResult(pts, vals)
+
+
+@dataclasses.dataclass
+class GaussianProcessSearch:
+    """Sequential Bayesian optimization — reference ⟦GaussianProcessSearch⟧.
+
+    ``n_seed`` random evaluations, then per iteration: slice-sample GP
+    hyperparameters on the unit-cube observations, score a random candidate
+    pool with Expected Improvement, evaluate the argmax.
+    Prior observations can be injected with ``observe`` (the reference's
+    warm-start from past sweeps).
+    """
+
+    rescaling: VectorRescaling
+    n_seed: int = 3
+    n_candidates: int = 512
+    kernel_cls: type = Matern52
+    n_gp_samples: int = 6
+    seed: int = 0
+
+    def __post_init__(self):
+        self._obs_u: list[np.ndarray] = []
+        self._obs_y: list[float] = []
+
+    def observe(self, point_native: np.ndarray, value: float) -> None:
+        self._obs_u.append(self.rescaling.to_unit(point_native)[0])
+        self._obs_y.append(float(value))
+
+    def search(self, evaluate: EvaluationFunction, n: int) -> SearchResult:
+        rng = np.random.default_rng(self.seed)
+        pts: list[np.ndarray] = []
+        vals: list[float] = []
+
+        def run(native: np.ndarray) -> None:
+            v = float(evaluate(native))
+            pts.append(native)
+            vals.append(v)
+            self.observe(native, v)
+            logger.info(
+                "hyperparameter eval %d: %s -> %.6g",
+                len(pts), np.array2string(native, precision=4), v,
+            )
+
+        n_seed = min(self.n_seed, n) if not self._obs_y else min(
+            max(0, self.n_seed - len(self._obs_y)), n
+        )
+        for p in self.rescaling.sample(rng, n_seed):
+            run(p)
+
+        while len(pts) < n:
+            u = np.asarray(self._obs_u, float)
+            y = np.asarray(self._obs_y, float)
+            # Standardize observations for the GP (zero mean unit variance).
+            y_std = float(y.std()) or 1.0
+            y_n = (y - y.mean()) / y_std
+            models = GaussianProcessEstimator(
+                kernel_cls=self.kernel_cls,
+                n_samples=self.n_gp_samples,
+                seed=int(rng.integers(2**31)),
+            ).fit(u, y_n)
+            cand = rng.random((self.n_candidates, self.rescaling.dim))
+            mu, var = predict_mean_var(models, cand)
+            ei = expected_improvement(mu, var, best=float(y_n.min()))
+            run(self.rescaling.from_unit(cand[int(np.argmax(ei))][None, :])[0])
+
+        return SearchResult(np.stack(pts), np.asarray(vals, float))

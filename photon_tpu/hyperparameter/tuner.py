@@ -1,0 +1,108 @@
+"""GAME auto-tuning: Bayesian optimization of per-coordinate regularization.
+
+Parity: the reference wires ⟦GaussianProcessSearch⟧ to ⟦GameEstimator⟧
+through an EvaluationFunction that trains one GAME model per proposed
+hyperparameter vector and returns the validation metric (SURVEY.md §6 config
+(4): "GAME per-user + per-item random effects CTR with Bayesian
+hyperparameter auto-tuning").
+
+Parameters are named ``<coordinateId>.reg_weight``; log scale is the correct
+default for regularization weights.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.estimators import (
+    GameEstimator,
+    GameOptimizationConfiguration,
+    reg_weight_sweep,
+)
+from photon_tpu.estimators.game_estimator import GameFitResult
+from photon_tpu.evaluation import EvaluationSuite
+from photon_tpu.hyperparameter.rescaling import ParamRange, VectorRescaling
+from photon_tpu.hyperparameter.search import (
+    GaussianProcessSearch,
+    RandomSearch,
+    SearchResult,
+)
+from photon_tpu.io.data_reader import GameDataBundle
+
+
+@dataclasses.dataclass(frozen=True)
+class TuningResult:
+    search: SearchResult
+    best_config: GameOptimizationConfiguration
+    # The fully trained result for the best configuration — already fitted
+    # during the search; no refit needed.
+    best_result: Optional[GameFitResult] = None
+
+    @property
+    def best_params(self) -> np.ndarray:
+        return self.search.best_point
+
+
+def tune_regularization(
+    estimator: GameEstimator,
+    train: GameDataBundle,
+    validation: GameDataBundle,
+    base_config: GameOptimizationConfiguration,
+    reg_ranges: Mapping[str, tuple[float, float]],
+    n_iterations: int = 10,
+    strategy: str = "gp",
+    seed: int = 0,
+    initial_model=None,
+) -> TuningResult:
+    """Search per-coordinate reg weights; returns history + best config.
+
+    ``reg_ranges``: coordinate id → (min, max) reg weight, searched on log
+    scale. The objective is the estimator's primary evaluator on validation
+    (negated internally when bigger is better — searches minimize).
+    """
+    if not estimator.evaluator_specs:
+        raise ValueError("estimator needs evaluator_specs for tuning")
+    suite = EvaluationSuite.parse(estimator.evaluator_specs)
+    sign = -1.0 if suite.primary.bigger_is_better else 1.0
+
+    cids = sorted(reg_ranges)
+    rescaling = VectorRescaling(
+        [
+            ParamRange(f"{cid}.reg_weight", lo, hi, scale="log")
+            for cid, (lo, hi) in ((c, reg_ranges[c]) for c in cids)
+        ]
+    )
+
+    def config_for(vec: np.ndarray) -> GameOptimizationConfiguration:
+        # Singleton-axis sweep expansion — shares reg_weight_sweep's
+        # validation and construction (one config out).
+        return reg_weight_sweep(
+            base_config, {cid: [float(w)] for cid, w in zip(cids, vec)}
+        )[0]
+
+    best: dict = {"value": np.inf, "result": None}
+
+    def evaluate(vec: np.ndarray) -> float:
+        result = estimator.fit(
+            train, validation, [config_for(vec)], initial_model=initial_model
+        )[0]
+        v = sign * result.evaluation.primary
+        if v < best["value"]:
+            best["value"] = v
+            best["result"] = result
+        return v
+
+    if strategy == "gp":
+        search = GaussianProcessSearch(rescaling, seed=seed)
+    elif strategy == "random":
+        search = RandomSearch(rescaling, seed=seed)
+    else:
+        raise ValueError(f"strategy must be 'gp' or 'random', got {strategy!r}")
+    history = search.search(evaluate, n_iterations)
+    return TuningResult(
+        search=history,
+        best_config=config_for(history.best_point),
+        best_result=best["result"],
+    )

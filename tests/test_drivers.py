@@ -216,3 +216,27 @@ class TestParamParsing:
         assert s.feature_bags == ("bagA", "bagB")
         assert s.add_intercept is False
         assert parse_feature_shard("g").feature_bags == ("features",)
+
+
+def test_training_driver_auto_tuning(game_data, tmp_path):
+    """--tuning gp replaces the grid sweep with Bayesian optimization of the
+    reg weights (reference: GAME + hyperparameter auto-tuning config)."""
+    d, _, _ = game_data
+    out = tmp_path / "tuned"
+    s = game_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--validation-data", str(d / "val.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate", "fixed:type=fixed,shard=global,reg=L2,max_iter=25",
+        "--coordinate",
+        "perUser:type=random,re_type=userId,shard=global,reg=L2,max_iter=25,reg_weights=1",
+        "--evaluators", "AUC",
+        "--tuning", "gp", "--tuning-iterations", "4",
+        "--tuning-range", "fixed:0.001:100",
+        "--devices", "1",
+    ])
+    assert s["n_configs"] == 1
+    assert s["evaluation"]["AUC"] > 0.6
+    assert 0.001 <= s["best_config"]["fixed"]["reg_weight"] <= 100

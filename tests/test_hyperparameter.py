@@ -1,0 +1,216 @@
+"""Hyperparameter tuning package (SURVEY.md §2.1 H).
+
+Mirrors the reference's ⟦GaussianProcessModelTest, SliceSamplerTest,
+RandomSearchTest/GaussianProcessSearchTest⟧ unit tier: GP posterior math vs
+closed form, sampler correctness on a known distribution, EI properties,
+rescaling round-trips, and search behavior on analytic objectives; plus an
+end-to-end GAME reg-weight tuning run.
+"""
+import numpy as np
+import pytest
+from scipy import stats
+
+from photon_tpu.hyperparameter import (
+    GaussianProcessEstimator,
+    GaussianProcessModel,
+    GaussianProcessSearch,
+    Matern52,
+    ParamRange,
+    RandomSearch,
+    RBF,
+    SliceSampler,
+    VectorRescaling,
+    expected_improvement,
+    predict_mean_var,
+    ranges_from_json,
+    ranges_to_json,
+)
+
+
+class TestKernels:
+    def test_rbf_closed_form(self, rng):
+        x = rng.normal(size=(5, 3))
+        k = RBF(amplitude=2.0, lengthscales=np.asarray([1.0, 2.0, 0.5]))
+        got = k(x, x)
+        for i in range(5):
+            for j in range(5):
+                d2 = np.sum(((x[i] - x[j]) / np.asarray([1.0, 2.0, 0.5])) ** 2)
+                assert got[i, j] == pytest.approx(4.0 * np.exp(-0.5 * d2))
+        assert np.allclose(got, got.T)
+        assert np.all(np.linalg.eigvalsh(got + 1e-9 * np.eye(5)) > 0)
+
+    def test_matern52_properties(self, rng):
+        x = rng.normal(size=(6, 2))
+        k = Matern52(amplitude=1.5, lengthscales=np.asarray([0.7, 1.3]))
+        got = k(x, x)
+        assert np.allclose(np.diag(got), 1.5**2)
+        assert np.allclose(got, got.T)
+        assert np.all(np.linalg.eigvalsh(got + 1e-9 * np.eye(6)) > 0)
+        # decays with distance
+        far = k(np.zeros((1, 2)), np.full((1, 2), 10.0))
+        assert far[0, 0] < 1e-3
+
+
+class TestGaussianProcess:
+    def test_posterior_matches_closed_form(self, rng):
+        """GP posterior mean/var vs the textbook formulas computed directly."""
+        x = rng.normal(size=(8, 2))
+        y = rng.normal(size=8)
+        kern = RBF(1.3, np.asarray([0.9, 1.1]))
+        noise = 0.05
+        m = GaussianProcessModel(x, y, kern, noise=noise)
+        xs = rng.normal(size=(4, 2))
+        mu, var = m.predict(xs)
+
+        K = kern(x, x) + noise * np.eye(8)
+        Ks = kern(x, xs)
+        Kss = kern(xs, xs)
+        mu_ref = Ks.T @ np.linalg.solve(K, y)
+        cov_ref = Kss - Ks.T @ np.linalg.solve(K, Ks)
+        np.testing.assert_allclose(mu, mu_ref, rtol=1e-8, atol=1e-10)
+        np.testing.assert_allclose(var, np.diag(cov_ref), rtol=1e-6, atol=1e-10)
+
+    def test_interpolates_noiseless_data(self, rng):
+        x = np.linspace(0, 1, 7)[:, None]
+        y = np.sin(4 * x[:, 0])
+        m = GaussianProcessModel(x, y, Matern52(1.0, np.asarray([0.3])), noise=1e-8)
+        mu, var = m.predict(x)
+        np.testing.assert_allclose(mu, y, atol=1e-4)
+        assert np.all(var < 1e-4)
+
+    def test_estimator_fits_reasonable_models(self, rng):
+        x = rng.random((20, 1))
+        y = np.sin(6 * x[:, 0]) + 0.05 * rng.normal(size=20)
+        models = GaussianProcessEstimator(n_samples=4, n_burn=8, seed=1).fit(x, y)
+        assert len(models) == 4
+        mu, var = predict_mean_var(models, x)
+        # posterior mean should track the function well at observed points
+        assert np.corrcoef(mu, y)[0, 1] > 0.95
+
+
+class TestSliceSampler:
+    def test_samples_standard_normal(self):
+        s = SliceSampler(lambda x: float(-0.5 * x @ x), seed=3)
+        draws = s.sample(np.zeros(1), n_samples=4000, n_burn=100)
+        _, p = stats.kstest(draws[:, 0], "norm")
+        assert p > 0.01
+        assert abs(draws.mean()) < 0.1
+        assert abs(draws.std() - 1.0) < 0.1
+
+    def test_respects_support(self):
+        """Sampling a distribution truncated to x > 0 stays in support."""
+
+        def logp(x):
+            return float(-x[0]) if x[0] > 0 else -np.inf
+
+        s = SliceSampler(logp, seed=5)
+        draws = s.sample(np.asarray([1.0]), n_samples=500, n_burn=50)
+        assert np.all(draws > 0)
+        assert abs(draws.mean() - 1.0) < 0.2  # Exp(1) mean
+
+    def test_rejects_bad_start(self):
+        s = SliceSampler(lambda x: -np.inf, seed=0)
+        with pytest.raises(ValueError, match="zero-density"):
+            s.sample(np.zeros(2), 1)
+
+
+class TestAcquisition:
+    def test_expected_improvement_properties(self):
+        # candidate below incumbent with no uncertainty: EI = improvement
+        ei = expected_improvement(np.asarray([0.2]), np.asarray([0.0]), best=1.0)
+        assert ei[0] == pytest.approx(0.8)
+        # candidate above incumbent, no uncertainty: EI = 0
+        ei = expected_improvement(np.asarray([2.0]), np.asarray([0.0]), best=1.0)
+        assert ei[0] == 0.0
+        # uncertainty adds value even at the incumbent mean
+        ei = expected_improvement(np.asarray([1.0]), np.asarray([1.0]), best=1.0)
+        assert ei[0] > 0.0
+        # monotone in sigma at fixed mean
+        e1 = expected_improvement(np.asarray([1.0]), np.asarray([0.5]), best=1.0)
+        e2 = expected_improvement(np.asarray([1.0]), np.asarray([2.0]), best=1.0)
+        assert e2[0] > e1[0]
+
+
+class TestRescaling:
+    def test_roundtrip_linear_and_log(self):
+        r = VectorRescaling([
+            ParamRange("a", -2.0, 4.0, "linear"),
+            ParamRange("b", 1e-4, 1e2, "log"),
+        ])
+        x = np.asarray([[1.0, 0.5], [-2.0, 1e-4], [4.0, 1e2]])
+        u = r.to_unit(x)
+        assert np.all((u >= 0) & (u <= 1))
+        np.testing.assert_allclose(r.from_unit(u), x, rtol=1e-12)
+
+    def test_json_roundtrip(self):
+        ranges = [ParamRange("fixed.reg_weight", 0.01, 100.0, "log")]
+        parsed = ranges_from_json(ranges_to_json(ranges))
+        assert parsed == ranges
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="max > min"):
+            ParamRange("x", 1.0, 1.0)
+        with pytest.raises(ValueError, match="log scale"):
+            ParamRange("x", -1.0, 1.0, "log")
+        with pytest.raises(ValueError, match="linear|log"):
+            ParamRange("x", 0.0, 1.0, "cubic")
+
+
+def _branin(v):
+    """Classic BO test function on [-5,10]x[0,15]; min ≈ 0.3979."""
+    x, y = v[0], v[1]
+    a, b, c = 1.0, 5.1 / (4 * np.pi**2), 5 / np.pi
+    r, s, t = 6.0, 10.0, 1 / (8 * np.pi)
+    return a * (y - b * x**2 + c * x - r) ** 2 + s * (1 - t) * np.cos(x) + s
+
+
+class TestSearch:
+    RESCALING = VectorRescaling([
+        ParamRange("x", -5.0, 10.0), ParamRange("y", 0.0, 15.0),
+    ])
+
+    def test_random_search_covers_space(self):
+        res = RandomSearch(self.RESCALING, seed=0).search(_branin, 30)
+        assert len(res.values) == 30
+        assert res.best_value < 10.0
+
+    def test_gp_search_beats_random_on_branin(self):
+        n = 20
+        gp = GaussianProcessSearch(self.RESCALING, n_seed=5, seed=0).search(_branin, n)
+        rnd = RandomSearch(self.RESCALING, seed=0).search(_branin, n)
+        assert len(gp.values) == n
+        assert gp.best_value < rnd.best_value + 1e-9
+        assert gp.best_value < 2.0  # close to the 0.398 optimum
+
+    def test_gp_search_warm_start_observations(self):
+        s = GaussianProcessSearch(self.RESCALING, n_seed=3, seed=1)
+        s.observe(np.asarray([np.pi, 2.275]), _branin([np.pi, 2.275]))  # near-opt
+        res = s.search(_branin, 5)
+        assert len(res.values) == 5
+        # warm-start observation participates in the GP (incumbent across all
+        # observed points is the injected near-optimum)
+        assert min(s._obs_y) == pytest.approx(_branin([np.pi, 2.275]))
+        assert len(s._obs_y) == 6  # 1 injected + 5 evaluated
+
+
+def test_tune_game_regularization(rng):
+    """End-to-end: BO over the fixed effect's reg weight on synthetic GLMix
+    data must return a sane best config (SURVEY.md §6 config (4))."""
+    from tests.test_estimator import BASE, _bundle, _estimator
+
+    from photon_tpu.hyperparameter import tune_regularization
+
+    train, val = _bundle(rng), _bundle(rng, seed_shift=1)
+    est = _estimator(n_sweeps=1)
+    result = tune_regularization(
+        est, train, val, BASE,
+        reg_ranges={"fixed": (1e-3, 1e3)},
+        n_iterations=6, seed=0,
+    )
+    assert len(result.search.values) == 6
+    assert 1e-3 <= result.best_config["fixed"].reg_weight <= 1e3
+    # best found AUC (values are negated AUC) should beat heavy regularization
+    heavy = est.fit(
+        train, val, [{**BASE, "fixed": BASE["fixed"].with_reg_weight(1e3)}]
+    )[0].evaluation.primary
+    assert -result.search.best_value >= heavy - 1e-9
