@@ -245,6 +245,10 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 raise ValueError("--tuning needs --evaluators and --validation-data")
             if not args.tuning_range:
                 raise ValueError("--tuning needs at least one --tuning-range CID:MIN:MAX")
+            if args.tuning_iterations < 1:
+                raise ValueError(
+                    f"--tuning-iterations must be >= 1, got {args.tuning_iterations}"
+                )
             if len(configs) > 1:
                 raise ValueError(
                     "--tuning replaces the reg-weight grid sweep; remove the "

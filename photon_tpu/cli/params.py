@@ -15,7 +15,8 @@ keys: ``type`` fixed|random (required); ``shard`` feature shard id;
 ``min_rows`` int; ``optimizer`` LBFGS|OWLQN|TRON; ``max_iter`` int; ``tol``
 float; ``reg`` NONE|L1|L2|ELASTIC_NET; ``alpha`` elastic-net α;
 ``reg_weights`` '|'-separated floats (sweep, default 0); ``downsample`` rate;
-``variance`` NONE|SIMPLE|FULL.
+``variance`` NONE|SIMPLE|FULL; ``incremental`` prior weight for incremental
+training from --model-input-dir (requires it).
 
 Example:
     --coordinate "fixed:type=fixed,shard=global,optimizer=LBFGS,reg=L2,reg_weights=0.1|1|10"
@@ -75,7 +76,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
     known = {
         "type", "shard", "re_type", "active_bound", "min_rows", "optimizer",
         "max_iter", "tol", "reg", "alpha", "reg_weights", "downsample",
-        "variance",
+        "variance", "incremental",
     }
     unknown = set(kv) - known
     if unknown:
@@ -115,6 +116,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
         regularization=reg_ctx,
         down_sampling_rate=float(kv.get("downsample", 1.0)),
         variance_type=VarianceComputationType(kv.get("variance", "NONE").upper()),
+        incremental_weight=float(kv.get("incremental", 0.0)),
     )
     weights = tuple(
         float(w) for w in kv.get("reg_weights", "0").split("|") if w != ""

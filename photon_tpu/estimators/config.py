@@ -68,8 +68,16 @@ class GLMOptimizationConfiguration:
     reg_weight: float = 0.0
     down_sampling_rate: float = 1.0
     variance_type: VarianceComputationType = VarianceComputationType.NONE
+    # Incremental training: weight of the Gaussian prior built from the
+    # estimator's initial_model posterior (0 = plain warm start, no prior).
+    # Reference ⟦PriorDistribution⟧ / incremental-training params.
+    incremental_weight: float = 0.0
 
     def __post_init__(self):
+        if self.incremental_weight < 0.0:
+            raise ValueError(
+                f"incremental_weight must be >= 0, got {self.incremental_weight}"
+            )
         if not (0.0 < self.down_sampling_rate <= 1.0):
             raise ValueError(
                 f"down_sampling_rate must be in (0, 1], got {self.down_sampling_rate}"

@@ -184,7 +184,9 @@ class GameEstimator:
         results: list[GameFitResult] = []
         for i, cfg in enumerate(configs):
             logger.info("=== configuration %d/%d ===", i + 1, len(configs))
-            coordinates = self._build_coordinates(prep, cfg, config_index=i)
+            coordinates = self._build_coordinates(
+                prep, cfg, config_index=i, initial_model=initial_model
+            )
             descent = CoordinateDescent(
                 update_sequence=tuple(self.update_sequence),
                 n_sweeps=self.n_sweeps,
@@ -267,6 +269,7 @@ class GameEstimator:
         prep: dict,
         cfg: GameOptimizationConfiguration,
         config_index: int,
+        initial_model: Optional[GameModel] = None,
     ) -> dict[str, Coordinate]:
         # Coordinates are built for EVERY data config, not just the update
         # sequence: coordinates outside the sequence are scoring-only (locked
@@ -279,11 +282,33 @@ class GameEstimator:
             problem = ocfg.problem(self.task)
             intercept = self._intercept_for(dcfg.feature_shard)
 
+            init_m = (
+                initial_model.models.get(cid)
+                if initial_model is not None and ocfg.incremental_weight > 0.0
+                else None
+            )
+            if ocfg.incremental_weight > 0.0 and init_m is None:
+                raise ValueError(
+                    f"coordinate {cid!r}: incremental_weight > 0 requires an "
+                    "initial_model containing this coordinate"
+                )
+
             if isinstance(dcfg, FixedEffectDataConfig):
                 batch: LabeledBatch = prep["train"][cid]
                 mask = intercept_reg_mask(batch.dim, intercept)
                 if mask is not None:
                     problem = dataclasses.replace(problem, reg_mask=mask)
+                if init_m is not None:
+                    from photon_tpu.functions.prior import PriorDistribution
+
+                    problem = dataclasses.replace(
+                        problem,
+                        prior=PriorDistribution.from_model(
+                            init_m.model.coefficients.means,
+                            init_m.model.coefficients.variances,
+                            ocfg.incremental_weight,
+                        ),
+                    )
                 if ocfg.down_sampling_rate < 1.0:
                     # Per-(config, coordinate) derived key, reproducible.
                     key = jax.random.fold_in(
@@ -321,6 +346,26 @@ class GameEstimator:
                         key,
                     )
                 mask = intercept_reg_mask(dataset.global_dim, intercept)
+                priors = None
+                if init_m is not None:
+                    from photon_tpu.functions.prior import PriorDistribution
+
+                    # Posterior projection is config-independent (down-sampled
+                    # datasets keep the bucket structure); cache it across the
+                    # sweep and only rescale precisions per config.
+                    cache = prep.setdefault("prior_proj", {})
+                    ck = (cid, id(init_m))
+                    if ck not in cache:
+                        cache[ck] = init_m.project_posteriors_to(
+                            prep["train"][cid]
+                        )
+                    means, variances = cache[ck]
+                    priors = [
+                        PriorDistribution.from_model(
+                            m, v, ocfg.incremental_weight
+                        )
+                        for m, v in zip(means, variances)
+                    ]
                 coordinates[cid] = RandomEffectCoordinate(
                     dataset=dataset,
                     problem=problem,
@@ -328,6 +373,7 @@ class GameEstimator:
                     entity_axis=self.data_axis,
                     global_reg_mask=mask,
                     normalization=prep["norm"][dcfg.feature_shard],
+                    priors=priors,
                 )
         return coordinates
 

@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from photon_tpu.data.batch import LabeledBatch
+from photon_tpu.functions.prior import PriorDistribution
 from photon_tpu.ops.losses import PointwiseLoss
 
 Array = jax.Array
@@ -43,6 +44,10 @@ class GLMObjective:
     loss: PointwiseLoss
     l2_weight: float = 0.0
     reg_mask: Optional[Array] = None
+    # Gaussian prior from a previous model (incremental training); its terms
+    # add to value/grad/HVP/diag. Reference ⟦PriorDistributionDiff⟧ mixes the
+    # same terms into the diff function.
+    prior: Optional["PriorDistribution"] = None
 
     # -- core --------------------------------------------------------------
 
@@ -56,7 +61,10 @@ class GLMObjective:
     def value(self, w: Array, batch: LabeledBatch) -> Array:
         z = batch.features.matvec(w) + batch.offsets
         data_term = jnp.sum(batch.weights * self.loss.loss(z, batch.labels))
-        return data_term + 0.5 * jnp.sum(self._l2_vec(w) * w * w)
+        out = data_term + 0.5 * jnp.sum(self._l2_vec(w) * w * w)
+        if self.prior is not None:
+            out = out + self.prior.value(w)
+        return out
 
     def value_and_grad(self, w: Array, batch: LabeledBatch) -> tuple[Array, Array]:
         """Hand-fused single pass: z → (ℓ, dℓ/dz) → Xᵀ(w·dz) + L2 terms.
@@ -72,6 +80,9 @@ class GLMObjective:
         lam = self._l2_vec(w)
         lv = lv + 0.5 * jnp.sum(lam * w * w)
         g = g + lam * w
+        if self.prior is not None:
+            lv = lv + self.prior.value(w)
+            g = g + self.prior.gradient(w)
         return lv, g
 
     def hessian_vector(self, w: Array, v: Array, batch: LabeledBatch) -> Array:
@@ -83,14 +94,20 @@ class GLMObjective:
         z = batch.features.matvec(w) + batch.offsets
         d2 = batch.weights * self.loss.d2(z, batch.labels)
         hv = batch.features.rmatvec(d2 * batch.features.matvec(v))
-        return hv + self._l2_vec(v) * v
+        hv = hv + self._l2_vec(v) * v
+        if self.prior is not None:
+            hv = hv + self.prior.hessian_vector(v)
+        return hv
 
     def hessian_diagonal(self, w: Array, batch: LabeledBatch) -> Array:
         """diag(H) = Σᵢ wᵢ d2ᵢ xᵢⱼ² + λ·mask — reference ⟦HessianDiagonalAggregator⟧."""
         z = batch.features.matvec(w) + batch.offsets
         d2 = batch.weights * self.loss.d2(z, batch.labels)
         diag = batch.features.sq_rmatvec(d2)
-        return diag + self._l2_vec(w)
+        diag = diag + self._l2_vec(w)
+        if self.prior is not None:
+            diag = diag + self.prior.hessian_diagonal()
+        return diag
 
     # -- closure builders for the optimizers --------------------------------
 

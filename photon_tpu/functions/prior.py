@@ -1,0 +1,67 @@
+"""Gaussian prior from a previous model posterior — incremental training.
+
+Parity: reference ⟦photon-lib/.../function/PriorDistribution.scala,
+PriorDistributionDiff⟧ (SURVEY.md §2.1 "Prior/warm-start", §5.4): retraining
+on new data penalizes deviation from the previous model's posterior,
+per-coefficient:
+
+    P(w) = (λ_inc / 2) Σⱼ (wⱼ − μⱼ)² / σⱼ²
+
+where (μ, σ²) are the previous coefficients' means and variances (variance
+defaults to 1 where the previous run computed none) and λ_inc is the
+incremental-training weight. Value/gradient/HVP/diagonal terms add directly
+to the smooth objective — unlike L1, a Gaussian prior is smooth, so every
+optimizer supports it.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PriorDistribution:
+    """Per-coefficient Gaussian prior: ``precisions`` already folds in the
+    incremental weight (λ_inc/σ²), so the penalty is
+    ½ Σ precⱼ (wⱼ − μⱼ)². Zero precision ⇒ no prior on that coefficient
+    (used for ghost/padding slots in projected per-entity priors)."""
+
+    means: Array        # [D]
+    precisions: Array   # [D]
+
+    @staticmethod
+    def from_model(
+        means: Array,
+        variances: Optional[Array],
+        incremental_weight: float = 1.0,
+        min_variance: float = 1e-12,
+    ) -> "PriorDistribution":
+        """Reference ⟦PriorDistribution.apply⟧: previous posterior → prior;
+        missing variances default to 1 (unit-variance prior)."""
+        means = jnp.asarray(means)
+        if variances is None:
+            var = jnp.ones_like(means)
+        else:
+            var = jnp.maximum(jnp.asarray(variances), min_variance)
+        return PriorDistribution(
+            means=means, precisions=incremental_weight / var
+        )
+
+    def value(self, w: Array) -> Array:
+        d = w - self.means
+        return 0.5 * jnp.sum(self.precisions * d * d)
+
+    def gradient(self, w: Array) -> Array:
+        return self.precisions * (w - self.means)
+
+    def hessian_vector(self, v: Array) -> Array:
+        return self.precisions * v
+
+    def hessian_diagonal(self) -> Array:
+        return self.precisions

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.data.normalization import NormalizationContext
 from photon_tpu.functions.objective import GLMObjective
+from photon_tpu.functions.prior import PriorDistribution
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import GeneralizedLinearModel
 from photon_tpu.ops.losses import loss_for_task
@@ -64,12 +65,20 @@ class GLMOptimizationProblem:
     reg_weight: float = 0.0
     variance_type: VarianceComputationType = VarianceComputationType.NONE
     reg_mask: Optional[Array] = None
+    # Incremental-training prior (array-valued, stripped from the jit key
+    # like reg_mask). Reference ⟦PriorDistribution⟧.
+    prior: Optional["PriorDistribution"] = None
 
-    def objective(self, reg_mask: Optional[Array] = None) -> GLMObjective:
+    def objective(
+        self,
+        reg_mask: Optional[Array] = None,
+        prior: Optional["PriorDistribution"] = None,
+    ) -> GLMObjective:
         return GLMObjective(
             loss=loss_for_task(self.task),
             l2_weight=self.regularization.l2_weight(self.reg_weight),
             reg_mask=self.reg_mask if reg_mask is None else reg_mask,
+            prior=self.prior if prior is None else prior,
         )
 
     def fit(
@@ -78,21 +87,24 @@ class GLMOptimizationProblem:
         w0: Array,
         reg_mask: Optional[Array] = None,
         normalization: Optional["NormalizationContext"] = None,
+        prior: Optional["PriorDistribution"] = None,
     ) -> tuple[GeneralizedLinearModel, OptimizerResult]:
         """Jitted ``run`` with a process-wide compilation cache.
 
-        The problem (minus any array-valued ``reg_mask``, which is passed as
-        a dynamic argument) is the static jit key, so repeated fits with the
-        same config and shapes — every coordinate-descent step — reuse one
-        XLA executable instead of re-tracing a fresh ``jax.jit(problem.run)``.
+        The problem (minus array-valued ``reg_mask``/``prior``, which are
+        passed as dynamic arguments) is the static jit key, so repeated fits
+        with the same config and shapes — every coordinate-descent step —
+        reuse one XLA executable instead of re-tracing a fresh
+        ``jax.jit(problem.run)``.
         """
         mask = reg_mask if reg_mask is not None else self.reg_mask
+        pr = prior if prior is not None else self.prior
         key = (
-            dataclasses.replace(self, reg_mask=None)
-            if self.reg_mask is not None
+            dataclasses.replace(self, reg_mask=None, prior=None)
+            if (self.reg_mask is not None or self.prior is not None)
             else self
         )
-        return _fit_jitted(key, batch, w0, mask, normalization)
+        return _fit_jitted(key, batch, w0, mask, pr, normalization)
 
     def run(
         self,
@@ -100,6 +112,7 @@ class GLMOptimizationProblem:
         w0: Array,
         reg_mask: Optional[Array] = None,
         normalization: Optional["NormalizationContext"] = None,
+        prior: Optional["PriorDistribution"] = None,
     ) -> tuple[GeneralizedLinearModel, OptimizerResult]:
         """Full solve. ``reg_mask`` overrides the static ``self.reg_mask`` —
         used by random effects, where each vmapped entity solve carries its
@@ -110,7 +123,7 @@ class GLMOptimizationProblem:
         reference — SURVEY.md §7 hard-part #5) against the *raw* sparse
         features, and the returned model is mapped back to original space.
         """
-        obj = self.objective(reg_mask)
+        obj = self.objective(reg_mask, prior)
         norm = normalization if normalization is not None and not normalization.is_identity else None
         if norm is None:
             vg = obj.bind(batch)
@@ -200,5 +213,5 @@ class GLMOptimizationProblem:
 
 
 @partial(jax.jit, static_argnums=0)
-def _fit_jitted(problem: GLMOptimizationProblem, batch, w0, reg_mask, normalization):
-    return problem.run(batch, w0, reg_mask, normalization)
+def _fit_jitted(problem: GLMOptimizationProblem, batch, w0, reg_mask, prior, normalization):
+    return problem.run(batch, w0, reg_mask, normalization, prior)

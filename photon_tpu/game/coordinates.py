@@ -12,7 +12,7 @@ order (fixed at dataset build time), so the reference's score-RDD joins by
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Union
+from typing import Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -84,6 +84,9 @@ class RandomEffectCoordinate:
     entity_axis: str = "data"
     global_reg_mask: Optional[Array] = None
     normalization: Optional[object] = None   # shard-level NormalizationContext
+    # Per-bucket PriorDistribution pytrees for incremental training
+    # (RandomEffectModel.project_prior_to output).
+    priors: Optional[Sequence] = None
 
     def _same_structure(self, model: RandomEffectModel) -> bool:
         # A model trained on THIS dataset (every coordinate-descent sweep)
@@ -110,6 +113,7 @@ class RandomEffectCoordinate:
             global_reg_mask=self.global_reg_mask,
             init_coefs=self._init_coefs(init),
             normalization=self.normalization,
+            priors=self.priors,
         )
 
     def score(self, model: RandomEffectModel) -> Array:

@@ -71,6 +71,20 @@ class RandomEffectModel:
         valid = proj < self.global_dim
         return proj[valid].astype(np.int64), coefs[valid]
 
+    def variances_for(self, entity_key) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """Sparse posterior variances for one entity (same index set as
+        ``coefficients_for``), or None if variances were not computed."""
+        if self.bucket_variances is None:
+            return None
+        dense = self._key_to_dense.get(entity_key)
+        if dense is None:
+            return np.zeros(0, np.int64), np.zeros(0, np.float32)
+        b, lane = self.entity_to_slot[dense]
+        proj = np.asarray(self.bucket_proj[b][lane])
+        var = np.asarray(self.bucket_variances[b][lane])
+        valid = proj < self.global_dim
+        return proj[valid].astype(np.int64), var[valid]
+
     def score_dataset(self, dataset: RandomEffectDataset) -> Array:
         """Scores for every row of the dataset this model was trained on
         (or any dataset with identical bucket structure)."""
@@ -79,19 +93,29 @@ class RandomEffectModel:
         ]
         return dataset.scatter_scores(per_bucket)
 
-    def project_to(self, dataset: RandomEffectDataset) -> list[Array]:
-        """Coefficient stacks re-projected into a *different* dataset's local
-        subspaces (validation / scoring data). Host-side per-entity remap —
-        the reference's model-RDD join by REId (SURVEY.md §3.6); entities
-        unseen at training time get the zero model."""
+    def _project_stacks(
+        self,
+        dataset: RandomEffectDataset,
+        sources: Sequence[Sequence[Array]],
+        fills: Sequence[float],
+    ) -> list[list[Array]]:
+        """Project per-entity [E, P] stacks (aligned with this model's bucket
+        structure) into ``dataset``'s local subspaces, several value sets in
+        ONE pass over the entities. Host-side per-entity remap — the
+        reference's model-RDD join by REId (SURVEY.md §3.6).
+        Entities/columns absent from this model get the per-source fill.
+        Returns one projected per-bucket list per source."""
         key_to_dense = self._key_to_dense
         old_proj = [np.asarray(p) for p in self.bucket_proj]
-        old_coefs = [np.asarray(c) for c in self.bucket_coefs]
-        out = []
+        old_vals = [[np.asarray(c) for c in src] for src in sources]
+        out: list[list[Array]] = [[] for _ in sources]
         for b in dataset.buckets:
             proj = np.asarray(b.proj)
             eids = np.asarray(b.entity_ids)
-            coefs = np.zeros(proj.shape, old_coefs[0].dtype)
+            vals = [
+                np.full(proj.shape, fill, src[0].dtype)
+                for src, fill in zip(old_vals, fills)
+            ]
             for lane in range(b.n_entities):
                 dense_new = eids[lane]
                 if dense_new < 0:
@@ -101,18 +125,54 @@ class RandomEffectModel:
                     continue
                 bo, lo = self.entity_to_slot[dense_old]
                 pv = old_proj[bo][lo]
-                cv = old_coefs[bo][lo]
                 valid = pv < self.global_dim
-                gi, gv = pv[valid], cv[valid]
+                gi = pv[valid]
                 if len(gi) == 0:
                     continue
                 # match new local columns against the trained sparse vector
                 cols_new = proj[lane]
                 pos = np.clip(np.searchsorted(gi, cols_new), 0, len(gi) - 1)
                 hit = gi[pos] == cols_new
-                coefs[lane][hit] = gv[pos[hit]]
-            out.append(jnp.asarray(coefs))
+                for s, src in enumerate(old_vals):
+                    gv = src[bo][lo][valid]
+                    vals[s][lane][hit] = gv[pos[hit]]
+            for s, v in enumerate(vals):
+                out[s].append(jnp.asarray(v))
         return out
+
+    def project_to(self, dataset: RandomEffectDataset) -> list[Array]:
+        """Coefficient stacks re-projected into a *different* dataset's local
+        subspaces (validation / scoring data); entities unseen at training
+        time get the zero model."""
+        return self._project_stacks(dataset, [self.bucket_coefs], [0.0])[0]
+
+    def project_posteriors_to(
+        self, dataset: RandomEffectDataset
+    ) -> tuple[list[Array], list[Array]]:
+        """(means, variances) per-bucket stacks projected into ``dataset`` in
+        one entity pass — the raw material for incremental-training priors.
+        Unseen entities/columns get the N(0, 1) default posterior."""
+        if self.bucket_variances is not None:
+            means, variances = self._project_stacks(
+                dataset, [self.bucket_coefs, self.bucket_variances], [0.0, 1.0]
+            )
+        else:
+            means = self.project_to(dataset)
+            variances = [jnp.ones_like(m) for m in means]
+        return means, variances
+
+    def project_prior_to(
+        self, dataset: RandomEffectDataset, incremental_weight: float = 1.0
+    ) -> list:
+        """Per-bucket PriorDistribution pytrees ([E, P] leaves) for
+        incremental training on ``dataset`` (reference ⟦PriorDistribution⟧)."""
+        from photon_tpu.functions.prior import PriorDistribution
+
+        means, variances = self.project_posteriors_to(dataset)
+        return [
+            PriorDistribution.from_model(m, v, incremental_weight)
+            for m, v in zip(means, variances)
+        ]
 
     def score_new_dataset(self, dataset: RandomEffectDataset) -> Array:
         """Scores for a dataset built from different rows (e.g. validation)."""
@@ -151,15 +211,17 @@ def _pad_bucket(
 
 
 @partial(jax.jit, static_argnums=0)
-def _fit_bucket_jitted(problem, batches, w0, local_mask, local_norm):
+def _fit_bucket_jitted(problem, batches, w0, local_mask, local_norm, local_prior):
     """One vmapped bucket solve; static problem key keeps the XLA executable
     cached across coordinate-descent sweeps (same config + bucket shapes).
-    ``local_norm`` is a per-entity LocalNormalizationContext pytree (leaves
-    [E, P]) or None."""
+    ``local_norm`` / ``local_prior`` are per-entity pytrees (leaves [E, P])
+    or None."""
     return jax.vmap(
-        lambda b, w, m, nm: problem.run(b, w, reg_mask=m, normalization=nm),
-        in_axes=(0, 0, 0, 0),
-    )(batches, w0, local_mask, local_norm)
+        lambda b, w, m, nm, pr: problem.run(
+            b, w, reg_mask=m, normalization=nm, prior=pr
+        ),
+        in_axes=(0, 0, 0, 0, 0),
+    )(batches, w0, local_mask, local_norm, local_prior)
 
 
 def train_random_effects(
@@ -171,6 +233,7 @@ def train_random_effects(
     global_reg_mask: Optional[Array] = None,
     init_coefs: Optional[Sequence[Array]] = None,
     normalization=None,
+    priors: Optional[Sequence] = None,
 ) -> tuple[RandomEffectModel, list[OptimizerResult]]:
     """Fit one GLM per entity; returns the model + per-bucket solver results.
 
@@ -179,7 +242,9 @@ def train_random_effects(
     ``global_reg_mask`` (e.g. 0 on the intercept column) is projected into
     each entity's local subspace, as is the shard-level ``normalization``
     context (reference: one NormalizationContext per feature shard applies to
-    every per-entity solve too).
+    every per-entity solve too). ``priors`` is an optional per-bucket list of
+    PriorDistribution pytrees ([E, P] leaves — see
+    ``RandomEffectModel.project_prior_to``) for incremental training.
     """
     from photon_tpu.data.normalization import project_context
 
@@ -217,6 +282,13 @@ def train_random_effects(
             if normalization is not None
             else None
         )
+        local_prior = priors[b_i] if priors is not None else None
+        if local_prior is not None and local_prior.means.shape[0] < e:
+            # mesh padding added inert lanes: extend with zero-precision rows
+            pad = e - local_prior.means.shape[0]
+            local_prior = jax.tree.map(
+                lambda a: jnp.pad(a, ((0, pad), (0, 0))), local_prior
+            )
 
         if mesh is not None:
             shard = lambda leaf: jax.device_put(
@@ -226,8 +298,11 @@ def train_random_effects(
             w0 = shard(w0)
             local_mask = shard(local_mask)
             local_norm = jax.tree.map(shard, local_norm)
+            local_prior = jax.tree.map(shard, local_prior)
 
-        models, result = _fit_bucket_jitted(problem, batches, w0, local_mask, local_norm)
+        models, result = _fit_bucket_jitted(
+            problem, batches, w0, local_mask, local_norm, local_prior
+        )
         coefs_out.append(models.coefficients.means[:orig_e])
         if want_var:
             var_out.append(models.coefficients.variances[:orig_e])

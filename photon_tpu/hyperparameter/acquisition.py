@@ -27,8 +27,10 @@ def expected_improvement(
     ``mu``/``var`` are the surrogate posterior at candidate points; ``best``
     is the incumbent (lowest observed value); ``xi`` trades off exploration.
     """
-    sigma = np.sqrt(np.maximum(var, 1e-18))
+    sigma = np.sqrt(np.maximum(var, 0.0))
     imp = best - xi - mu
-    z = imp / sigma
+    safe = np.where(sigma > 0.0, sigma, 1.0)
+    z = imp / safe
     ei = imp * _norm_cdf(z) + sigma * _norm_pdf(z)
-    return np.where(sigma > 1e-12, ei, np.maximum(imp, 0.0))
+    # Zero-uncertainty candidates degenerate to the deterministic improvement.
+    return np.where(sigma > 0.0, ei, np.maximum(imp, 0.0))

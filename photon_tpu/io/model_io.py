@@ -129,12 +129,17 @@ def save_game_model(
             def entity_records(m=m, imap=imap):
                 for key in m.entity_keys:
                     gi, gv = m.coefficients_for(key)
+                    var = m.variances_for(key)
                     yield {
                         "modelId": str(key),
                         "modelClass": _MODEL_CLASS[m.task],
                         "lossFunction": m.task.value,
                         "means": _nt_list(imap, gi, gv),
-                        "variances": None,
+                        "variances": (
+                            _nt_list(imap, var[0], var[1])
+                            if var is not None
+                            else None
+                        ),
                     }
 
             write_container(
@@ -197,13 +202,23 @@ def load_game_model(
                 for p in os.listdir(cdir)
                 if p.endswith(".avro")
             )
-            entity_keys, sparse = [], []
+            entity_keys, sparse, sparse_var = [], [], []
             for part in parts:
                 for rec in read_records(part):
                     entity_keys.append(rec["modelId"])
                     sparse.append(_from_nt_list(imap, rec["means"]))
+                    # null = variances not computed; [] = entity with no
+                    # active features (still "has variances" as a coordinate)
+                    sparse_var.append(
+                        _from_nt_list(imap, rec["variances"])
+                        if rec.get("variances") is not None
+                        else None
+                    )
+            if any(v is None for v in sparse_var):
+                sparse_var = None
             models[cid] = _synthetic_random_effect_model(
-                info.get("re_type", cid), task, entity_keys, sparse, len(imap)
+                info.get("re_type", cid), task, entity_keys, sparse, len(imap),
+                sparse_var,
             )
         else:
             raise ValueError(f"{cid}: unknown coordinate type {info['type']}")
@@ -216,6 +231,7 @@ def _synthetic_random_effect_model(
     entity_keys: list,
     sparse: list,
     global_dim: int,
+    sparse_var: list = None,
 ) -> RandomEffectModel:
     """Pack loaded per-entity sparse vectors into a single padded bucket."""
     p = max((len(gi) for gi, _ in sparse), default=1)
@@ -223,10 +239,21 @@ def _synthetic_random_effect_model(
     e = max(len(entity_keys), 1)
     proj = np.full((e, p), global_dim, np.int32)
     coefs = np.zeros((e, p), np.float32)
+    var = np.zeros((e, p), np.float32) if sparse_var is not None else None
     for lane, (gi, gv) in enumerate(sparse):
         order = np.argsort(gi)  # projection maps are sorted by global column
         proj[lane, : len(gi)] = gi[order]
         coefs[lane, : len(gi)] = gv[order]
+        if var is not None:
+            vi, vv = sparse_var[lane]
+            # means/variances share the index set on save; align defensively
+            vorder = np.argsort(vi)
+            if len(vi) != len(gi) or np.any(vi[vorder] != gi[order]):
+                raise ValueError(
+                    f"{re_type}: variance indices differ from mean indices "
+                    f"for entity {entity_keys[lane]!r}"
+                )
+            var[lane, : len(vi)] = vv[vorder]
     return RandomEffectModel(
         re_type=re_type,
         task=task,
@@ -236,6 +263,7 @@ def _synthetic_random_effect_model(
         entity_keys=list(entity_keys),
         entity_to_slot={i: (0, i) for i in range(len(entity_keys))},
         global_dim=global_dim,
+        bucket_variances=[jnp.asarray(var)] if var is not None else None,
     )
 
 

@@ -63,18 +63,23 @@ def fit_data_parallel(
     batch = shard_batch_pytree(batch, mesh, data_axis)
     rep = replicated(mesh)
     w0 = jax.device_put(w0, rep)
-    # Array-valued reg_mask / normalization can't be part of the static jit
-    # key; pass them dynamically (same convention as GLMOptimizationProblem.fit).
-    mask = problem.reg_mask
-    key = dataclasses.replace(problem, reg_mask=None) if mask is not None else problem
-    return _fit_dp_jitted(key, rep, batch, w0, mask, normalization)
+    # Array-valued reg_mask / prior / normalization can't be part of the
+    # static jit key; pass them dynamically (same convention as
+    # GLMOptimizationProblem.fit).
+    mask, prior = problem.reg_mask, problem.prior
+    key = (
+        dataclasses.replace(problem, reg_mask=None, prior=None)
+        if (mask is not None or prior is not None)
+        else problem
+    )
+    return _fit_dp_jitted(key, rep, batch, w0, mask, prior, normalization)
 
 
 @partial(jax.jit, static_argnums=(0, 1))
-def _fit_dp_jitted(problem, out_sharding, batch, w0, reg_mask, normalization):
+def _fit_dp_jitted(problem, out_sharding, batch, w0, reg_mask, prior, normalization):
     # out_sharding (a NamedSharding: hashable) is applied via lax constraint
     # so the whole (problem, sharding) pair stays one cached executable.
-    model, result = problem.run(batch, w0, reg_mask, normalization)
+    model, result = problem.run(batch, w0, reg_mask, normalization, prior)
     return jax.tree.map(
         lambda a: jax.lax.with_sharding_constraint(a, out_sharding),
         (model, result),
