@@ -96,6 +96,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="prebuilt per-shard mmap index maps (else built from training data)")
     p.add_argument("--devices", type=int, default=0,
                    help="data-parallel mesh size; 0 = all visible devices, 1 = no mesh")
+    p.add_argument("--mesh", default=None, metavar="data=4,model=2",
+                   help="explicit 2D mesh axes; a 'model' axis shards fixed-effect "
+                        "coefficients/optimizer state over it (overrides --devices)")
     p.add_argument("--offset-column", default="offset")
     p.add_argument("--weight-column", default="weight")
     p.add_argument("--response-column", default="response")
@@ -128,12 +131,29 @@ def _load_or_build_indexes(args, shard_specs, logger):
     return shard_cfgs, index_maps
 
 
-def _make_mesh(n_devices: int):
+def _make_mesh(n_devices: int, mesh_spec: Optional[str] = None):
     import jax
 
     from photon_tpu.parallel.mesh import DATA_AXIS, make_mesh
 
     avail = len(jax.devices())
+    if mesh_spec:
+        axes = {}
+        for item in mesh_spec.split(","):
+            name, sep, size = item.partition("=")
+            if not sep:
+                raise ValueError(f"--mesh items must be axis=size, got {item!r}")
+            axes[name.strip()] = int(size)
+        if DATA_AXIS not in axes:
+            raise ValueError(
+                f"--mesh must include the '{DATA_AXIS}' axis (got {sorted(axes)})"
+            )
+        total = 1
+        for s in axes.values():
+            total *= s
+        if total > avail:
+            raise ValueError(f"--mesh needs {total} devices, have {avail}")
+        return make_mesh(axes, devices=jax.devices()[:total])
     n = avail if n_devices == 0 else n_devices
     if n > avail:
         raise ValueError(f"--devices {n} > {avail} visible devices")
@@ -146,6 +166,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     """Run training; returns a result summary dict (also written to disk)."""
     args = build_arg_parser().parse_args(argv)
     task = TaskType[args.task]
+    if args.mesh and "model" in args.mesh and args.normalization != "NONE":
+        raise ValueError(
+            "--normalization with a 'model' mesh axis is not supported yet "
+            "(model-parallel fixed-effect training has no normalization path)"
+        )
 
     os.makedirs(args.output_dir, exist_ok=True)
     with PhotonLogger(args.output_dir) as logger:
@@ -223,9 +248,12 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     args.model_input_dir, index_maps
                 )
 
-        mesh = _make_mesh(args.devices)
+        mesh = _make_mesh(args.devices, args.mesh)
         if mesh is not None:
             logger.info("mesh: %s", mesh)
+        model_axis = (
+            "model" if mesh is not None and "model" in mesh.shape else None
+        )
 
         estimator = GameEstimator(
             task=task,
@@ -238,6 +266,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 s: im.intercept_index for s, im in index_maps.items()
             },
             mesh=mesh,
+            model_axis=model_axis,
         )
 
         if args.tuning:

@@ -132,6 +132,9 @@ class GameEstimator:
     intercept_indices: Optional[Mapping[str, int]] = None
     mesh: Optional[object] = None
     data_axis: str = "data"
+    # Fixed-effect coordinates train feature-dimension-sharded over this
+    # mesh axis when set (P3; random effects always shard over data_axis).
+    model_axis: Optional[str] = None
     seed: int = 0
 
     def __post_init__(self):
@@ -328,6 +331,7 @@ class GameEstimator:
                     mesh=self.mesh,
                     data_axis=self.data_axis,
                     normalization=prep["norm"][dcfg.feature_shard],
+                    model_axis=self.model_axis,
                 )
             else:
                 dataset = prep["train"][cid]

@@ -54,6 +54,10 @@ class FixedEffectCoordinate:
     mesh: Optional[object] = None
     data_axis: str = "data"
     normalization: Optional[object] = None   # NormalizationContext or None
+    # When set (with a mesh that has this axis), coefficients/gradients/
+    # L-BFGS history shard over it — the P3 feature-dimension path for very
+    # wide feature spaces (SURVEY.md §2.6 P3).
+    model_axis: Optional[str] = None
 
     def train(self, offsets: Array, init: Optional[FixedEffectModel] = None):
         batch = self.batch.with_offsets(offsets.astype(self.batch.labels.dtype))
@@ -61,7 +65,19 @@ class FixedEffectCoordinate:
             w0 = init.model.coefficients.means
         else:
             w0 = jnp.zeros((batch.dim,), batch.labels.dtype)
-        if self.mesh is not None:
+        if self.mesh is not None and self.model_axis is not None:
+            if self.normalization is not None:
+                raise ValueError(
+                    "model-parallel fixed-effect training does not support "
+                    "normalization contexts yet"
+                )
+            from photon_tpu.parallel.model_parallel import fit_model_parallel
+
+            model, result = fit_model_parallel(
+                self.problem, batch, w0, self.mesh,
+                self.data_axis, self.model_axis,
+            )
+        elif self.mesh is not None:
             model, result = fit_data_parallel(
                 self.problem, batch, w0, self.mesh, self.data_axis,
                 normalization=self.normalization,

@@ -59,31 +59,49 @@ class RandomEffectModel:
     def _key_to_dense(self) -> dict:
         return {k: i for i, k in enumerate(self.entity_keys)}
 
+    def _sparse_for(
+        self, entity_key, stacks: Sequence[Sequence[Array]]
+    ) -> tuple[np.ndarray, list[np.ndarray]]:
+        """(global_indices, [values per stack]) for one entity — one slot
+        lookup and proj gather shared by means/variances export."""
+        dense = self._key_to_dense.get(entity_key)
+        if dense is None:
+            return np.zeros(0, np.int64), [
+                np.zeros(0, np.float32) for _ in stacks
+            ]
+        b, lane = self.entity_to_slot[dense]
+        proj = np.asarray(self.bucket_proj[b][lane])
+        valid = proj < self.global_dim
+        return proj[valid].astype(np.int64), [
+            np.asarray(s[b][lane])[valid] for s in stacks
+        ]
+
     def coefficients_for(self, entity_key) -> tuple[np.ndarray, np.ndarray]:
         """(global_indices, values) sparse coefficient vector for one entity
         (host-side; for model export and cross-dataset scoring)."""
-        dense = self._key_to_dense.get(entity_key)
-        if dense is None:
-            return np.zeros(0, np.int64), np.zeros(0, np.float32)
-        b, lane = self.entity_to_slot[dense]
-        proj = np.asarray(self.bucket_proj[b][lane])
-        coefs = np.asarray(self.bucket_coefs[b][lane])
-        valid = proj < self.global_dim
-        return proj[valid].astype(np.int64), coefs[valid]
+        gi, (gv,) = self._sparse_for(entity_key, [self.bucket_coefs])
+        return gi, gv
 
     def variances_for(self, entity_key) -> Optional[tuple[np.ndarray, np.ndarray]]:
         """Sparse posterior variances for one entity (same index set as
         ``coefficients_for``), or None if variances were not computed."""
         if self.bucket_variances is None:
             return None
-        dense = self._key_to_dense.get(entity_key)
-        if dense is None:
-            return np.zeros(0, np.int64), np.zeros(0, np.float32)
-        b, lane = self.entity_to_slot[dense]
-        proj = np.asarray(self.bucket_proj[b][lane])
-        var = np.asarray(self.bucket_variances[b][lane])
-        valid = proj < self.global_dim
-        return proj[valid].astype(np.int64), var[valid]
+        gi, (gv,) = self._sparse_for(entity_key, [self.bucket_variances])
+        return gi, gv
+
+    def export_for(
+        self, entity_key
+    ) -> tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+        """(indices, means, variances-or-None) in one slot lookup — the model
+        export path's per-entity gather."""
+        if self.bucket_variances is None:
+            gi, (gv,) = self._sparse_for(entity_key, [self.bucket_coefs])
+            return gi, gv, None
+        gi, (gv, vv) = self._sparse_for(
+            entity_key, [self.bucket_coefs, self.bucket_variances]
+        )
+        return gi, gv, vv
 
     def score_dataset(self, dataset: RandomEffectDataset) -> Array:
         """Scores for every row of the dataset this model was trained on

@@ -128,17 +128,14 @@ def save_game_model(
 
             def entity_records(m=m, imap=imap):
                 for key in m.entity_keys:
-                    gi, gv = m.coefficients_for(key)
-                    var = m.variances_for(key)
+                    gi, gv, vv = m.export_for(key)
                     yield {
                         "modelId": str(key),
                         "modelClass": _MODEL_CLASS[m.task],
                         "lossFunction": m.task.value,
                         "means": _nt_list(imap, gi, gv),
                         "variances": (
-                            _nt_list(imap, var[0], var[1])
-                            if var is not None
-                            else None
+                            _nt_list(imap, gi, vv) if vv is not None else None
                         ),
                     }
 

@@ -39,7 +39,6 @@ from photon_tpu.optim.base import (
     ValueAndGrad,
     check_convergence,
     finalize_reason,
-    l2_norm,
 )
 
 Array = jax.Array
@@ -65,12 +64,24 @@ def empty_history(m: int, d: int, dtype) -> LBFGSHistory:
     )
 
 
-def two_loop_direction(g: Array, hist: LBFGSHistory) -> Array:
+def make_dot(axis_name=None):
+    """Coefficient-space inner product. With ``axis_name``, vectors are
+    SHARDS over that mesh axis (SURVEY.md §2.6 P3: feature-dimension-sharded
+    optimizer state) and the dot completes with a ``psum`` over ICI — the
+    sharded-state analog of the reference broadcasting whole vectors."""
+    if axis_name is None:
+        return jnp.dot
+    return lambda a, b: lax.psum(jnp.dot(a, b), axis_name)
+
+
+def two_loop_direction(g: Array, hist: LBFGSHistory, dot=jnp.dot) -> Array:
     """Compute −H·g via the standard two-loop recursion over the masked buffer.
 
     Falls back to steepest descent when the history is empty. All loops are
     ``fori_loop`` over the *static* memory size m with masking, so the
     computation has fixed shape regardless of how many corrections are valid.
+    Under a sharded ``dot``, g/s/y are per-device shards and every inner
+    product psums over the model axis; α/ρ/γ scalars stay replicated.
     """
     m = hist.rho.shape[0]
 
@@ -78,7 +89,7 @@ def two_loop_direction(g: Array, hist: LBFGSHistory) -> Array:
         q, alpha = carry
         idx = jnp.mod(hist.pos - 1 - j, m)
         valid = j < hist.count
-        a = hist.rho[idx] * jnp.dot(hist.s[idx], q)
+        a = hist.rho[idx] * dot(hist.s[idx], q)
         a = jnp.where(valid, a, 0.0)
         q = q - a * hist.y[idx]
         alpha = alpha.at[idx].set(a)
@@ -90,15 +101,15 @@ def two_loop_direction(g: Array, hist: LBFGSHistory) -> Array:
 
     # Initial Hessian scaling γ = sᵀy / yᵀy from the newest pair.
     newest = jnp.mod(hist.pos - 1, m)
-    sy = jnp.dot(hist.s[newest], hist.y[newest])
-    yy = jnp.dot(hist.y[newest], hist.y[newest])
+    sy = dot(hist.s[newest], hist.y[newest])
+    yy = dot(hist.y[newest], hist.y[newest])
     gamma = jnp.where(hist.count > 0, sy / jnp.maximum(yy, 1e-30), 1.0)
     r = gamma * q
 
     def forward(j, r):
         idx = jnp.mod(hist.pos - hist.count + j, m)
         valid = j < hist.count
-        b = hist.rho[idx] * jnp.dot(hist.y[idx], r)
+        b = hist.rho[idx] * dot(hist.y[idx], r)
         corr = jnp.where(valid, alpha[idx] - b, 0.0)
         return r + corr * hist.s[idx]
 
@@ -106,10 +117,12 @@ def two_loop_direction(g: Array, hist: LBFGSHistory) -> Array:
     return -r
 
 
-def update_history(hist: LBFGSHistory, s: Array, y: Array) -> LBFGSHistory:
+def update_history(
+    hist: LBFGSHistory, s: Array, y: Array, dot=jnp.dot
+) -> LBFGSHistory:
     """Push a curvature pair, skipping it if sᵀy is not sufficiently positive."""
-    sy = jnp.dot(s, y)
-    ok = sy > 1e-10 * l2_norm(s) * l2_norm(y)
+    sy = dot(s, y)
+    ok = sy > 1e-10 * jnp.sqrt(dot(s, s)) * jnp.sqrt(dot(y, y))
 
     def push(h: LBFGSHistory) -> LBFGSHistory:
         return LBFGSHistory(
@@ -133,6 +146,7 @@ def backtracking_line_search(
     max_iters: int,
     c1: float = 1e-4,
     shrink: float = 0.5,
+    dot=jnp.dot,
 ):
     """Armijo backtracking from t=1. Returns (x⁺, f⁺, g⁺, t, n_probes).
 
@@ -141,7 +155,7 @@ def backtracking_line_search(
     accepted only if it decreases f; otherwise the step is rejected (t=0) and
     the caller's convergence logic will stop on function values.
     """
-    dg = jnp.dot(d, g)
+    dg = dot(d, g)
 
     def cond(carry):
         t, fx, _, _, it, done = carry
@@ -186,7 +200,15 @@ class _LoopState(NamedTuple):
 
 @dataclasses.dataclass(frozen=True)
 class LBFGS(Optimizer):
-    """Limited-memory BFGS. ``optimize`` is pure/jittable/vmappable."""
+    """Limited-memory BFGS. ``optimize`` is pure/jittable/vmappable.
+
+    With ``axis_name`` set, ``x0``/gradients/history are SHARDS over that
+    mesh axis (run inside ``shard_map``); every coefficient-space inner
+    product completes with a psum, so optimizer state never materializes
+    full-length vectors on any device (SURVEY.md §2.6 P3).
+    """
+
+    axis_name: str = None
 
     def optimize(self, value_and_grad: ValueAndGrad, x0: Array) -> OptimizerResult:
         cfg = self.config
@@ -194,9 +216,11 @@ class LBFGS(Optimizer):
         max_it = cfg.max_iterations
         d = x0.shape[-1]
         dtype = x0.dtype
+        dot = make_dot(self.axis_name)
+        norm = lambda v: jnp.sqrt(dot(v, v))
 
         f0, g0 = value_and_grad(x0)
-        gnorm0 = l2_norm(g0)
+        gnorm0 = norm(g0)
         values = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(f0)
         gnorms = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(gnorm0)
 
@@ -213,18 +237,18 @@ class LBFGS(Optimizer):
             return (st.reason == NOT_CONVERGED) & (st.it < max_it)
 
         def body(st: _LoopState) -> _LoopState:
-            dvec = two_loop_direction(st.g, st.hist)
+            dvec = two_loop_direction(st.g, st.hist, dot)
             # Safeguard: if not a descent direction, restart from −g.
-            descent = jnp.dot(dvec, st.g) < 0
+            descent = dot(dvec, st.g) < 0
             dvec = jnp.where(descent, dvec, -st.g)
 
             x_new, f_new, g_new, t, _ = backtracking_line_search(
                 value_and_grad, st.x, st.f, st.g, dvec,
-                cfg.max_line_search_iterations,
+                cfg.max_line_search_iterations, dot=dot,
             )
-            hist = update_history(st.hist, x_new - st.x, g_new - st.g)
+            hist = update_history(st.hist, x_new - st.x, g_new - st.g, dot)
             it = st.it + 1
-            gnorm = l2_norm(g_new)
+            gnorm = norm(g_new)
             reason = check_convergence(it, st.f, f_new, gnorm, st.gnorm0, cfg)
             # A fully failed line search (t == 0) cannot make further progress.
             reason = jnp.where(
@@ -242,7 +266,7 @@ class LBFGS(Optimizer):
         st = lax.while_loop(cond, body, init)
         reason = finalize_reason(st.reason, st.it, max_it)
         return OptimizerResult(
-            x=st.x, value=st.f, grad_norm=l2_norm(st.g),
+            x=st.x, value=st.f, grad_norm=norm(st.g),
             iterations=st.it, converged_reason=reason,
             values=st.values, grad_norms=st.grad_norms,
         )

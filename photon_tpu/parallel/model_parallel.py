@@ -1,0 +1,214 @@
+"""Feature-dimension-sharded training: 2D (data × model) mesh L-BFGS.
+
+Parity/North-star: SURVEY.md §2.6 P3 — the reference broadcasts the whole
+coefficient vector every iteration and holds it on the driver; at 10M
+features that is the scalability wall. Here the coefficient vector, gradient,
+and the L-BFGS S/Y history live SHARDED over the ``model`` mesh axis while
+batch rows shard over the ``data`` axis:
+
+* margins: each model shard computes the partial zᵢ from its own feature
+  columns; one ``psum`` over the model axis completes z (communication is
+  O(rows_per_device), NOT O(D) — no all-gather of coefficients, ever);
+* loss/value: summed over the data axis with a second ``psum``;
+* gradient: each model shard scatter-accumulates only its own columns, then
+  psums over the data axis — gradient shards never leave their device;
+* two-loop recursion: every coefficient-space inner product is a local dot +
+  scalar ``psum`` over the model axis (``LBFGS(axis_name=...)``).
+
+The whole multi-iteration solve is ONE ``shard_map``-ped XLA program on the
+mesh — zero host round trips, optimizer state O(D / n_model_shards) per
+device.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from photon_tpu.data.batch import DenseFeatures, LabeledBatch, SparseFeatures
+from photon_tpu.functions.problem import GLMOptimizationProblem
+from photon_tpu.models.coefficients import Coefficients
+from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.optim import LBFGS, OptimizerType
+from photon_tpu.ops.losses import loss_for_task
+from photon_tpu.parallel.mesh import pad_rows_to_multiple
+
+Array = jax.Array
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def _pad_dim_sparse(feats: SparseFeatures, new_dim: int) -> SparseFeatures:
+    # Ghost column moves from dim to new_dim; remap ghost entries.
+    idx = jnp.where(feats.idx >= feats.dim, new_dim, feats.idx)
+    return SparseFeatures(idx=idx, val=feats.val, dim=new_dim)
+
+
+def fit_model_parallel(
+    problem: GLMOptimizationProblem,
+    batch: LabeledBatch,
+    w0: Array,
+    mesh,
+    data_axis: str = DATA_AXIS,
+    model_axis: str = MODEL_AXIS,
+):
+    """Full L-BFGS solve with coefficients sharded over ``model_axis`` and
+    rows over ``data_axis``. Returns (GeneralizedLinearModel, OptimizerResult)
+    with full-length (host-assembled) coefficients.
+
+    Supports LBFGS with NONE variance and no normalization (the P3
+    scale path; other optimizers/options use the data-parallel path).
+    """
+    if problem.optimizer_type != OptimizerType.LBFGS:
+        raise ValueError(
+            "model-parallel training currently supports LBFGS only "
+            f"(got {problem.optimizer_type.name})"
+        )
+    if problem.variance_type.name != "NONE":
+        raise ValueError("model-parallel training does not compute variances")
+    if problem.regularization.l1_weight(problem.reg_weight) > 0.0:
+        raise ValueError("model-parallel training supports smooth (L2) regularization only")
+
+    n_data = mesh.shape[data_axis]
+    n_model = mesh.shape[model_axis]
+    d = batch.dim
+    d_pad = -d % n_model
+    d_full = d + d_pad
+
+    if batch.n_rows % n_data:
+        batch = pad_rows_to_multiple(batch, n_data)
+    feats = batch.features
+    if isinstance(feats, SparseFeatures):
+        feats = _pad_dim_sparse(feats, d_full)
+        feats_specs = SparseFeatures(
+            idx=P(data_axis, None), val=P(data_axis, None), dim=feats.dim
+        )
+    elif isinstance(feats, DenseFeatures):
+        if d_pad:
+            feats = DenseFeatures(jnp.pad(feats.x, ((0, 0), (0, d_pad))))
+        feats_specs = DenseFeatures(x=P(data_axis, model_axis))
+    else:  # pragma: no cover - union is closed
+        raise TypeError(f"unknown feature container {type(feats)}")
+    batch = dataclasses.replace(batch, features=feats)
+
+    w0 = jnp.pad(w0, (0, d_pad))
+    lam_mask = problem.reg_mask
+    if lam_mask is not None:
+        lam_mask = jnp.pad(lam_mask.astype(w0.dtype), (0, d_pad))
+    else:
+        # padding columns must carry 0 penalty? They stay at 0 anyway (no
+        # data touches them); keep 1 to preserve SPD behavior.
+        lam_mask = jnp.pad(jnp.ones((d,), w0.dtype), (0, d_pad), constant_values=1.0)
+
+    shard_d = d_full // n_model
+    l2 = problem.regularization.l2_weight(problem.reg_weight)
+    loss = loss_for_task(problem.task)
+    prior = problem.prior
+    if prior is not None:
+        prior = jax.tree.map(lambda a: jnp.pad(a, (0, d_pad)), prior)
+
+    row_specs = P(data_axis)
+    batch_specs = LabeledBatch(
+        features=feats_specs, labels=row_specs, offsets=row_specs,
+        weights=row_specs,
+    )
+    key = dataclasses.replace(problem, reg_mask=None, prior=None)
+
+    from photon_tpu.optim.base import OptimizerResult
+
+    res_specs = OptimizerResult(
+        x=P(), value=P(), grad_norm=P(), iterations=P(),
+        converged_reason=P(), values=P(), grad_norms=P(),
+    )
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(model_axis),
+            batch_specs,
+            P(model_axis),
+            jax.tree.map(lambda _: P(model_axis), prior),
+        ),
+        out_specs=(P(model_axis), res_specs),
+        check_vma=False,
+    )
+    def solve(w_shard, local_batch, lam_shard, prior_shard):
+        lf = local_batch.features
+
+        if isinstance(lf, SparseFeatures):
+            lo = lax.axis_index(model_axis) * shard_d
+
+            def margins(ws):
+                li = lf.idx - lo
+                own = (li >= 0) & (li < shard_d)
+                li = jnp.where(own, li, shard_d)
+                w_ext = jnp.concatenate([ws, jnp.zeros((1,), ws.dtype)])
+                zp = jnp.sum(w_ext[li] * lf.val, axis=-1)
+                return lax.psum(zp, model_axis)
+
+            def grad_shard(dz):
+                li = lf.idx - lo
+                own = (li >= 0) & (li < shard_d)
+                li = jnp.where(own, li, shard_d)
+                contrib = lf.val * dz[:, None]
+                g = jnp.zeros((shard_d + 1,), contrib.dtype)
+                g = g.at[li.ravel()].add(contrib.ravel())
+                return g[:shard_d]
+        else:
+
+            def margins(ws):
+                return lax.psum(lf.x @ ws, model_axis)
+
+            def grad_shard(dz):
+                return lf.x.T @ dz
+
+        def vg(ws):
+            z = margins(ws) + local_batch.offsets
+            lv = jnp.sum(local_batch.weights * loss.loss(z, local_batch.labels))
+            lv = lax.psum(lv, data_axis)
+            dz = local_batch.weights * loss.d1(z, local_batch.labels)
+            g = lax.psum(grad_shard(dz), data_axis)
+            lam = l2 * lam_shard
+            # L2 value is a model-axis-sharded sum; data term already global.
+            lv = lv + lax.psum(0.5 * jnp.sum(lam * ws * ws), model_axis)
+            g = g + lam * ws
+            if prior_shard is not None:
+                lv = lv + lax.psum(prior_shard.value(ws), model_axis)
+                g = g + prior_shard.gradient(ws)
+            return lv, g
+
+        result = LBFGS(key.optimizer_config, axis_name=model_axis).optimize(
+            vg, w_shard
+        )
+        return result.x, dataclasses.replace(result, x=jnp.zeros((0,), w_shard.dtype))
+
+    x_sharded, result = solve(
+        jax.device_put(
+            w0, NamedSharding(mesh, P(model_axis))
+        ),
+        _shard_batch(batch, mesh, batch_specs),
+        jax.device_put(lam_mask, NamedSharding(mesh, P(model_axis))),
+        jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(mesh, P(model_axis))), prior
+        ),
+    )
+    x = jnp.asarray(x_sharded)[:d]
+    result = dataclasses.replace(result, x=x)
+    model = GeneralizedLinearModel(Coefficients(means=x), problem.task)
+    return model, result
+
+
+def _shard_batch(batch: LabeledBatch, mesh, specs) -> LabeledBatch:
+    return jax.tree.map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        batch,
+        specs,
+    )
