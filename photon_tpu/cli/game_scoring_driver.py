@@ -62,9 +62,19 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             meta = json.load(f)
         shards = {info["feature_shard"] for info in meta["coordinates"].values()}
 
-        index_root = args.index_dir or os.path.join(
-            os.path.dirname(os.path.normpath(args.model_dir)), "index"
-        )
+        if args.index_dir:
+            index_root = args.index_dir
+        else:
+            # The training driver writes indexes at <out>/index while models
+            # live at <out>/best or <out>/models/<i> — walk up past "models",
+            # but only for true models/<i> children (an output dir itself
+            # named "models" must not trigger the walk-up).
+            norm = os.path.normpath(args.model_dir)
+            parent = os.path.dirname(norm)
+            if (os.path.basename(parent) == "models"
+                    and os.path.basename(norm).isdigit()):
+                parent = os.path.dirname(parent)
+            index_root = os.path.join(parent, "index")
         index_maps = {
             s: MmapIndexMap(os.path.join(index_root, s)) for s in sorted(shards)
         }
@@ -89,19 +99,31 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 ev.group_column for ev in suite.evaluators if ev.group_column
             }
 
+        # Shard configs persisted at training time are authoritative; the
+        # --feature-bags flag is only a fallback for pre-metadata models.
+        saved_shards = meta.get("feature_shards", {})
+        shard_cfgs = {
+            s: (
+                FeatureShardConfig(
+                    feature_bags=tuple(saved_shards[s]["feature_bags"]),
+                    add_intercept=saved_shards[s]["add_intercept"],
+                )
+                if s in saved_shards
+                else FeatureShardConfig(feature_bags=tuple(args.feature_bags))
+            )
+            for s in index_maps
+        }
         reader = AvroDataReader(
             index_maps,
-            {
-                s: FeatureShardConfig(feature_bags=tuple(args.feature_bags))
-                for s in index_maps
-            },
+            shard_cfgs,
             columns=InputColumnNames(
                 uid=args.uid_column, response=args.response_column
             ),
             id_tag_columns=sorted(id_tags),
         )
         with Timed("read data", logger):
-            bundle = reader.read(args.data)
+            # Labels are only required when evaluators were requested.
+            bundle = reader.read(args.data, require_labels=suite is not None)
         logger.info("scoring %d rows", bundle.n_rows)
 
         transformer = GameTransformer(

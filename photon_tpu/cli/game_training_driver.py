@@ -316,7 +316,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             EvaluationSuite.parse(args.evaluators) if args.evaluators else None
         )
         best = select_best(results, suite) if suite else results[0]
-        best_i = results.index(best)
+        # Identity, not equality: results hold JAX arrays whose __eq__ is
+        # elementwise, so list.index would raise on any non-first best.
+        best_i = next(i for i, r in enumerate(results) if r is best)
 
         shard_by_coordinate = {
             cid: c.feature_shard for cid, c in data_configs.items()
@@ -326,10 +328,12 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             if args.output_mode == "ALL":
                 for i, r in enumerate(results):
                     mdir = os.path.join(args.output_dir, "models", str(i))
-                    save_game_model(mdir, r.model, index_maps, shard_by_coordinate)
+                    save_game_model(mdir, r.model, index_maps,
+                                    shard_by_coordinate, shard_cfgs)
                     saved[str(i)] = mdir
             bdir = os.path.join(args.output_dir, "best")
-            save_game_model(bdir, best.model, index_maps, shard_by_coordinate)
+            save_game_model(bdir, best.model, index_maps,
+                            shard_by_coordinate, shard_cfgs)
             saved["best"] = bdir
             for shard, im in index_maps.items():
                 idir = os.path.join(args.output_dir, "index", shard)

@@ -356,14 +356,18 @@ class GameEstimator:
 
                     # Posterior projection is config-independent (down-sampled
                     # datasets keep the bucket structure); cache it across the
-                    # sweep and only rescale precisions per config.
+                    # sweep. Keyed by the model object itself (identity
+                    # verified on hit) — an id() key could silently serve a
+                    # stale projection after id reuse.
                     cache = prep.setdefault("prior_proj", {})
-                    ck = (cid, id(init_m))
-                    if ck not in cache:
-                        cache[ck] = init_m.project_posteriors_to(
-                            prep["train"][cid]
+                    hit = cache.get(cid)
+                    if hit is None or hit[0] is not init_m:
+                        hit = (
+                            init_m,
+                            init_m.project_posteriors_to(prep["train"][cid]),
                         )
-                    means, variances = cache[ck]
+                        cache[cid] = hit
+                    means, variances = hit[1]
                     priors = [
                         PriorDistribution.from_model(
                             m, v, ocfg.incremental_weight

@@ -128,7 +128,12 @@ class AvroDataReader:
         self.columns = columns
         self.id_tag_columns = tuple(id_tag_columns)
 
-    def read(self, paths, dtype=jnp.float32) -> GameDataBundle:
+    def read(
+        self, paths, dtype=jnp.float32, require_labels: bool = True
+    ) -> GameDataBundle:
+        """``require_labels=False`` admits unlabeled records (label → NaN) —
+        the reference GameScoringDriver treats response as optional at
+        scoring time."""
         cols = self.columns
         labels, offsets, weights, uids = [], [], [], []
         tags: dict[str, list] = {t: [] for t in self.id_tag_columns}
@@ -150,7 +155,8 @@ class AvroDataReader:
         }
 
         for rec in _iter_records(_expand_paths(paths)):
-            labels.append(_first(rec, response_cols, required=True))
+            lab = _first(rec, response_cols, required=require_labels)
+            labels.append(float("nan") if lab is None else lab)
             offsets.append(rec.get(cols.offset) or 0.0)
             w = rec.get(cols.weight)
             weights.append(1.0 if w is None else w)

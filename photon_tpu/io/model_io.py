@@ -82,10 +82,24 @@ def save_game_model(
     model: GameModel,
     index_maps: Mapping[str, IndexMap],
     shard_by_coordinate: Optional[Mapping[str, str]] = None,
+    shard_configs: Optional[Mapping[str, object]] = None,
 ) -> None:
-    """Write every coordinate of a GameModel in the reference layout."""
+    """Write every coordinate of a GameModel in the reference layout.
+
+    ``shard_configs`` (shard → FeatureShardConfig-like with ``feature_bags``
+    and ``add_intercept``) is persisted in the metadata so the scoring driver
+    reconstructs the exact feature assembly without re-passing flags.
+    """
     os.makedirs(model_dir, exist_ok=True)
     meta: dict = {"coordinates": {}}
+    if shard_configs:
+        meta["feature_shards"] = {
+            shard: {
+                "feature_bags": list(cfg.feature_bags),
+                "add_intercept": bool(cfg.add_intercept),
+            }
+            for shard, cfg in shard_configs.items()
+        }
     shard_by_coordinate = dict(shard_by_coordinate or {})
 
     for cid in model.keys():
@@ -278,7 +292,11 @@ def save_scores(
     labels = (
         [None] * n
         if labels is None
-        else [None if l is None else float(l) for l in labels]
+        else [
+            None if l is None or l != l  # NaN of any float-like type
+            else float(l)
+            for l in labels
+        ]
     )
 
     def recs():

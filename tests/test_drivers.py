@@ -218,6 +218,116 @@ class TestParamParsing:
         assert parse_feature_shard("g").feature_bags == ("features",)
 
 
+def test_best_config_not_first_and_models_subdir_scoring(game_data, tmp_path):
+    """Regression: selecting a best config at index > 0 must not crash
+    (identity selection, not array __eq__), and scoring from a
+    ``models/<i>`` directory must find ``<out>/index`` without --index-dir."""
+    d, _, n_val = game_data
+    out = tmp_path / "out"
+    # reg weight 100 first: the better (0.01) config lands at index 1.
+    summary = game_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--validation-data", str(d / "val.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate",
+        "fixed:type=fixed,shard=global,reg=L2,max_iter=30,reg_weights=100|0.01",
+        "--evaluators", "AUC",
+        "--output-mode", "ALL",
+        "--devices", "1",
+    ])
+    assert summary["best_config_index"] == 1
+    score_out = tmp_path / "score_out"
+    ssum = game_scoring_driver.run([
+        "--data", str(d / "val.avro"),
+        "--model-dir", str(out / "models" / "1"),
+        "--output-dir", str(score_out),
+    ])
+    assert ssum["n_rows"] == n_val
+
+
+def test_scoring_unlabeled_data(game_data, tmp_path):
+    """Scoring data with no response column (reference: response optional at
+    scoring time)."""
+    d, _, _ = game_data
+    out = tmp_path / "out"
+    game_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate", "fixed:type=fixed,shard=global,reg=L2,max_iter=20,reg_weights=1",
+        "--devices", "1",
+    ])
+    schema = json.loads(json.dumps(RECORD_SCHEMA))
+    schema["fields"][1] = {
+        "name": "response", "type": ["null", "double"], "default": None
+    }
+    rng = np.random.default_rng(9)
+    recs = [
+        {
+            "uid": str(i), "response": None, "offset": None, "weight": None,
+            "features": [
+                {"name": "g", "term": str(j), "value": float(rng.normal())}
+                for j in range(5)
+            ],
+            "metadataMap": None,
+        }
+        for i in range(10)
+    ]
+    unl = tmp_path / "unlabeled.avro"
+    write_container(str(unl), schema, recs)
+    score_out = tmp_path / "score_out"
+    ssum = game_scoring_driver.run([
+        "--data", str(unl),
+        "--model-dir", str(out / "best"),
+        "--output-dir", str(score_out),
+    ])
+    assert ssum["n_rows"] == 10
+    scored = read_records(str(score_out / "scores.avro"))
+    assert all(r["label"] is None for r in scored)
+    assert all(np.isfinite(r["predictionScore"]) for r in scored)
+
+
+def test_custom_feature_bags_persist_to_scoring(game_data, tmp_path):
+    """Shard configs (bags, intercept) saved in game-metadata.json are used
+    by the scoring driver without re-passing --feature-bags."""
+    d, _, _ = game_data
+    # Rewrite the fixture with features under a custom bag name.
+    schema = json.loads(json.dumps(RECORD_SCHEMA))
+    schema["fields"][4] = dict(schema["fields"][4], name="myBag")
+    recs = [
+        {**r, "myBag": r["features"]}
+        for r in read_records(str(d / "train.avro"))
+    ]
+    for r in recs:
+        del r["features"]
+    data = tmp_path / "custom.avro"
+    write_container(str(data), schema, recs)
+    out = tmp_path / "out"
+    game_training_driver.run([
+        "--train-data", str(data),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:myBag",
+        "--coordinate", "fixed:type=fixed,shard=global,reg=L2,max_iter=20,reg_weights=1",
+        "--devices", "1",
+    ])
+    meta = json.load(open(out / "best" / "game-metadata.json"))
+    assert meta["feature_shards"]["global"]["feature_bags"] == ["myBag"]
+    score_out = tmp_path / "score_out"
+    ssum = game_scoring_driver.run([
+        "--data", str(data),
+        "--model-dir", str(out / "best"),
+        "--output-dir", str(score_out),
+        # note: no --feature-bags; metadata must supply "myBag"
+    ])
+    scored = read_records(str(score_out / "scores.avro"))
+    # with the right bag, scores are non-trivial (not all just intercept)
+    assert np.std([r["predictionScore"] for r in scored]) > 1e-3
+
+
 def test_training_driver_auto_tuning(game_data, tmp_path):
     """--tuning gp replaces the grid sweep with Bayesian optimization of the
     reg weights (reference: GAME + hyperparameter auto-tuning config)."""
