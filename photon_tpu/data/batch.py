@@ -73,11 +73,17 @@ class SparseFeatures:
 
     ``idx[N, K]`` holds column ids in [0, D]; id == D marks padding (its value
     must be 0). ``dim`` (static) is the true feature dimension D.
+
+    ``fast`` (optional, see ``ops/fast_sparse.py``) carries precomputed
+    MXU-friendly layouts; when present, matvec/rmatvec take the fast path
+    (row-slice gather + one-hot reduce) instead of XLA's slow generic
+    gather/scatter lowering. Attach with ``with_fast_path()``.
     """
 
     idx: Array
     val: Array
     dim: int = dataclasses.field(metadata=dict(static=True))
+    fast: Optional[object] = None
 
     @property
     def n_rows(self) -> int:
@@ -87,13 +93,40 @@ class SparseFeatures:
     def max_nnz(self) -> int:
         return self.idx.shape[1]
 
+    def with_fast_path(self, q_capacity: int = 2048) -> "SparseFeatures":
+        """Build the fast-path layouts (host-side, once) and attach them."""
+        from photon_tpu.ops.fast_sparse import build_fast_aux
+
+        if self.fast is not None:
+            return self
+        aux = build_fast_aux(
+            jax.device_get(self.idx), jax.device_get(self.val), self.dim,
+            q_capacity=q_capacity,
+        )
+        return dataclasses.replace(self, fast=aux)
+
+    def without_fast_path(self) -> "SparseFeatures":
+        """Drop the fast layouts (e.g. before row-sharding: the column-sorted
+        table is not partitionable along the row axis)."""
+        if self.fast is None:
+            return self
+        return dataclasses.replace(self, fast=None)
+
     def matvec(self, w: Array) -> Array:
+        if self.fast is not None:
+            from photon_tpu.ops.fast_sparse import matvec_fast
+
+            return matvec_fast(self.fast, self.val, w, self.dim)
         # Gather through an extended vector with a zero ghost column so
         # padding indices read 0 — no masking needed in the hot loop.
         w_ext = jnp.concatenate([w, jnp.zeros((1,), w.dtype)])
         return jnp.sum(w_ext[self.idx] * self.val, axis=-1)
 
     def rmatvec(self, v: Array) -> Array:
+        if self.fast is not None:
+            from photon_tpu.ops.fast_sparse import rmatvec_fast
+
+            return rmatvec_fast(self.fast, v, self.dim)
         contrib = (v[:, None] * self.val).ravel()
         out = jax.ops.segment_sum(
             contrib, self.idx.ravel(), num_segments=self.dim + 1
@@ -101,6 +134,10 @@ class SparseFeatures:
         return out[: self.dim]
 
     def sq_rmatvec(self, v: Array) -> Array:
+        if self.fast is not None:
+            from photon_tpu.ops.fast_sparse import rmatvec_fast
+
+            return rmatvec_fast(self.fast, v, self.dim, square_vals=True)
         contrib = (v[:, None] * self.val * self.val).ravel()
         out = jax.ops.segment_sum(
             contrib, self.idx.ravel(), num_segments=self.dim + 1

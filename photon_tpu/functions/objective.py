@@ -109,6 +109,39 @@ class GLMObjective:
             diag = diag + self.prior.hessian_diagonal()
         return diag
 
+    # -- score-space interface (incremental-z optimizers) --------------------
+
+    def value_from_scores(self, z: Array, w: Array, batch: LabeledBatch) -> Array:
+        """Objective value given precomputed margins z = Xw + offsets.
+
+        Lets an optimizer that maintains z incrementally (z ← z + t·Xp per
+        accepted step) price line-search probes with pure elementwise work —
+        no data pass. See ``LBFGS.optimize_scored``.
+        """
+        lv = jnp.sum(batch.weights * self.loss.loss(z, batch.labels))
+        lv = lv + 0.5 * jnp.sum(self._l2_vec(w) * w * w)
+        if self.prior is not None:
+            lv = lv + self.prior.value(w)
+        return lv
+
+    def grad_from_scores(self, z: Array, w: Array, batch: LabeledBatch) -> Array:
+        """Gradient given margins: Xᵀ(weights·ℓ'(z)) + L2/prior terms —
+        exactly one rmatvec pass."""
+        dz = batch.weights * self.loss.d1(z, batch.labels)
+        g = batch.features.rmatvec(dz) + self._l2_vec(w) * w
+        if self.prior is not None:
+            g = g + self.prior.gradient(w)
+        return g
+
+    def score_space(self, batch: LabeledBatch) -> "ScoreSpaceObjective":
+        """Bundle of score-space callables for ``LBFGS.optimize_scored``."""
+        return ScoreSpaceObjective(
+            score=lambda w: batch.features.matvec(w) + batch.offsets,
+            score_delta=lambda p: batch.features.matvec(p),
+            value_from_scores=lambda z, w: self.value_from_scores(z, w, batch),
+            grad_from_scores=lambda z, w: self.grad_from_scores(z, w, batch),
+        )
+
     # -- closure builders for the optimizers --------------------------------
 
     def bind(self, batch: LabeledBatch) -> Callable[[Array], tuple[Array, Array]]:
@@ -117,6 +150,18 @@ class GLMObjective:
 
     def bind_hvp(self, batch: LabeledBatch) -> Callable[[Array, Array], Array]:
         return lambda w, v: self.hessian_vector(w, v, batch)
+
+
+@dataclasses.dataclass(frozen=True)
+class ScoreSpaceObjective:
+    """Callables an incremental-score optimizer needs (SURVEY.md §3.4: the
+    reference pays one cluster job per line-search probe; here probes are
+    elementwise over z, and a full iteration is 1 matvec + 1 rmatvec)."""
+
+    score: Callable[[Array], Array]               # w ↦ z = Xw + offsets
+    score_delta: Callable[[Array], Array]         # p ↦ Xp  (no offsets)
+    value_from_scores: Callable[[Array, Array], Array]   # (z, w) ↦ f
+    grad_from_scores: Callable[[Array, Array], Array]    # (z, w) ↦ ∇f
 
 
 def intercept_reg_mask(dim: int, intercept_index: Optional[int]) -> Optional[Array]:

@@ -153,7 +153,15 @@ class GLMOptimizationProblem:
             )
 
         if self.optimizer_type == OptimizerType.LBFGS:
-            result = LBFGS(self.optimizer_config).optimize(vg, w0)
+            if norm is None:
+                # Incremental-score path: line-search probes are elementwise
+                # over maintained margins; one matvec + one rmatvec per
+                # iteration (vs one fused pass per probe). Identical math.
+                result = LBFGS(self.optimizer_config).optimize_scored(
+                    obj.score_space(batch), w0
+                )
+            else:
+                result = LBFGS(self.optimizer_config).optimize(vg, w0)
         elif self.optimizer_type == OptimizerType.OWLQN:
             l1 = self.regularization.l1_weight(self.reg_weight)
             mask = obj.reg_mask if obj.reg_mask is not None else jnp.ones_like(w0)

@@ -1,0 +1,189 @@
+"""MXU-friendly sparse matvec/rmatvec paths for TPU.
+
+Why this exists: XLA's generic ``gather``/``scatter-add`` lowerings on TPU run
+near one element per scalar-core cycle, so the ELL hot ops of a sparse GLM pass
+(`SparseFeatures.matvec`/`rmatvec`, SURVEY.md §7 hard-part #2) execute ~100×
+off the HBM roofline. Measured on a v5e (2^19 rows × 32 nnz over 2^18
+features): plain gather ≈ 150 ms, ``segment_sum`` scatter ≈ 118 ms per pass.
+
+This module replaces both with formulations XLA compiles to vector/MXU code:
+
+* ``matvec`` (and the gather side of ``rmatvec``): **row-slice gather +
+  lane-select**.  The coefficient vector is viewed as ``[D/128, 128]``; each
+  entry fetches its 128-wide row slice (``w2[idx >> 7]`` — a contiguous-slice
+  gather XLA vectorizes) and selects its lane with a fused
+  ``where(lo == iota)`` reduction.  Measured ≈ 55 ms vs 150 ms.
+
+* ``rmatvec`` reduction: **column-sorted one-hot matmul**.  Entries are
+  pre-sorted (host-side, once — indices are static data) by column and grouped
+  into rows of a ``[B, Q]`` table whose columns all fall in one aligned
+  128-column range.  The scatter-add then becomes
+  ``einsum("bql,bq->bl", onehot(col & 127), contrib)`` — an MXU contraction
+  with the one-hot fused from an int8 compare, never materialized — followed
+  by a tiny sorted segment-sum over ranges.  Measured ≈ 11 ms vs 118 ms for
+  the scatter itself.
+
+The plan arrays are built once per dataset on the host (NumPy) and ride along
+as an optional pytree on ``SparseFeatures``; all ops stay pure/jittable.
+Ghost-padding entries (column id == dim) are mapped to a zero row with value
+0, so no masking is needed in the hot loop.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+LANE = 128
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class FastSparseAux:
+    """Static auxiliary layouts for the fast paths.
+
+    Row-major digit split (for matvec's row-slice gather):
+      ``hi[N, K]`` int32 — column id >> 7 (ghost entries point at the zero
+      row appended to the coefficient table); ``lo[N, K]`` int8 — column & 127.
+
+    Column-sorted table (for rmatvec's one-hot reduce): ``B`` rows of capacity
+    ``Q``; every slot in row b carries an entry whose column lies in the
+    128-aligned range ``cs_range[b]``. ``cs_rhi``/``cs_rlo`` split the entry's
+    ROW id for the dz gather; ``cs_clo`` is its lane within the range;
+    ``cs_val`` is the feature value (0 in padding slots).
+    """
+
+    hi: Array        # [N, K] int32
+    lo: Array        # [N, K] int8
+    cs_rhi: Array    # [B, Q] int32
+    cs_rlo: Array    # [B, Q] int8
+    cs_clo: Array    # [B, Q] int8
+    cs_val: Array    # [B, Q] float32
+    cs_range: Array  # [B] int32 (sorted; == n_ranges for padding rows)
+    n_ranges: int = dataclasses.field(metadata=dict(static=True))
+    n_row_blocks: int = dataclasses.field(metadata=dict(static=True))
+
+
+def build_fast_aux(
+    idx: np.ndarray, val: np.ndarray, dim: int, q_capacity: int = 2048
+) -> FastSparseAux:
+    """Host-side construction of both static layouts from ELL arrays.
+
+    ``idx``/``val`` are the ``SparseFeatures`` arrays ([N, K], ghost column ==
+    ``dim`` with value 0). ``q_capacity`` bounds the column-table row width; a
+    popular column range simply occupies several table rows (so skewed or
+    dense columns — e.g. the intercept — need no special casing).
+    """
+    idx = np.asarray(idx)
+    val = np.asarray(val)
+    n, k = idx.shape
+    n_row_blocks = -(-n // LANE)
+    n_col_blocks = -(-dim // LANE)
+
+    # Row-major digit split; ghost entries -> appended zero row of w table.
+    hi = (idx >> 7).astype(np.int32)
+    lo = (idx & 127).astype(np.int8)
+    ghost = idx >= dim
+    hi[ghost] = n_col_blocks
+    lo[ghost] = 0
+
+    # Column-sorted table.
+    flat_col = idx.ravel()
+    keep = flat_col < dim
+    cols = flat_col[keep].astype(np.int64)
+    rows = np.repeat(np.arange(n, dtype=np.int64), k)[keep]
+    vals = val.ravel()[keep]
+    order = np.argsort(cols, kind="stable")
+    cols, rows, vals = cols[order], rows[order], vals[order]
+
+    rng_of = (cols >> 7).astype(np.int64)
+    counts = np.bincount(rng_of, minlength=n_col_blocks)
+    rows_per_range = np.maximum(1, -(-counts // q_capacity))
+    b_total = int(rows_per_range.sum())
+    b_pad = -(-b_total // 8) * 8
+
+    cs_rhi = np.zeros((b_pad, q_capacity), np.int32)
+    cs_rlo = np.zeros((b_pad, q_capacity), np.int8)
+    cs_clo = np.zeros((b_pad, q_capacity), np.int8)
+    cs_val = np.zeros((b_pad, q_capacity), np.float32)
+    cs_range = np.full((b_pad,), n_col_blocks, np.int32)
+
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    b = 0
+    for r in range(n_col_blocks):
+        lo_e, hi_e = int(starts[r]), int(starts[r + 1])
+        for off in range(lo_e, max(hi_e, lo_e + 1), q_capacity):
+            end = min(off + q_capacity, hi_e)
+            m = end - off
+            if m > 0:
+                sl = slice(off, end)
+                cs_rhi[b, :m] = (rows[sl] >> 7).astype(np.int32)
+                cs_rlo[b, :m] = (rows[sl] & 127).astype(np.int8)
+                cs_clo[b, :m] = (cols[sl] & 127).astype(np.int8)
+                cs_val[b, :m] = vals[sl]
+            cs_range[b] = r
+            b += 1
+
+    return FastSparseAux(
+        hi=jnp.asarray(hi),
+        lo=jnp.asarray(lo),
+        cs_rhi=jnp.asarray(cs_rhi),
+        cs_rlo=jnp.asarray(cs_rlo),
+        cs_clo=jnp.asarray(cs_clo),
+        cs_val=jnp.asarray(cs_val),
+        cs_range=jnp.asarray(cs_range),
+        n_ranges=n_col_blocks,
+        n_row_blocks=n_row_blocks,
+    )
+
+
+def _lane_iota() -> Array:
+    return jax.lax.broadcasted_iota(jnp.int8, (1, 1, LANE), 2)
+
+
+def matvec_fast(aux: FastSparseAux, val: Array, w: Array, dim: int) -> Array:
+    """z[i] = Σ_k val[i,k] · w[idx[i,k]] via row-slice gather + lane select."""
+    nblk = -(-dim // LANE)
+    w2 = jnp.pad(w, (0, nblk * LANE - dim)).reshape(nblk, LANE)
+    w2 = jnp.concatenate([w2, jnp.zeros((1, LANE), w.dtype)])  # ghost row
+    rows = w2[aux.hi]                                  # [N, K, 128]
+    sel = jnp.where(aux.lo[..., None] == _lane_iota(), rows, 0.0)
+    return jnp.sum(jnp.sum(sel, axis=-1) * val, axis=-1)
+
+
+def rmatvec_fast(
+    aux: FastSparseAux, dz: Array, dim: int, square_vals: bool = False
+) -> Array:
+    """g[c] = Σ_{entries of column c} val · dz[row] — scatter-free.
+
+    dz is gathered by row-slice + lane select (same trick as matvec), the
+    per-column reduction is a fused one-hot MXU contraction per 128-column
+    range, and ranges assemble with one small sorted segment-sum.
+    """
+    n = dz.shape[0]
+    nb = aux.n_row_blocks
+    dz2 = jnp.pad(dz, (0, nb * LANE - n)).reshape(nb, LANE)
+    rows = dz2[aux.cs_rhi]                             # [B, Q, 128]
+    iota = _lane_iota()
+    dz_at = jnp.sum(jnp.where(aux.cs_rlo[..., None] == iota, rows, 0.0), axis=-1)
+    v = aux.cs_val * aux.cs_val if square_vals else aux.cs_val
+    contrib = dz_at * v                                # [B, Q]
+    oh = jnp.where(aux.cs_clo[..., None] == iota, 1.0, 0.0)
+    out_b = jnp.einsum(
+        "bql,bq->bl", oh, contrib, preferred_element_type=jnp.float32
+    )                                                  # [B, 128]
+    out_r = jax.ops.segment_sum(
+        out_b, aux.cs_range, num_segments=aux.n_ranges + 1,
+        indices_are_sorted=True,
+    )[: aux.n_ranges]
+    return out_r.reshape(-1)[:dim]
+
+
+# Note: no custom_vjp wrapper is needed — every optimizer-facing path
+# (GLMObjective.value_and_grad / hessian_vector / hessian_diagonal) is
+# hand-fused and calls matvec/rmatvec explicitly, so autodiff never
+# differentiates through these functions.

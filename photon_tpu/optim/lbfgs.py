@@ -137,6 +137,46 @@ def update_history(
     return jax.tree.map(lambda a, b: jnp.where(ok, a, b), pushed, hist)
 
 
+def armijo_backtrack(
+    probe,
+    f: Array,
+    dg: Array,
+    init_aux,
+    max_iters: int,
+    c1: float = 1e-4,
+    shrink: float = 0.5,
+):
+    """Shared Armijo backtracking core. ``probe: t ↦ (f(x + t·d), aux)`` —
+    the aux rides along untouched (the plain path carries the probe's
+    gradient; the scored path carries nothing).
+
+    Returns ``(t_final, ft, aux, accept, n_probes)``; ``t_final`` is 0 on a
+    fully failed search (the caller's convergence logic stops on function
+    values). If no step satisfies Armijo within the cap, the last (smallest)
+    probe is accepted only if it still decreases f. NaN/Inf-safe: non-finite
+    probe values are treated as failures.
+    """
+
+    def cond(carry):
+        t, fx, _, _, it, done = carry
+        return (~done) & (it < max_iters)
+
+    def body(carry):
+        t, _, _, _, it, _ = carry
+        ft, aux = probe(t)
+        ok = (ft <= f + c1 * t * dg) & jnp.isfinite(ft)
+        return (jnp.where(ok, t, t * shrink), ft, aux, t, it + 1, ok)
+
+    t0 = jnp.asarray(1.0, f.dtype)
+    t, ft, aux, t_used, n, ok = lax.while_loop(
+        cond, body,
+        (t0, f, init_aux, t0, jnp.zeros((), jnp.int32), jnp.zeros((), bool)),
+    )
+    accept = ok | (jnp.isfinite(ft) & (ft < f))
+    t_final = jnp.where(accept, t_used, 0.0)
+    return t_final, ft, aux, accept, n
+
+
 def backtracking_line_search(
     value_and_grad: ValueAndGrad,
     x: Array,
@@ -151,39 +191,17 @@ def backtracking_line_search(
     """Armijo backtracking from t=1. Returns (x⁺, f⁺, g⁺, t, n_probes).
 
     Each probe is one fused value+grad evaluation (one data pass on-device).
-    If no step satisfies Armijo within the cap, the last (smallest) probe is
-    accepted only if it decreases f; otherwise the step is rejected (t=0) and
-    the caller's convergence logic will stop on function values.
     """
     dg = dot(d, g)
-
-    def cond(carry):
-        t, fx, _, _, it, done = carry
-        return (~done) & (it < max_iters)
-
-    def body(carry):
-        t, _, _, _, it, _ = carry
-        xt = x + t * d
-        ft, gt = value_and_grad(xt)
-        ok = ft <= f + c1 * t * dg
-        # NaN/Inf-safe: treat non-finite ft as failure.
-        ok = ok & jnp.isfinite(ft)
-        t_next = jnp.where(ok, t, t * shrink)
-        return (t_next, ft, gt, t, it + 1, ok)
-
-    t0 = jnp.asarray(1.0, f.dtype)
-    t, ft, gt, t_used, n, ok = lax.while_loop(
-        cond, body, (t0, f, g, t0, jnp.zeros((), jnp.int32), jnp.zeros((), bool))
+    t_final, ft, gt, accept, n = armijo_backtrack(
+        lambda t: value_and_grad(x + t * d), f, dg, g, max_iters, c1, shrink
     )
-    # On success the accepted step used t_used (= t). On failure fall back to
-    # accepting the final probe only if it still decreased f.
-    accept = ok | (jnp.isfinite(ft) & (ft < f))
-    t_final = jnp.where(accept, t_used, 0.0)
+    t_used = jnp.where(accept, t_final, 0.0)
     # Select (not scale by t=0): keeps x clean even if d has NaN/Inf entries.
-    x_new = jnp.where(accept, x + t_used * d, x)
+    x_new = jnp.where(accept, x + t_final * d, x)
     f_new = jnp.where(accept, ft, f)
     g_new = jax.tree.map(lambda a, b: jnp.where(accept, a, b), gt, g)
-    return x_new, f_new, g_new, t_final, n
+    return x_new, f_new, g_new, t_used, n
 
 
 class _LoopState(NamedTuple):
@@ -262,6 +280,107 @@ class LBFGS(Optimizer):
                 values=st.values.at[it].set(f_new),
                 grad_norms=st.grad_norms.at[it].set(gnorm),
             )
+
+        st = lax.while_loop(cond, body, init)
+        reason = finalize_reason(st.reason, st.it, max_it)
+        return OptimizerResult(
+            x=st.x, value=st.f, grad_norm=norm(st.g),
+            iterations=st.it, converged_reason=reason,
+            values=st.values, grad_norms=st.grad_norms,
+        )
+
+    def optimize_scored(self, so, x0: Array) -> OptimizerResult:
+        """L-BFGS with incrementally maintained margins z = Xw + offsets.
+
+        The reference pays a full data pass (a Spark job) per line-search
+        probe (SURVEY.md §3.4). Here each iteration computes Xp ONCE for the
+        chosen direction; every probe prices f(w + t·p) from z + t·Xp with
+        elementwise work only, and the accepted point costs one rmatvec for
+        the gradient. Net data passes per iteration: 1 matvec + 1 rmatvec,
+        independent of probe count.
+
+        ``so`` is a ``functions.objective.ScoreSpaceObjective``. Same
+        optimum/convergence semantics as ``optimize`` (identical math;
+        floating-point rounding of z + t·Xp vs X(w + t·p) differs at ~ulp).
+        """
+        cfg = self.config
+        m = cfg.history_length
+        max_it = cfg.max_iterations
+        d = x0.shape[-1]
+        dtype = x0.dtype
+        dot = make_dot(self.axis_name)
+        norm = lambda v: jnp.sqrt(dot(v, v))
+        c1, shrink = 1e-4, 0.5
+
+        z0 = so.score(x0)
+        f0 = so.value_from_scores(z0, x0)
+        g0 = so.grad_from_scores(z0, x0)
+        gnorm0 = norm(g0)
+        values = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(f0)
+        gnorms = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(gnorm0)
+
+        class _St(NamedTuple):
+            x: Array
+            z: Array
+            f: Array
+            g: Array
+            hist: LBFGSHistory
+            it: Array
+            reason: Array
+            gnorm0: Array
+            values: Array
+            grad_norms: Array
+
+        init = _St(x0, z0, f0, g0, empty_history(m, d, dtype),
+                   jnp.zeros((), jnp.int32),
+                   jnp.asarray(NOT_CONVERGED, jnp.int32),
+                   gnorm0, values, gnorms)
+
+        def cond(st):
+            return (st.reason == NOT_CONVERGED) & (st.it < max_it)
+
+        def body(st):
+            dvec = two_loop_direction(st.g, st.hist, dot)
+            descent = dot(dvec, st.g) < 0
+            dvec = jnp.where(descent, dvec, -st.g)
+            zp = so.score_delta(dvec)          # the ONE data pass (matvec)
+            dg = dot(dvec, st.g)
+
+            # Probes are elementwise over maintained scores — no data pass.
+            t_final, ft, _, accept, _ = armijo_backtrack(
+                lambda t: (
+                    so.value_from_scores(st.z + t * zp, st.x + t * dvec),
+                    jnp.zeros((), dtype),
+                ),
+                st.f, dg, jnp.zeros((), dtype),
+                cfg.max_line_search_iterations, c1, shrink,
+            )
+            x_new = jnp.where(accept, st.x + t_final * dvec, st.x)
+            z_new = jnp.where(accept, st.z + t_final * zp, st.z)
+            # Refresh z from x periodically: the incremental z accumulates
+            # one rounding per accepted step, which can stall convergence
+            # near the optimum. One extra matvec every 8 iterations.
+            z_new = lax.cond(
+                jnp.mod(st.it + 1, 8) == 0,
+                lambda: so.score(x_new),
+                lambda: z_new,
+            )
+            f_new = jnp.where(accept, ft, st.f)
+            g_new = so.grad_from_scores(z_new, x_new)   # one rmatvec
+
+            hist = update_history(st.hist, x_new - st.x, g_new - st.g, dot)
+            it = st.it + 1
+            gnorm = norm(g_new)
+            reason = check_convergence(it, st.f, f_new, gnorm, st.gnorm0, cfg)
+            reason = jnp.where(
+                (t_final == 0.0) & (reason == NOT_CONVERGED),
+                jnp.asarray(FUNCTION_VALUES_CONVERGED, jnp.int32),
+                reason,
+            )
+            return _St(x_new, z_new, f_new, g_new, hist, it, reason,
+                       st.gnorm0,
+                       st.values.at[it].set(f_new),
+                       st.grad_norms.at[it].set(gnorm))
 
         st = lax.while_loop(cond, body, init)
         reason = finalize_reason(st.reason, st.it, max_it)

@@ -57,6 +57,11 @@ def fit_data_parallel(
     from photon_tpu.parallel.mesh import pad_rows_to_multiple
 
     axis_size = mesh.shape[data_axis]
+    if getattr(batch.features, "fast", None) is not None:
+        # The column-sorted fast-path table is not row-shardable.
+        batch = dataclasses.replace(
+            batch, features=batch.features.without_fast_path()
+        )
     if batch.n_rows % axis_size:
         batch = pad_rows_to_multiple(batch, axis_size)
 
@@ -99,6 +104,10 @@ def spmd_value_and_grad(
     term is added once globally (outside the psum), not once per shard.
     """
     data_obj = GLMObjective(loss=obj.loss, l2_weight=0.0, reg_mask=None)
+    if getattr(batch.features, "fast", None) is not None:
+        batch = dataclasses.replace(
+            batch, features=batch.features.without_fast_path()
+        )
     batch_specs = jax.tree.map(
         lambda leaf: P(data_axis, *([None] * (leaf.ndim - 1))), batch
     )

@@ -1,0 +1,152 @@
+"""Correctness of the MXU-friendly sparse fast paths (ops/fast_sparse.py)
+and the incremental-score L-BFGS variant, vs the generic implementations."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.batch import LabeledBatch, SparseFeatures, ell_from_rows
+from photon_tpu.functions.objective import GLMObjective
+from photon_tpu.functions.problem import GLMOptimizationProblem
+from photon_tpu.ops.fast_sparse import build_fast_aux, matvec_fast, rmatvec_fast
+from photon_tpu.ops.losses import loss_for_task
+from photon_tpu.optim import (LBFGS, OptimizerConfig, OptimizerType,
+                              RegularizationContext, RegularizationType)
+from photon_tpu.types import TaskType
+
+
+def _random_sparse(n, dim, k, seed=0, skew=False):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(n):
+        nnz = rng.integers(1, k + 1)
+        if skew and i % 3 == 0:
+            cols = np.unique(np.concatenate([
+                rng.integers(0, 8, size=nnz),       # hot columns
+                rng.integers(0, dim, size=2),
+            ]))
+        else:
+            cols = np.unique(rng.integers(0, dim, size=nnz))
+        vals = rng.normal(size=len(cols))
+        rows.append((cols.tolist(), vals.tolist()))
+    return ell_from_rows(rows, dim=dim)
+
+
+@pytest.mark.parametrize("skew", [False, True])
+def test_matvec_rmatvec_match_generic(skew):
+    n, dim, k = 300, 517, 9   # deliberately non-multiples of 128
+    sf = _random_sparse(n, dim, k, seed=1, skew=skew)
+    aux = build_fast_aux(np.asarray(sf.idx), np.asarray(sf.val), dim,
+                         q_capacity=64)
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=dim).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=n).astype(np.float32))
+
+    np.testing.assert_allclose(
+        np.asarray(matvec_fast(aux, sf.val, w, dim)),
+        np.asarray(sf.matvec(w)), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(rmatvec_fast(aux, v, dim)),
+        np.asarray(sf.rmatvec(v)), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(rmatvec_fast(aux, v, dim, square_vals=True)),
+        np.asarray(sf.sq_rmatvec(v)), rtol=1e-5, atol=1e-5)
+
+
+def test_with_fast_path_dispatch():
+    n, dim, k = 200, 300, 7
+    sf = _random_sparse(n, dim, k, seed=3)
+    fast = sf.with_fast_path(q_capacity=128)
+    assert fast.fast is not None
+    rng = np.random.default_rng(4)
+    w = jnp.asarray(rng.normal(size=dim).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(fast.matvec(w)),
+                               np.asarray(sf.matvec(w)), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(fast.rmatvec(v)),
+                               np.asarray(sf.rmatvec(v)), rtol=1e-5, atol=1e-5)
+    assert fast.without_fast_path().fast is None
+
+
+def test_fast_path_under_jit_and_objective():
+    n, dim, k = 256, 384, 8
+    sf = _random_sparse(n, dim, k, seed=5).with_fast_path(q_capacity=256)
+    rng = np.random.default_rng(6)
+    labels = (rng.random(n) < 0.5).astype(np.float32)
+    batch = LabeledBatch(
+        features=sf,
+        labels=jnp.asarray(labels),
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+    slow_batch = LabeledBatch(
+        features=sf.without_fast_path(),
+        labels=batch.labels, offsets=batch.offsets, weights=batch.weights,
+    )
+    obj = GLMObjective(loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+                       l2_weight=0.5)
+    w = jnp.asarray(rng.normal(size=dim).astype(np.float32) * 0.1)
+    vf, gf = jax.jit(obj.value_and_grad)(w, batch)
+    vs, gs = jax.jit(obj.value_and_grad)(w, slow_batch)
+    np.testing.assert_allclose(np.asarray(vf), np.asarray(vs), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(gs),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_scored_lbfgs_matches_plain():
+    """optimize_scored reaches the same optimum as optimize on a logistic
+    problem (same math; different per-probe rounding)."""
+    n, dim, k = 400, 64, 6
+    sf = _random_sparse(n, dim, k, seed=7)
+    rng = np.random.default_rng(8)
+    w_true = rng.normal(size=dim)
+    z = np.asarray(sf.matvec(jnp.asarray(w_true, jnp.float32)))
+    labels = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float32)
+    batch = LabeledBatch(
+        features=sf, labels=jnp.asarray(labels),
+        offsets=jnp.zeros((n,), jnp.float32),
+        weights=jnp.ones((n,), jnp.float32),
+    )
+    obj = GLMObjective(loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+                       l2_weight=1.0)
+    cfg = OptimizerConfig(max_iterations=60, tolerance=1e-9)
+    w0 = jnp.zeros((dim,), jnp.float32)
+    r_plain = LBFGS(cfg).optimize(obj.bind(batch), w0)
+    r_scored = LBFGS(cfg).optimize_scored(obj.score_space(batch), w0)
+    # f32 line searches stall at slightly different near-optimal points;
+    # assert mutual near-optimality rather than bitwise trajectory equality.
+    assert float(r_scored.value) == pytest.approx(float(r_plain.value),
+                                                  rel=5e-3)
+    np.testing.assert_allclose(np.asarray(r_scored.x), np.asarray(r_plain.x),
+                               rtol=0.05, atol=0.05)
+
+
+def test_problem_run_uses_scored_path_and_matches():
+    """GLMOptimizationProblem.run (LBFGS, no normalization) reaches the same
+    optimum with and without the fast feature path attached."""
+    n, dim, k = 300, 200, 8
+    sf = _random_sparse(n, dim, k, seed=9)
+    rng = np.random.default_rng(10)
+    labels = (rng.random(n) < 0.4).astype(np.float32)
+
+    def make_batch(features):
+        return LabeledBatch(
+            features=features, labels=jnp.asarray(labels),
+            offsets=jnp.zeros((n,), jnp.float32),
+            weights=jnp.ones((n,), jnp.float32),
+        )
+
+    problem = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_type=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=200, tolerance=1e-10),
+        regularization=RegularizationContext(RegularizationType.L2),
+        reg_weight=1.0,
+    )
+    w0 = jnp.zeros((dim,), jnp.float32)
+    m_slow, r_slow = problem.run(make_batch(sf), w0)
+    m_fast, r_fast = problem.run(make_batch(sf.with_fast_path(q_capacity=256)), w0)
+    assert float(r_fast.value) == pytest.approx(float(r_slow.value), rel=5e-3)
+    np.testing.assert_allclose(
+        np.asarray(m_fast.coefficients.means),
+        np.asarray(m_slow.coefficients.means), rtol=0.05, atol=0.05)
