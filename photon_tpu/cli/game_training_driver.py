@@ -94,6 +94,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="reg-weight search range per coordinate (repeatable; log scale)")
     p.add_argument("--index-dir", default=None,
                    help="prebuilt per-shard mmap index maps (else built from training data)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="enable step-level checkpointing; a restarted run with "
+                        "the same args auto-resumes from the newest snapshot")
     p.add_argument("--devices", type=int, default=0,
                    help="data-parallel mesh size; 0 = all visible devices, 1 = no mesh")
     p.add_argument("--mesh", default=None, metavar="data=4,model=2",
@@ -270,6 +273,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         )
 
         if args.tuning:
+            if args.checkpoint_dir:
+                raise ValueError(
+                    "--checkpoint-dir is not supported with --tuning (the GP "
+                    "search loop has no step-level checkpoint path yet)"
+                )
             if not (args.evaluators and validation is not None):
                 raise ValueError("--tuning needs --evaluators and --validation-data")
             if not args.tuning_range:
@@ -304,13 +312,21 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             # The best config's model was already trained during the search.
             results = [tuning.best_result]
         else:
+            ckpt = None
+            if args.checkpoint_dir:
+                from photon_tpu.checkpoint import CheckpointManager
+
+                ckpt = CheckpointManager(args.checkpoint_dir)
             with Timed("fit", logger) as fit_timer:
                 results = estimator.fit(
                     train,
                     validation if args.evaluators else None,
                     configs,
                     initial_model=initial_model,
+                    checkpoint_manager=ckpt,
                 )
+            if ckpt is not None:
+                ckpt.close()
 
         suite = (
             EvaluationSuite.parse(args.evaluators) if args.evaluators else None

@@ -154,6 +154,7 @@ class GameEstimator:
         validation_data: Optional[GameDataBundle] = None,
         configs: Sequence[GameOptimizationConfiguration] = (),
         initial_model: Optional[GameModel] = None,
+        checkpoint_manager=None,
     ) -> list[GameFitResult]:
         """Train one GameModel per optimization configuration.
 
@@ -161,6 +162,12 @@ class GameEstimator:
         once and shared across the sweep (reference: datasets persist across
         the config loop and unpersist after). ``initial_model`` warm-starts
         every configuration (reference ⟦modelInputDirectory⟧).
+
+        ``checkpoint_manager`` (photon_tpu.checkpoint.CheckpointManager)
+        enables step-level checkpointing: every coordinate step and every
+        completed configuration is snapshotted, and a fresh ``fit`` over the
+        same inputs auto-resumes from the newest snapshot, reproducing the
+        uninterrupted result bit-identically.
         """
         if not configs:
             raise ValueError("at least one GameOptimizationConfiguration required")
@@ -185,7 +192,45 @@ class GameEstimator:
         )
 
         results: list[GameFitResult] = []
+        start_config, descent_resume, fingerprint = 0, None, None
+        if checkpoint_manager is not None:
+            import hashlib
+
+            fingerprint = hashlib.sha256(repr((
+                self.task,
+                tuple(self.update_sequence),
+                self.n_sweeps,
+                tuple(self.evaluator_specs),
+                [sorted((cid, repr(c)) for cid, c in cfg.items())
+                 for cfg in configs],
+                data.n_rows,
+            )).encode()).hexdigest()[:16]
+            payload = checkpoint_manager.load_latest()
+            if payload is not None:
+                meta = payload["meta"]
+                if meta.get("run_fingerprint") != fingerprint:
+                    raise ValueError(
+                        "checkpoint directory holds snapshots from a run with "
+                        "different configuration (task/coordinates/sweeps/"
+                        "configs/data changed) — resuming would silently mix "
+                        "incompatible state; use a fresh --checkpoint-dir"
+                    )
+                results = list(payload["state"].get("completed_results", []))
+                if meta.get("phase") == "config_done":
+                    start_config = meta["config_index"] + 1
+                else:
+                    start_config = meta["config_index"]
+                    descent_resume = payload
+                logger.info(
+                    "resuming from checkpoint step %d (config %d)",
+                    payload["step"], start_config,
+                )
+
+        # Each config owns steps_per_config descent steps + 1 config-done slot.
+        steps_per_config = self.n_sweeps * len(self.update_sequence)
         for i, cfg in enumerate(configs):
+            if i < start_config:
+                continue
             logger.info("=== configuration %d/%d ===", i + 1, len(configs))
             coordinates = self._build_coordinates(
                 prep, cfg, config_index=i, initial_model=initial_model
@@ -201,13 +246,29 @@ class GameEstimator:
                 validation=validation,
                 suite=suite,
                 initial_models=dict(initial_model.models) if initial_model else None,
+                checkpointer=checkpoint_manager,
+                resume=descent_resume if i == start_config else None,
+                step_base=i * (steps_per_config + 1),
+                checkpoint_meta={"config_index": i,
+                                 "run_fingerprint": fingerprint},
+                extra_state={"completed_results": results},
             )
+            descent_resume = None
             evaluation = (
                 self._evaluate(model, validation, suite)
                 if validation is not None
                 else None
             )
             results.append(GameFitResult(model, evaluation, cfg, tracker))
+            if checkpoint_manager is not None:
+                checkpoint_manager.save(
+                    i * (steps_per_config + 1) + steps_per_config,
+                    state={"completed_results": results},
+                    meta={"phase": "config_done", "config_index": i,
+                          "run_fingerprint": fingerprint},
+                )
+        if checkpoint_manager is not None:
+            checkpoint_manager.wait()
         return results
 
     # ----------------------------------------------------------- internals

@@ -84,7 +84,18 @@ class CoordinateDescent:
         validation: Optional[ValidationData] = None,
         suite: Optional[EvaluationSuite] = None,
         initial_models: Optional[Mapping[str, DatumScoringModel]] = None,
+        checkpointer=None,
+        resume: Optional[dict] = None,
+        step_base: int = 0,
+        checkpoint_meta: Optional[dict] = None,
+        extra_state: Optional[dict] = None,
     ) -> tuple[GameModel, list[CoordinateStepRecord]]:
+        """``checkpointer`` (a ``photon_tpu.checkpoint.CheckpointManager``)
+        snapshots the full descent state after every coordinate step
+        (SURVEY.md §5.4 rebuild note); ``resume`` is a payload from
+        ``load_latest`` whose position is fast-forwarded past. Resumed runs
+        reproduce the uninterrupted run bit-identically.
+        """
         for cid in self.update_sequence:
             if cid not in coordinates:
                 raise ValueError(f"update sequence names unknown coordinate {cid!r}")
@@ -97,25 +108,54 @@ class CoordinateDescent:
             else jnp.asarray(base_offsets)
         )
 
-        models: dict[str, DatumScoringModel] = dict(initial_models or {})
-        scores: dict[str, Array] = {}
-        # Initial scores from warm-start models, else zero. Models OUTSIDE the
-        # update sequence are "locked" coordinates (reference partial
-        # retraining): scored so residuals are right, never retrained, kept in
-        # the output model.
-        for cid in self.update_sequence:
-            if cid in models:
+        resumed_pos = None
+        if resume is not None:
+            st = resume["state"]
+            models = dict(st["models"])
+            scores = dict(st["scores"])
+            total = st["total"]
+            v_cache = dict(st["v_cache"])
+            best_metric = st["best_metric"]
+            best_models = st["best_models"]
+            tracker = list(st["tracker"])
+            resumed_pos = (resume["meta"]["sweep"], resume["meta"]["coord_index"])
+            logger.info(
+                "resuming after sweep %d coordinate %d",
+                resumed_pos[0], resumed_pos[1],
+            )
+        else:
+            models = dict(initial_models or {})
+            scores = {}
+            # Initial scores from warm-start models, else zero. Models OUTSIDE
+            # the update sequence are "locked" coordinates (reference partial
+            # retraining): scored so residuals are right, never retrained,
+            # kept in the output model.
+            for cid in self.update_sequence:
+                if cid in models:
+                    scores[cid] = coordinates[cid].score(models[cid])
+                else:
+                    scores[cid] = jnp.zeros((n_rows,), base.dtype)
+            for cid in sorted(set(models) - set(self.update_sequence)):
+                if cid not in coordinates:
+                    raise ValueError(
+                        f"initial model {cid!r} is outside the update sequence "
+                        "and has no coordinate to score it (locked coordinates "
+                        "need a coordinate for residual bookkeeping)"
+                    )
                 scores[cid] = coordinates[cid].score(models[cid])
-            else:
-                scores[cid] = jnp.zeros((n_rows,), base.dtype)
-        for cid in sorted(set(models) - set(self.update_sequence)):
-            if cid not in coordinates:
-                raise ValueError(
-                    f"initial model {cid!r} is outside the update sequence "
-                    "and has no coordinate to score it (locked coordinates "
-                    "need a coordinate for residual bookkeeping)"
-                )
-            scores[cid] = coordinates[cid].score(models[cid])
+            total = base + sum(scores.values())
+            tracker = []
+            best_metric = None
+            best_models = None
+            # Validation scores cached per coordinate — only the coordinate
+            # just trained is re-scored (random-effect cross-dataset
+            # projection is host-side work, so re-scoring every coordinate
+            # each step is O(C²)).
+            v_cache = {
+                cid: validation.scorers[cid](models[cid])
+                for cid in models
+                if validation is not None
+            }
         if validation is not None:
             need = set(self.update_sequence) | set(models)
             missing = sorted(c for c in need if c not in validation.scorers)
@@ -123,22 +163,13 @@ class CoordinateDescent:
                 raise ValueError(
                     f"validation scorers missing for coordinates {missing}"
                 )
-        total = base + sum(scores.values())
 
-        tracker: list[CoordinateStepRecord] = []
-        best_metric: Optional[float] = None
-        best_models: Optional[dict] = None
-        # Validation scores cached per coordinate — only the coordinate just
-        # trained is re-scored (random-effect cross-dataset projection is
-        # host-side work, so re-scoring every coordinate each step is O(C²)).
-        v_cache: dict[str, Array] = {
-            cid: validation.scorers[cid](models[cid])
-            for cid in models
-            if validation is not None
-        }
-
+        step = step_base
         for sweep in range(self.n_sweeps):
-            for cid in self.update_sequence:
+            for ci, cid in enumerate(self.update_sequence):
+                if resumed_pos is not None and (sweep, ci) <= resumed_pos:
+                    step += 1
+                    continue
                 coord = coordinates[cid]
                 t0 = time.perf_counter()
                 residual_offset = total - scores[cid]
@@ -178,6 +209,28 @@ class CoordinateDescent:
                 else:
                     logger.info("sweep %d coord %s done (%.2fs)", sweep, cid, dt)
                 tracker.append(record)
+
+                if checkpointer is not None:
+                    checkpointer.save(
+                        step,
+                        state={
+                            "models": models,
+                            "scores": scores,
+                            "total": total,
+                            "v_cache": v_cache,
+                            "best_metric": best_metric,
+                            "best_models": best_models,
+                            "tracker": tracker,
+                            **(extra_state or {}),
+                        },
+                        meta={
+                            "phase": "step",
+                            "sweep": sweep,
+                            "coord_index": ci,
+                            **(checkpoint_meta or {}),
+                        },
+                    )
+                step += 1
 
         final = best_models if best_models is not None else models
         return GameModel(dict(final)), tracker

@@ -196,18 +196,18 @@ def backtracking_line_search(
     t_final, ft, gt, accept, n = armijo_backtrack(
         lambda t: value_and_grad(x + t * d), f, dg, g, max_iters, c1, shrink
     )
-    t_used = jnp.where(accept, t_final, 0.0)
     # Select (not scale by t=0): keeps x clean even if d has NaN/Inf entries.
     x_new = jnp.where(accept, x + t_final * d, x)
     f_new = jnp.where(accept, ft, f)
     g_new = jax.tree.map(lambda a, b: jnp.where(accept, a, b), gt, g)
-    return x_new, f_new, g_new, t_used, n
+    return x_new, f_new, g_new, t_final, n
 
 
 class _LoopState(NamedTuple):
     x: Array
     f: Array
     g: Array
+    extra: object          # step-strategy carry (e.g. maintained scores z)
     hist: LBFGSHistory
     it: Array
     reason: Array
@@ -228,23 +228,24 @@ class LBFGS(Optimizer):
 
     axis_name: str = None
 
-    def optimize(self, value_and_grad: ValueAndGrad, x0: Array) -> OptimizerResult:
+    def _solve(self, x0, f0, g0, extra0, step_fn) -> OptimizerResult:
+        """Shared loop core: direction, step via ``step_fn``, history update,
+        convergence bookkeeping. ``step_fn(st, dvec, it) →
+        (x, f, g, extra, t_final)``; ``t_final == 0`` marks a fully failed
+        line search (no further progress possible)."""
         cfg = self.config
-        m = cfg.history_length
         max_it = cfg.max_iterations
-        d = x0.shape[-1]
         dtype = x0.dtype
         dot = make_dot(self.axis_name)
         norm = lambda v: jnp.sqrt(dot(v, v))
 
-        f0, g0 = value_and_grad(x0)
         gnorm0 = norm(g0)
         values = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(f0)
         gnorms = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(gnorm0)
 
         init = _LoopState(
-            x=x0, f=f0, g=g0,
-            hist=empty_history(m, d, dtype),
+            x=x0, f=f0, g=g0, extra=extra0,
+            hist=empty_history(cfg.history_length, x0.shape[-1], dtype),
             it=jnp.zeros((), jnp.int32),
             reason=jnp.asarray(NOT_CONVERGED, jnp.int32),
             gnorm0=gnorm0,
@@ -260,10 +261,7 @@ class LBFGS(Optimizer):
             descent = dot(dvec, st.g) < 0
             dvec = jnp.where(descent, dvec, -st.g)
 
-            x_new, f_new, g_new, t, _ = backtracking_line_search(
-                value_and_grad, st.x, st.f, st.g, dvec,
-                cfg.max_line_search_iterations, dot=dot,
-            )
+            x_new, f_new, g_new, extra, t = step_fn(st, dvec, st.it)
             hist = update_history(st.hist, x_new - st.x, g_new - st.g, dot)
             it = st.it + 1
             gnorm = norm(g_new)
@@ -275,7 +273,7 @@ class LBFGS(Optimizer):
                 reason,
             )
             return _LoopState(
-                x=x_new, f=f_new, g=g_new, hist=hist, it=it,
+                x=x_new, f=f_new, g=g_new, extra=extra, hist=hist, it=it,
                 reason=reason, gnorm0=st.gnorm0,
                 values=st.values.at[it].set(f_new),
                 grad_norms=st.grad_norms.at[it].set(gnorm),
@@ -288,6 +286,20 @@ class LBFGS(Optimizer):
             iterations=st.it, converged_reason=reason,
             values=st.values, grad_norms=st.grad_norms,
         )
+
+    def optimize(self, value_and_grad: ValueAndGrad, x0: Array) -> OptimizerResult:
+        cfg = self.config
+        dot = make_dot(self.axis_name)
+        f0, g0 = value_and_grad(x0)
+
+        def step(st, dvec, it):
+            x_new, f_new, g_new, t, _ = backtracking_line_search(
+                value_and_grad, st.x, st.f, st.g, dvec,
+                cfg.max_line_search_iterations, dot=dot,
+            )
+            return x_new, f_new, g_new, st.extra, t
+
+        return self._solve(x0, f0, g0, jnp.zeros((), x0.dtype), step)
 
     def optimize_scored(self, so, x0: Array) -> OptimizerResult:
         """L-BFGS with incrementally maintained margins z = Xw + offsets.
@@ -304,88 +316,38 @@ class LBFGS(Optimizer):
         floating-point rounding of z + t·Xp vs X(w + t·p) differs at ~ulp).
         """
         cfg = self.config
-        m = cfg.history_length
-        max_it = cfg.max_iterations
-        d = x0.shape[-1]
-        dtype = x0.dtype
         dot = make_dot(self.axis_name)
-        norm = lambda v: jnp.sqrt(dot(v, v))
-        c1, shrink = 1e-4, 0.5
+        dtype = x0.dtype
 
         z0 = so.score(x0)
         f0 = so.value_from_scores(z0, x0)
         g0 = so.grad_from_scores(z0, x0)
-        gnorm0 = norm(g0)
-        values = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(f0)
-        gnorms = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(gnorm0)
 
-        class _St(NamedTuple):
-            x: Array
-            z: Array
-            f: Array
-            g: Array
-            hist: LBFGSHistory
-            it: Array
-            reason: Array
-            gnorm0: Array
-            values: Array
-            grad_norms: Array
-
-        init = _St(x0, z0, f0, g0, empty_history(m, d, dtype),
-                   jnp.zeros((), jnp.int32),
-                   jnp.asarray(NOT_CONVERGED, jnp.int32),
-                   gnorm0, values, gnorms)
-
-        def cond(st):
-            return (st.reason == NOT_CONVERGED) & (st.it < max_it)
-
-        def body(st):
-            dvec = two_loop_direction(st.g, st.hist, dot)
-            descent = dot(dvec, st.g) < 0
-            dvec = jnp.where(descent, dvec, -st.g)
+        def step(st, dvec, it):
+            z = st.extra
             zp = so.score_delta(dvec)          # the ONE data pass (matvec)
             dg = dot(dvec, st.g)
-
             # Probes are elementwise over maintained scores — no data pass.
             t_final, ft, _, accept, _ = armijo_backtrack(
                 lambda t: (
-                    so.value_from_scores(st.z + t * zp, st.x + t * dvec),
+                    so.value_from_scores(z + t * zp, st.x + t * dvec),
                     jnp.zeros((), dtype),
                 ),
                 st.f, dg, jnp.zeros((), dtype),
-                cfg.max_line_search_iterations, c1, shrink,
+                cfg.max_line_search_iterations,
             )
             x_new = jnp.where(accept, st.x + t_final * dvec, st.x)
-            z_new = jnp.where(accept, st.z + t_final * zp, st.z)
+            z_new = jnp.where(accept, z + t_final * zp, z)
             # Refresh z from x periodically: the incremental z accumulates
             # one rounding per accepted step, which can stall convergence
             # near the optimum. One extra matvec every 8 iterations.
             z_new = lax.cond(
-                jnp.mod(st.it + 1, 8) == 0,
+                jnp.mod(it + 1, 8) == 0,
                 lambda: so.score(x_new),
                 lambda: z_new,
             )
             f_new = jnp.where(accept, ft, st.f)
             g_new = so.grad_from_scores(z_new, x_new)   # one rmatvec
+            return x_new, f_new, g_new, z_new, t_final
 
-            hist = update_history(st.hist, x_new - st.x, g_new - st.g, dot)
-            it = st.it + 1
-            gnorm = norm(g_new)
-            reason = check_convergence(it, st.f, f_new, gnorm, st.gnorm0, cfg)
-            reason = jnp.where(
-                (t_final == 0.0) & (reason == NOT_CONVERGED),
-                jnp.asarray(FUNCTION_VALUES_CONVERGED, jnp.int32),
-                reason,
-            )
-            return _St(x_new, z_new, f_new, g_new, hist, it, reason,
-                       st.gnorm0,
-                       st.values.at[it].set(f_new),
-                       st.grad_norms.at[it].set(gnorm))
-
-        st = lax.while_loop(cond, body, init)
-        reason = finalize_reason(st.reason, st.it, max_it)
-        return OptimizerResult(
-            x=st.x, value=st.f, grad_norm=norm(st.g),
-            iterations=st.it, converged_reason=reason,
-            values=st.values, grad_norms=st.grad_norms,
-        )
+        return self._solve(x0, f0, g0, z0, step)

@@ -1,0 +1,176 @@
+"""Step-level checkpoint/resume (photon_tpu/checkpoint.py + descent/estimator
+integration): killed mid-run, a resumed fit reproduces the uninterrupted
+final model bit-identically (SURVEY.md §5.3/§5.4 rebuild requirement)."""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.checkpoint import CheckpointManager
+from photon_tpu.data.batch import SparseFeatures
+from photon_tpu.estimators.config import (
+    FixedEffectDataConfig,
+    GLMOptimizationConfiguration,
+    RandomEffectDataConfig,
+)
+from photon_tpu.estimators.game_estimator import GameEstimator
+from photon_tpu.io.data_reader import GameDataBundle
+from photon_tpu.optim import RegularizationContext, RegularizationType
+from photon_tpu.types import TaskType
+
+
+def _bundle(seed=0, n_users=6, rows_per_user=30, d_global=8, d_user=3):
+    rng = np.random.default_rng(seed)
+    n = n_users * rows_per_user
+    dim = d_global + n_users * d_user
+    users = np.repeat(np.arange(n_users), rows_per_user)
+    rng.shuffle(users)
+    k = 5
+    gi = rng.integers(0, d_global, size=(n, k)).astype(np.int32)
+    gv = rng.normal(size=(n, k)).astype(np.float32)
+    ui = (d_global + users[:, None] * d_user
+          + rng.integers(0, d_user, size=(n, 2))).astype(np.int32)
+    uv = rng.normal(size=(n, 2)).astype(np.float32)
+    idx = np.concatenate([gi, ui], 1)
+    val = np.concatenate([gv, uv], 1)
+    z = (gv * 0.5).sum(1) + uv.sum(1) * 0.5
+    labels = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float64)
+    return GameDataBundle(
+        features={"g": SparseFeatures(jnp.asarray(idx), jnp.asarray(val), dim)},
+        labels=labels,
+        offsets=np.zeros(n),
+        weights=np.ones(n),
+        uids=np.arange(n).astype(object),
+        id_tags={"userId": np.array([f"u{u}" for u in users], object)},
+    )
+
+
+def _estimator():
+    return GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={
+            "fixed": FixedEffectDataConfig("g"),
+            "perUser": RandomEffectDataConfig(re_type="userId",
+                                              feature_shard="g"),
+        },
+        n_sweeps=2,
+        evaluator_specs=("AUC",),
+    )
+
+
+def _configs():
+    base = dict(
+        regularization=RegularizationContext(RegularizationType.L2),
+        max_iterations=15,
+    )
+    return [
+        {"fixed": GLMOptimizationConfiguration(reg_weight=w, **base),
+         "perUser": GLMOptimizationConfiguration(reg_weight=1.0, **base)}
+        for w in (0.5, 5.0)
+    ]
+
+
+def _final_arrays(results):
+    out = []
+    for r in results:
+        fx = r.model["fixed"].model.coefficients.means
+        out.append(np.asarray(fx))
+        re = r.model["perUser"]
+        for c in re.bucket_coefs:
+            out.append(np.asarray(c))
+    return out
+
+
+def test_manager_roundtrip(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=2)
+    for step in range(5):
+        mgr.save(step, {"a": jnp.arange(3) + step, "b": [step]}, {"tag": step})
+    mgr.wait()
+    payload = mgr.load_latest()
+    assert payload["step"] == 4
+    assert payload["meta"]["tag"] == 4
+    np.testing.assert_array_equal(payload["state"]["a"], np.arange(3) + 4)
+    # keep=2: old steps garbage-collected
+    names = sorted(os.listdir(tmp_path / "ck"))
+    assert names == ["step-3", "step-4"]
+    mgr.close()
+
+
+def test_load_latest_skips_corrupt(tmp_path):
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=3)
+    mgr.save(0, {"x": 1}); mgr.save(1, {"x": 2}); mgr.wait()
+    with open(tmp_path / "ck" / "step-2", "wb") as f:
+        f.write(b"torn write")
+    payload = mgr.load_latest()
+    assert payload["state"]["x"] == 2
+    mgr.close()
+
+
+@pytest.mark.parametrize("fail_after", [1, 3, 5, 7])
+def test_kill_and_resume_bit_identical(tmp_path, fail_after):
+    """Crash after N coordinate-step checkpoints (spanning mid-sweep and
+    config boundaries: 2 configs x 2 sweeps x 2 coords + 2 config-done saves),
+    resume, and require the final models match the uninterrupted run exactly."""
+    bundle = _bundle()
+    ref = _estimator().fit(bundle, _bundle(seed=1), _configs())
+
+    ckdir = str(tmp_path / f"ck{fail_after}")
+    mgr = CheckpointManager(ckdir, fail_after=fail_after)
+    with pytest.raises(KeyboardInterrupt):
+        _estimator().fit(bundle, _bundle(seed=1), _configs(),
+                         checkpoint_manager=mgr)
+    mgr.close()
+
+    mgr2 = CheckpointManager(ckdir)
+    resumed = _estimator().fit(bundle, _bundle(seed=1), _configs(),
+                               checkpoint_manager=mgr2)
+    mgr2.close()
+
+    assert len(resumed) == len(ref)
+    for a, b in zip(_final_arrays(resumed), _final_arrays(ref)):
+        np.testing.assert_array_equal(a, b)
+    for ra, rb in zip(resumed, ref):
+        assert ra.evaluation.values == rb.evaluation.values
+        assert len(ra.tracker) == len(rb.tracker)
+
+
+def test_resume_rejects_changed_run(tmp_path):
+    """A checkpoint dir from a different run configuration must not be
+    silently resumed."""
+    bundle = _bundle()
+    ckdir = str(tmp_path / "ck")
+    mgr = CheckpointManager(ckdir, fail_after=2)
+    with pytest.raises(KeyboardInterrupt):
+        _estimator().fit(bundle, _bundle(seed=1), _configs(),
+                         checkpoint_manager=mgr)
+    mgr.close()
+    changed = _configs()[:1]  # different config list
+    mgr2 = CheckpointManager(ckdir)
+    with pytest.raises(ValueError, match="different configuration"):
+        _estimator().fit(bundle, _bundle(seed=1), changed,
+                         checkpoint_manager=mgr2)
+    mgr2.close()
+
+
+def test_driver_checkpoint_flag(tmp_path):
+    """--checkpoint-dir writes snapshots during a driver run."""
+    import json
+    from photon_tpu.cli import game_training_driver
+    from photon_tpu.io.avro import write_container
+    from tests.test_drivers import RECORD_SCHEMA, _write_game_avro
+
+    d = tmp_path / "data"
+    d.mkdir()
+    _write_game_avro(d / "train.avro", seed=1, n_users=4, rows_per_user=12)
+    out = tmp_path / "out"
+    summary = game_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate", "fixed:type=fixed,shard=global,reg=L2,max_iter=10,reg_weights=1",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--devices", "1",
+    ])
+    assert any(n.startswith("step-") for n in os.listdir(tmp_path / "ck"))
