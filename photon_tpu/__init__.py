@@ -1,6 +1,6 @@
 """photon-tpu: a TPU-native framework with the capabilities of photon-ml.
 
-A from-scratch JAX/XLA/Pallas rebuild of the reference
+A from-scratch JAX/XLA rebuild of the reference
 (TheClimateCorporation/photon-ml, LinkedIn-lineage GLM + GAME/GLMix on
 Spark/Scala — see SURVEY.md): generalized linear models (logistic, linear,
 Poisson, smoothed-hinge SVM), batch second-order optimizers (L-BFGS, OWL-QN,

@@ -33,6 +33,8 @@ from typing import Optional, Union
 import jax
 import jax.numpy as jnp
 
+from photon_tpu.ops import pass_counter
+
 Array = jax.Array
 
 
@@ -52,14 +54,17 @@ class DenseFeatures:
         return self.x.shape[1]
 
     def matvec(self, w: Array) -> Array:
+        pass_counter.record("matvec")
         return self.x @ w
 
     def rmatvec(self, v: Array) -> Array:
         """Xᵀv — accumulate per-row coefficients ``v`` into feature space."""
+        pass_counter.record("rmatvec")
         return self.x.T @ v
 
     def sq_rmatvec(self, v: Array) -> Array:
         """(X∘X)ᵀv — for Hessian diagonals: Σᵢ vᵢ·xᵢⱼ²."""
+        pass_counter.record("sq_rmatvec")
         return (self.x * self.x).T @ v
 
     def row_slice(self, start: int, size: int) -> "DenseFeatures":
@@ -113,6 +118,7 @@ class SparseFeatures:
         return dataclasses.replace(self, fast=None)
 
     def matvec(self, w: Array) -> Array:
+        pass_counter.record("matvec")
         if self.fast is not None:
             from photon_tpu.ops.fast_sparse import matvec_fast
 
@@ -123,6 +129,7 @@ class SparseFeatures:
         return jnp.sum(w_ext[self.idx] * self.val, axis=-1)
 
     def rmatvec(self, v: Array) -> Array:
+        pass_counter.record("rmatvec")
         if self.fast is not None:
             from photon_tpu.ops.fast_sparse import rmatvec_fast
 
@@ -134,6 +141,7 @@ class SparseFeatures:
         return out[: self.dim]
 
     def sq_rmatvec(self, v: Array) -> Array:
+        pass_counter.record("sq_rmatvec")
         if self.fast is not None:
             from photon_tpu.ops.fast_sparse import rmatvec_fast
 

@@ -148,6 +148,23 @@ class NormalizationContext:
 
         return wrapped
 
+    def wrap_hvp_at(
+        self, hvp_at: Callable[[Array], Callable[[Array], Array]]
+    ) -> Callable[[Array], Callable[[Array], Array]]:
+        """Factory form of ``wrap_hvp``: the original-space point and its
+        pullback are computed once per x, preserving the inner factory's
+        hoisting (TRON's CG loop calls only the returned ``v ↦ H'v``)."""
+        if self.is_identity:
+            return hvp_at
+
+        def wrapped(wp: Array) -> Callable[[Array], Array]:
+            w = self.coef_to_original(wp)
+            _, pullback = jax.vjp(self.coef_to_original, wp)
+            hv = hvp_at(w)
+            return lambda vp: pullback(hv(self.coef_to_original(vp)))[0]
+
+        return wrapped
+
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
@@ -199,6 +216,7 @@ class LocalNormalizationContext:
     # Same lifting as NormalizationContext (duck-typed in problem.run).
     wrap_value_and_grad = NormalizationContext.wrap_value_and_grad
     wrap_hvp = NormalizationContext.wrap_hvp
+    wrap_hvp_at = NormalizationContext.wrap_hvp_at
 
 
 def project_context(

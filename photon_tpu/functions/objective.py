@@ -151,6 +151,32 @@ class GLMObjective:
     def bind_hvp(self, batch: LabeledBatch) -> Callable[[Array, Array], Array]:
         return lambda w, v: self.hessian_vector(w, v, batch)
 
+    def bind_hvp_at(
+        self, batch: LabeledBatch
+    ) -> Callable[[Array], Callable[[Array], Array]]:
+        """``w ↦ (v ↦ H(w)·v)`` with the margins z (and the loss curvature d2)
+        computed ONCE at w. Inside TRON's inner CG loop, where w is fixed, this
+        hoists the z matvec explicitly: each H·v then costs exactly 2 data
+        passes (Xv matvec + rmatvec) instead of 3 — and the optimizer's
+        ``data_passes`` accounting matches the program XLA actually runs
+        (rather than hoping loop-invariant code motion fires).
+        """
+
+        def at(w: Array) -> Callable[[Array], Array]:
+            z = batch.features.matvec(w) + batch.offsets
+            d2 = batch.weights * self.loss.d2(z, batch.labels)
+
+            def hv(v: Array) -> Array:
+                out = batch.features.rmatvec(d2 * batch.features.matvec(v))
+                out = out + self._l2_vec(v) * v
+                if self.prior is not None:
+                    out = out + self.prior.hessian_vector(v)
+                return out
+
+            return hv
+
+        return at
+
 
 @dataclasses.dataclass(frozen=True)
 class ScoreSpaceObjective:

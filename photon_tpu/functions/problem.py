@@ -168,15 +168,16 @@ class GLMOptimizationProblem:
             result = OWLQN(self.optimizer_config).optimize(vg, w0, l1 * mask)
         elif self.optimizer_type == OptimizerType.TRON:
             if norm is None:
-                hvp = obj.bind_hvp(batch)
+                hvp_at = obj.bind_hvp_at(batch)
             else:
                 data_obj = dataclasses.replace(obj, l2_weight=0.0)
-                inner_hvp = norm.wrap_hvp(data_obj.bind_hvp(batch))
+                inner_at = norm.wrap_hvp_at(data_obj.bind_hvp_at(batch))
 
-                def hvp(wp: Array, vp: Array) -> Array:
-                    return inner_hvp(wp, vp) + obj._l2_vec(vp) * vp
+                def hvp_at(wp: Array):
+                    hv = inner_at(wp)
+                    return lambda vp: hv(vp) + obj._l2_vec(vp) * vp
 
-            result = TRON(self.optimizer_config).optimize(vg, w0, hvp)
+            result = TRON(self.optimizer_config).optimize(vg, w0, hvp_at)
         else:  # pragma: no cover - enum is closed
             raise ValueError(f"unknown optimizer {self.optimizer_type}")
 

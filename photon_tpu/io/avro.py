@@ -20,9 +20,8 @@ enums ↔ strings, null union branches ↔ None. Schemas are plain parsed-JSON
 dicts; named-type references are resolved through a registry so photon's
 nested ``NameTermValueAvro`` reuse works.
 
-The hot decode path (billions of training rows) has a C++ twin in
-``photon_tpu/native`` — this module is the reference implementation and the
-always-available fallback.
+This module is the reference implementation and the always-available
+fallback for the hot decode path.
 """
 from __future__ import annotations
 

@@ -1,4 +1,4 @@
-"""Numerical ops: pointwise losses, sparse feature ops, Pallas kernels."""
+"""Numerical ops: pointwise losses and fast sparse feature ops."""
 from photon_tpu.ops.losses import (  # noqa: F401
     LogisticLoss,
     PointwiseLoss,

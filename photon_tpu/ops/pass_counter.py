@@ -1,0 +1,66 @@
+"""Instrumented data-pass counting for feature-matrix ops.
+
+VERDICT/ADVICE (round 2) flagged that bench.py's ``data_passes`` was computed
+from a formula (``2*iters + iters//8 + 2``), not measured. This module makes
+the claim self-verifying: every ``matvec`` / ``rmatvec`` / ``sq_rmatvec`` on a
+feature container calls :func:`record`, and inside a :func:`counting` context
+that embeds a ``jax.debug.callback`` in the traced program, so each *runtime
+execution* (including executions inside ``lax.while_loop`` bodies) bumps a
+host-side counter.
+
+Counting is trace-time gated: outside the context, ``record`` is a no-op and
+nothing is embedded, so the hot path carries zero overhead. To count an
+already-jitted function, re-jit it inside the context (a fresh ``jax.jit``
+wrapper forces a retrace with the callbacks embedded) and run it once —
+untimed, since host callbacks serialize the device stream.
+
+One "data pass" = one touch of all N·K feature entries, i.e. one matvec OR
+one rmatvec (the convention bench.py documents).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+import jax
+
+_counts: dict[str, int] = {"matvec": 0, "rmatvec": 0, "sq_rmatvec": 0}
+_enabled: bool = False
+
+
+def _bump(kind: str) -> None:
+    # Re-checked at call time: a program traced inside a counting() context
+    # keeps its embedded callbacks for the life of its jit cache entry, and
+    # those must not mutate counts (or be mistaken for live sessions) after
+    # the context exits. (The stale callbacks still cost a host round trip —
+    # don't reuse jit wrappers traced under counting() for timing.)
+    if _enabled:
+        _counts[kind] += 1
+
+
+def record(kind: str) -> None:
+    """Mark one data pass of the given kind at the current trace point."""
+    if _enabled:
+        jax.debug.callback(lambda k=kind: _bump(k))
+
+
+@contextlib.contextmanager
+def counting() -> Iterator[dict[str, int]]:
+    """Enable pass counting; yields the live counter dict.
+
+    Flushes outstanding device callbacks (``jax.effects_barrier``) before
+    returning control, so the dict is complete when the block exits.
+    """
+    global _enabled
+    for k in _counts:
+        _counts[k] = 0
+    _enabled = True
+    try:
+        yield _counts
+    finally:
+        jax.effects_barrier()
+        _enabled = False
+
+
+def total_passes() -> int:
+    return sum(_counts.values())

@@ -214,6 +214,7 @@ class _LoopState(NamedTuple):
     gnorm0: Array
     values: Array
     grad_norms: Array
+    passes: Array          # int32 — instrumented data-pass counter
 
 
 @dataclasses.dataclass(frozen=True)
@@ -228,11 +229,12 @@ class LBFGS(Optimizer):
 
     axis_name: str = None
 
-    def _solve(self, x0, f0, g0, extra0, step_fn) -> OptimizerResult:
+    def _solve(self, x0, f0, g0, extra0, step_fn, init_passes=2) -> OptimizerResult:
         """Shared loop core: direction, step via ``step_fn``, history update,
         convergence bookkeeping. ``step_fn(st, dvec, it) →
-        (x, f, g, extra, t_final)``; ``t_final == 0`` marks a fully failed
-        line search (no further progress possible)."""
+        (x, f, g, extra, t_final, passes)``; ``t_final == 0`` marks a fully
+        failed line search (no further progress possible); ``passes`` is the
+        number of data passes the step made (see OptimizerResult)."""
         cfg = self.config
         max_it = cfg.max_iterations
         dtype = x0.dtype
@@ -250,6 +252,7 @@ class LBFGS(Optimizer):
             reason=jnp.asarray(NOT_CONVERGED, jnp.int32),
             gnorm0=gnorm0,
             values=values, grad_norms=gnorms,
+            passes=jnp.asarray(init_passes, jnp.int32),
         )
 
         def cond(st: _LoopState):
@@ -261,7 +264,7 @@ class LBFGS(Optimizer):
             descent = dot(dvec, st.g) < 0
             dvec = jnp.where(descent, dvec, -st.g)
 
-            x_new, f_new, g_new, extra, t = step_fn(st, dvec, st.it)
+            x_new, f_new, g_new, extra, t, step_passes = step_fn(st, dvec, st.it)
             hist = update_history(st.hist, x_new - st.x, g_new - st.g, dot)
             it = st.it + 1
             gnorm = norm(g_new)
@@ -277,6 +280,7 @@ class LBFGS(Optimizer):
                 reason=reason, gnorm0=st.gnorm0,
                 values=st.values.at[it].set(f_new),
                 grad_norms=st.grad_norms.at[it].set(gnorm),
+                passes=st.passes + step_passes.astype(jnp.int32),
             )
 
         st = lax.while_loop(cond, body, init)
@@ -285,6 +289,7 @@ class LBFGS(Optimizer):
             x=st.x, value=st.f, grad_norm=norm(st.g),
             iterations=st.it, converged_reason=reason,
             values=st.values, grad_norms=st.grad_norms,
+            data_passes=st.passes,
         )
 
     def optimize(self, value_and_grad: ValueAndGrad, x0: Array) -> OptimizerResult:
@@ -293,11 +298,12 @@ class LBFGS(Optimizer):
         f0, g0 = value_and_grad(x0)
 
         def step(st, dvec, it):
-            x_new, f_new, g_new, t, _ = backtracking_line_search(
+            x_new, f_new, g_new, t, n_probes = backtracking_line_search(
                 value_and_grad, st.x, st.f, st.g, dvec,
                 cfg.max_line_search_iterations, dot=dot,
             )
-            return x_new, f_new, g_new, st.extra, t
+            # Each probe is one fused value+grad = 1 matvec + 1 rmatvec.
+            return x_new, f_new, g_new, st.extra, t, 2 * n_probes
 
         return self._solve(x0, f0, g0, jnp.zeros((), x0.dtype), step)
 
@@ -341,13 +347,16 @@ class LBFGS(Optimizer):
             # Refresh z from x periodically: the incremental z accumulates
             # one rounding per accepted step, which can stall convergence
             # near the optimum. One extra matvec every 8 iterations.
+            refresh = jnp.mod(it + 1, 8) == 0
             z_new = lax.cond(
-                jnp.mod(it + 1, 8) == 0,
+                refresh,
                 lambda: so.score(x_new),
                 lambda: z_new,
             )
             f_new = jnp.where(accept, ft, st.f)
             g_new = so.grad_from_scores(z_new, x_new)   # one rmatvec
-            return x_new, f_new, g_new, z_new, t_final
+            # 1 matvec (Xp) + 1 rmatvec (grad) + the conditional z refresh.
+            passes = 2 + refresh.astype(jnp.int32)
+            return x_new, f_new, g_new, z_new, t_final, passes
 
         return self._solve(x0, f0, g0, z0, step)

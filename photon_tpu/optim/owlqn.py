@@ -64,6 +64,7 @@ class _LoopState(NamedTuple):
     gnorm0: Array
     values: Array
     grad_norms: Array
+    passes: Array   # int32 — instrumented data-pass counter
 
 
 @dataclasses.dataclass(frozen=True)
@@ -101,6 +102,7 @@ class OWLQN(Optimizer):
             it=jnp.zeros((), jnp.int32),
             reason=jnp.asarray(NOT_CONVERGED, jnp.int32),
             gnorm0=gnorm0, values=values, grad_norms=gnorms,
+            passes=jnp.asarray(2, jnp.int32),  # init fused value+grad
         )
 
         def cond(st: _LoopState):
@@ -135,7 +137,7 @@ class OWLQN(Optimizer):
                 return (jnp.where(ok, t, 0.5 * t), ft, fts, gt, xt, it + 1, ok)
 
             t0 = jnp.asarray(1.0, dtype)
-            _, ft, fts, gt, xt, _, ok = lax.while_loop(
+            _, ft, fts, gt, xt, n_probes, ok = lax.while_loop(
                 ls_cond, ls_body,
                 (t0, st.f, st.f, st.g, st.x, jnp.zeros((), jnp.int32),
                  jnp.zeros((), bool)),
@@ -160,6 +162,8 @@ class OWLQN(Optimizer):
                 reason=reason, gnorm0=st.gnorm0,
                 values=st.values.at[it].set(f_new),
                 grad_norms=st.grad_norms.at[it].set(gnorm),
+                # Each probe is one fused value+grad = 2 data passes.
+                passes=st.passes + 2 * n_probes,
             )
 
         st = lax.while_loop(cond, body, init)
@@ -169,4 +173,5 @@ class OWLQN(Optimizer):
             x=st.x, value=st.f, grad_norm=l2_norm(pg_fin),
             iterations=st.it, converged_reason=reason,
             values=st.values, grad_norms=st.grad_norms,
+            data_passes=st.passes,
         )

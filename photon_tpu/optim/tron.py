@@ -16,7 +16,7 @@ reference, SURVEY.md §3.4).
 from __future__ import annotations
 
 import dataclasses
-from typing import NamedTuple
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +25,6 @@ from jax import lax
 from photon_tpu.optim.base import (
     FUNCTION_VALUES_CONVERGED,
     NOT_CONVERGED,
-    Hvp,
     Optimizer,
     OptimizerResult,
     ValueAndGrad,
@@ -53,8 +52,9 @@ def _boundary_tau(p: Array, d: Array, delta: Array) -> Array:
 def steihaug_cg(hvp, g: Array, delta: Array, max_iters: int, tol: Array):
     """Truncated CG for H p = −g inside ‖p‖ ≤ delta.
 
-    Returns (p, Hp) — Hp is maintained incrementally so the caller can compute
-    the predicted reduction without another Hessian pass.
+    Returns (p, Hp, n_hvp) — Hp is maintained incrementally so the caller can
+    compute the predicted reduction without another Hessian pass; n_hvp is the
+    number of Hessian-vector products performed (for pass accounting).
     """
 
     class CGState(NamedTuple):
@@ -99,7 +99,7 @@ def steihaug_cg(hvp, g: Array, delta: Array, max_iters: int, tol: Array):
         )
 
     st = lax.while_loop(cond, body, init)
-    return st.p, st.hp
+    return st.p, st.hp, st.it
 
 
 class _LoopState(NamedTuple):
@@ -112,18 +112,26 @@ class _LoopState(NamedTuple):
     gnorm0: Array
     values: Array
     grad_norms: Array
+    passes: Array   # int32 — instrumented data-pass counter
 
 
 @dataclasses.dataclass(frozen=True)
 class TRON(Optimizer):
-    """Trust-region Newton. Requires an HVP alongside value+grad.
+    """Trust-region Newton. Requires an HVP factory alongside value+grad.
 
-    ``optimize(value_and_grad, x0, hvp)`` where ``hvp(x, v) -> H(x) v``.
-    Build ``hvp`` generically as ``lambda x, v: jax.jvp(grad_fn, (x,), (v,))[1]``.
+    ``optimize(value_and_grad, x0, hvp_at)`` where ``hvp_at(x)`` returns
+    ``v ↦ H(x)·v``. The factory form lets an objective hoist work that
+    depends only on x (GLM margins/curvature — see
+    ``GLMObjective.bind_hvp_at``) out of the inner CG loop explicitly.
+    Build one generically as
+    ``lambda x: (lambda v: jax.jvp(grad_fn, (x,), (v,))[1])``.
     """
 
     def optimize(  # type: ignore[override]
-        self, value_and_grad: ValueAndGrad, x0: Array, hvp: Hvp
+        self,
+        value_and_grad: ValueAndGrad,
+        x0: Array,
+        hvp_at: "Callable[[Array], Callable[[Array], Array]]",
     ) -> OptimizerResult:
         cfg = self.config
         max_it = cfg.max_iterations
@@ -139,6 +147,7 @@ class TRON(Optimizer):
             it=jnp.zeros((), jnp.int32),
             reason=jnp.asarray(NOT_CONVERGED, jnp.int32),
             gnorm0=gnorm0, values=values, grad_norms=gnorms,
+            passes=jnp.asarray(2, jnp.int32),  # init fused value+grad
         )
 
         def cond(st: _LoopState):
@@ -147,8 +156,8 @@ class TRON(Optimizer):
         def body(st: _LoopState) -> _LoopState:
             gnorm = l2_norm(st.g)
             cg_tol = 0.1 * gnorm
-            p, hp = steihaug_cg(
-                lambda v: hvp(st.x, v), st.g, st.delta,
+            p, hp, n_hvp = steihaug_cg(
+                hvp_at(st.x), st.g, st.delta,
                 cfg.max_cg_iterations, cg_tol,
             )
             # Predicted reduction of the quadratic model: −(gᵀp + ½ pᵀHp).
@@ -198,6 +207,10 @@ class TRON(Optimizer):
                 gnorm0=st.gnorm0,
                 values=st.values.at[it].set(f_new),
                 grad_norms=st.grad_norms.at[it].set(gnorm_new),
+                # Per outer iteration: 1 pass for the hoisted margin matvec
+                # in hvp_at(x) (GLMObjective.bind_hvp_at), 2 per CG HVP
+                # (Xv matvec + rmatvec), 2 for the fused trial value+grad.
+                passes=st.passes + 1 + 2 * n_hvp + 2,
             )
 
         st = lax.while_loop(cond, body, init)
@@ -206,4 +219,5 @@ class TRON(Optimizer):
             x=st.x, value=st.f, grad_norm=l2_norm(st.g),
             iterations=st.it, converged_reason=reason,
             values=st.values, grad_norms=st.grad_norms,
+            data_passes=st.passes,
         )

@@ -125,7 +125,7 @@ def fit_model_parallel(
 
     res_specs = OptimizerResult(
         x=P(), value=P(), grad_norm=P(), iterations=P(),
-        converged_reason=P(), values=P(), grad_norms=P(),
+        converged_reason=P(), values=P(), grad_norms=P(), data_passes=P(),
     )
 
     @partial(

@@ -150,7 +150,7 @@ class TestOWLQN:
 class TestTRON:
     def _hvp(self, vg):
         grad_fn = lambda w: vg(w)[1]
-        return lambda w, v: jax.jvp(grad_fn, (w,), (v,))[1]
+        return lambda w: (lambda v: jax.jvp(grad_fn, (w,), (v,))[1])
 
     def test_quadratic_exact(self, rng):
         vg, x_star = quadratic_problem(rng)
@@ -199,3 +199,81 @@ class TestTRON:
         assert int(res.converged_reason) in (2, 3)
         # Gradient at the optimum is ~zero.
         assert float(res.grad_norm) < 1e-2 * max(1.0, float(res.value))
+
+
+class TestDataPassCounter:
+    """OptimizerResult.data_passes (device counter) must equal the number of
+    actual feature-matrix touches, cross-checked by the host-callback counter
+    at the matvec/rmatvec source (ops/pass_counter.py)."""
+
+    def _problem(self, opt_type, reg_type, variance="NONE"):
+        from photon_tpu.functions.problem import (
+            GLMOptimizationProblem,
+            VarianceComputationType,
+        )
+        from photon_tpu.optim import (
+            OptimizerConfig,
+            OptimizerType,
+            RegularizationContext,
+            RegularizationType,
+        )
+        from photon_tpu.types import TaskType
+
+        return GLMOptimizationProblem(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_type=opt_type,
+            optimizer_config=OptimizerConfig(max_iterations=12, tolerance=0.0),
+            regularization=RegularizationContext(reg_type),
+            reg_weight=1.0,
+            variance_type=VarianceComputationType[variance],
+        )
+
+    def _batch(self, rng, n=512, d=64, k=6):
+        from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+
+        idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+        val = (rng.normal(size=(n, k)) / np.sqrt(k)).astype(np.float32)
+        y = (rng.random(n) < 0.5).astype(np.float32)
+        sf = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)
+        return LabeledBatch(
+            sf,
+            jnp.asarray(y),
+            jnp.zeros((n,), jnp.float32),
+            jnp.ones((n,), jnp.float32),
+        ), d
+
+    @pytest.mark.parametrize(
+        "opt,reg",
+        [("LBFGS", "L2"), ("OWLQN", "L1"), ("TRON", "L2")],
+    )
+    def test_device_counter_matches_source_counter(self, rng, opt, reg):
+        from photon_tpu.ops import pass_counter
+        from photon_tpu.optim import OptimizerType, RegularizationType
+
+        problem = self._problem(OptimizerType[opt], RegularizationType[reg])
+        batch, d = self._batch(rng)
+        w0 = jnp.zeros((d,), jnp.float32)
+        with pass_counter.counting() as counts:
+            _, res = jax.jit(problem.run)(batch, w0)
+            jax.block_until_ready(res.value)
+        measured = counts["matvec"] + counts["rmatvec"] + counts["sq_rmatvec"]
+        assert int(res.data_passes) == measured, (dict(counts), int(res.data_passes))
+        assert measured > 0
+
+    def test_scored_path_fewer_passes_than_plain(self, rng):
+        """The incremental-score L-BFGS prices probes without data passes, so
+        its pass count must not exceed the plain path's on the same solve."""
+        from photon_tpu.functions.objective import GLMObjective
+        from photon_tpu.ops.losses import LogisticLoss
+        from photon_tpu.optim import LBFGS, OptimizerConfig
+
+        batch, d = self._batch(rng)
+        obj = GLMObjective(loss=LogisticLoss, l2_weight=1.0)
+        cfg = OptimizerConfig(max_iterations=20, tolerance=0.0)
+        w0 = jnp.zeros((d,), jnp.float32)
+        plain = LBFGS(cfg).optimize(obj.bind(batch), w0)
+        scored = LBFGS(cfg).optimize_scored(obj.score_space(batch), w0)
+        assert int(scored.data_passes) <= int(plain.data_passes)
+        # Scored path: init(2) + per-iter 2 (+1 refresh every 8th iter).
+        it = int(scored.iterations)
+        assert int(scored.data_passes) == 2 + 2 * it + it // 8
