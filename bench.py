@@ -348,6 +348,83 @@ def bench_game():
     }
 
 
+def bench_ingest():
+    """Streaming Avro ingest throughput (io/streaming.py + native decoder).
+
+    Writes a CTR-shaped file once (cached in /tmp across runs) and measures
+    chunked decode. The 100M-row constant-memory run and per-core scaling
+    are documented in the module README note; this is the tracked number.
+    """
+    import tempfile
+
+    from photon_tpu import native
+    from photon_tpu.index.index_map import (
+        INTERCEPT_NAME,
+        DefaultIndexMap,
+        feature_key,
+    )
+    from photon_tpu.io.avro import write_container
+    from photon_tpu.io.data_reader import FeatureShardConfig, InputColumnNames
+    from photon_tpu.io.streaming import StreamingAvroReader
+
+    if native.get_lib() is None:
+        return {"ingest_rows_per_sec": None}
+
+    n, d, k = 200_000, 100_000, 12
+    path = os.path.join(
+        tempfile.gettempdir(), f"photon_bench_ingest_{n}_{d}_{k}.avro"
+    )
+    names = [f"feat_{i}" for i in range(d)]
+    schema = {
+        "type": "record", "name": "TrainingExampleAvro", "fields": [
+            {"name": "uid", "type": "string"},
+            {"name": "response", "type": "double"},
+            {"name": "features", "type": {"type": "array", "items": {
+                "type": "record", "name": "FeatureAvro", "fields": [
+                    {"name": "name", "type": "string"},
+                    {"name": "term", "type": ["null", "string"]},
+                    {"name": "value", "type": "double"},
+                ]}}},
+            {"name": "metadataMap", "type": {"type": "map", "values": "string"}},
+        ],
+    }
+    if not os.path.exists(path):
+        rng = np.random.default_rng(3)
+
+        def gen():
+            for i in range(n):
+                ids = rng.integers(0, d, k)
+                yield {
+                    "uid": f"u{i}", "response": float(i & 1),
+                    "features": [
+                        {"name": names[j], "term": "t", "value": 1.0}
+                        for j in ids
+                    ],
+                    "metadataMap": {"userId": f"user{i % 5000}"},
+                }
+
+        write_container(path + ".tmp", schema, gen(), block_records=4096)
+        os.replace(path + ".tmp", path)
+
+    imap = DefaultIndexMap(
+        [feature_key(INTERCEPT_NAME, "")] + [feature_key(nm, "t") for nm in names]
+    )
+    sr = StreamingAvroReader(
+        {"g": imap}, {"g": FeatureShardConfig()}, InputColumnNames(),
+        ("userId",), chunk_rows=1 << 17, capture_uids=False,
+    )
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        rows = sum(c.n_rows for c in sr.iter_chunks(path))
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "ingest_rows_per_sec": round(rows / best, 1),
+        "ingest_mb_per_sec": round(os.path.getsize(path) / best / 1e6, 1),
+        "ingest_nnz_per_row": k,
+    }
+
+
 def main():
     details = {}
     head, (idx, val, labels) = bench_fixed_effect_lbfgs()
@@ -377,6 +454,7 @@ def main():
 
     details.update(bench_owlqn_tron())
     details.update(bench_game())
+    details.update(bench_ingest())
 
     with open(os.path.join(os.path.dirname(__file__) or ".",
                            "BENCH_DETAILS.json"), "w") as f:

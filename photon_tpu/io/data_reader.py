@@ -49,6 +49,19 @@ class InputColumnNames:
     response_aliases: tuple = ("response", "label")
 
 
+def response_columns(columns: "InputColumnNames") -> tuple:
+    """Label-column lookup order: an explicitly configured response column is
+    authoritative; the conventional aliases only apply to the default
+    configuration (falling back from a custom name could silently read wrong
+    labels). Shared by the per-record and streaming readers so their
+    semantics cannot drift."""
+    if columns.response in columns.response_aliases:
+        return (columns.response,) + tuple(
+            a for a in columns.response_aliases if a != columns.response
+        )
+    return (columns.response,)
+
+
 @dataclasses.dataclass(frozen=True)
 class FeatureShardConfig:
     """Which feature bags make up one shard — reference
@@ -127,26 +140,49 @@ class AvroDataReader:
             )
         self.columns = columns
         self.id_tag_columns = tuple(id_tag_columns)
+        self._streaming = None
 
     def read(
         self, paths, dtype=jnp.float32, require_labels: bool = True
     ) -> GameDataBundle:
         """``require_labels=False`` admits unlabeled records (label → NaN) —
         the reference GameScoringDriver treats response as optional at
-        scoring time."""
+        scoring time.
+
+        Decoding goes through the streaming block engine
+        (``io/streaming.py`` + the native decoder) when the schema supports
+        it; otherwise this falls back to the per-record Python path
+        (``read_per_record``) with identical semantics.
+        """
+        from photon_tpu.io.streaming import StreamingAvroReader, Unsupported
+
+        try:
+            if self._streaming is None:
+                # Cached: the per-shard hash tables and compiled programs are
+                # config-determined and reused across read() calls.
+                self._streaming = StreamingAvroReader(
+                    self.index_maps,
+                    self.shard_configs,
+                    self.columns,
+                    self.id_tag_columns,
+                )
+            return self._streaming.read(
+                paths, dtype=dtype, require_labels=require_labels
+            )
+        except Unsupported:
+            return self.read_per_record(paths, dtype, require_labels)
+
+    def read_per_record(
+        self, paths, dtype=jnp.float32, require_labels: bool = True
+    ) -> GameDataBundle:
+        """Per-record pure-Python decode — the reference implementation the
+        streaming engine is tested against, and the fallback for schema
+        shapes the program compiler can't express."""
         cols = self.columns
         labels, offsets, weights, uids = [], [], [], []
         tags: dict[str, list] = {t: [] for t in self.id_tag_columns}
         shard_rows: dict[str, list] = {s: [] for s in self.index_maps}
-        # An explicitly configured response column is authoritative; the
-        # conventional aliases only apply to the default configuration
-        # (falling back from a custom name could silently read wrong labels).
-        if cols.response in cols.response_aliases:
-            response_cols = (cols.response,) + tuple(
-                a for a in cols.response_aliases if a != cols.response
-            )
-        else:
-            response_cols = (cols.response,)
+        response_cols = response_columns(cols)
         # Intercept indices are per-shard invariants; don't look them up per row.
         intercepts = {
             shard: self.index_maps[shard].get_index(INTERCEPT_NAME, INTERCEPT_TERM)

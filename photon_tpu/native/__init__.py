@@ -1,0 +1,95 @@
+"""Native (C++) components, compiled on demand with the system toolchain.
+
+The only native component is the Avro block decoder (``avro_block.cc``) used
+by :mod:`photon_tpu.io.streaming`. It is compiled once per source change with
+``g++ -O3 -shared`` into this directory and loaded via ctypes; if no compiler
+is available (or ``PHOTON_TPU_NO_NATIVE=1``), callers fall back to the pure
+Python codec (``photon_tpu.io.avro``) — slower, identical semantics.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "avro_block.cc")
+_SO = os.path.join(_HERE, "_avro_block.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_failed = False
+
+
+def _compile() -> bool:
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", _SO + ".tmp", _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    except (OSError, subprocess.SubprocessError):
+        return False
+    os.replace(_SO + ".tmp", _SO)
+    return True
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64, i32, u64, f64, u8 = (
+        ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_double,
+        ctypes.c_uint8,
+    )
+    P = ctypes.POINTER
+    lib.ph_hash_keys.argtypes = [P(u8), P(i64), i64, P(u64)]
+    lib.ph_hash_keys.restype = None
+    lib.ph_create.argtypes = [
+        P(i32), i64, P(i32), i64, P(i64), i64,
+        i32, P(f64), i32,
+        P(u8), P(i64), i64,
+        i32, P(P(u64)), P(P(i32)), P(i64),
+    ]
+    lib.ph_create.restype = ctypes.c_void_p
+    lib.ph_destroy.argtypes = [ctypes.c_void_p]
+    lib.ph_decode_block.argtypes = [ctypes.c_void_p, P(u8), i64, i64]
+    lib.ph_decode_block.restype = i64
+    lib.ph_chunk_rows.argtypes = [ctypes.c_void_p]
+    lib.ph_chunk_rows.restype = i64
+    lib.ph_get_num_col.argtypes = [ctypes.c_void_p, i32, P(f64)]
+    lib.ph_get_str_codes.argtypes = [ctypes.c_void_p, i32, P(i32)]
+    lib.ph_shard_nnz.argtypes = [ctypes.c_void_p, i32]
+    lib.ph_shard_nnz.restype = i64
+    lib.ph_get_shard_triples.argtypes = [ctypes.c_void_p, i32, P(i32), P(i32), P(f64)]
+    lib.ph_dict_size.argtypes = [ctypes.c_void_p, i32]
+    lib.ph_dict_size.restype = i64
+    lib.ph_dict_heap_bytes_from.argtypes = [ctypes.c_void_p, i32, i64]
+    lib.ph_dict_heap_bytes_from.restype = i64
+    lib.ph_get_dict_range.argtypes = [ctypes.c_void_p, i32, i64, P(u8), P(i64)]
+    lib.ph_reset_chunk.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The compiled decoder library, or None if native is unavailable."""
+    global _lib, _failed
+    if _lib is not None:
+        return _lib
+    if _failed or os.environ.get("PHOTON_TPU_NO_NATIVE") == "1":
+        return None
+    with _lock:
+        if _lib is not None or _failed:
+            return _lib
+        try:
+            stale = (
+                not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)
+            )
+            if stale and not _compile():
+                _failed = True
+                return None
+            _lib = _bind(ctypes.CDLL(_SO))
+        except OSError:
+            _failed = True
+            return None
+    return _lib

@@ -132,7 +132,15 @@ class TRON(Optimizer):
         value_and_grad: ValueAndGrad,
         x0: Array,
         hvp_at: "Callable[[Array], Callable[[Array], Array]]",
+        hvp_passes: int = 2,
+        factory_passes: int = 1,
     ) -> OptimizerResult:
+        """``hvp_passes``/``factory_passes`` declare how many feature-data
+        passes one H·v call / one ``hvp_at(x)`` call costs, for the
+        ``data_passes`` counter. Defaults match ``GLMObjective.bind_hvp_at``
+        (hoisted margin matvec at the factory, Xv matvec + rmatvec per HVP);
+        callers with a different objective structure must pass their own
+        costs (0/0 for objectives not backed by feature data)."""
         cfg = self.config
         max_it = cfg.max_iterations
         dtype = x0.dtype
@@ -207,10 +215,10 @@ class TRON(Optimizer):
                 gnorm0=st.gnorm0,
                 values=st.values.at[it].set(f_new),
                 grad_norms=st.grad_norms.at[it].set(gnorm_new),
-                # Per outer iteration: 1 pass for the hoisted margin matvec
-                # in hvp_at(x) (GLMObjective.bind_hvp_at), 2 per CG HVP
-                # (Xv matvec + rmatvec), 2 for the fused trial value+grad.
-                passes=st.passes + 1 + 2 * n_hvp + 2,
+                # Per outer iteration: the declared factory cost (hoisted
+                # margin matvec for GLMs), hvp_passes per CG HVP, and 2 for
+                # the fused trial value+grad.
+                passes=st.passes + factory_passes + hvp_passes * n_hvp + 2,
             )
 
         st = lax.while_loop(cond, body, init)
