@@ -51,11 +51,18 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="record fields holding feature lists (per training config)")
     p.add_argument("--response-column", default="response")
     p.add_argument("--uid-column", default="uid")
+    p.add_argument("--dtype", default="float32", choices=["float32", "float64"],
+                   help="scoring precision (float64 enables jax x64)")
     return p
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
+    if args.dtype == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    _dt = np.float64 if args.dtype == "float64" else np.float32
     os.makedirs(args.output_dir, exist_ok=True)
     with PhotonLogger(args.output_dir) as logger:
         with open(os.path.join(args.model_dir, "game-metadata.json")) as f:
@@ -79,7 +86,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             s: MmapIndexMap(os.path.join(index_root, s)) for s in sorted(shards)
         }
         with Timed("load model", logger):
-            model, meta = load_game_model(args.model_dir, index_maps)
+            model, meta = load_game_model(args.model_dir, index_maps, dtype=_dt)
 
         # Reconstruct per-coordinate data configs from model metadata.
         data_configs = {}
@@ -123,7 +130,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         )
         with Timed("read data", logger):
             # Labels are only required when evaluators were requested.
-            bundle = reader.read(args.data, require_labels=suite is not None)
+            bundle = reader.read(args.data, require_labels=suite is not None,
+                                 dtype=_dt)
         logger.info("scoring %d rows", bundle.n_rows)
 
         transformer = GameTransformer(

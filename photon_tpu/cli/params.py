@@ -74,7 +74,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
         kv[k.strip()] = v.strip()
 
     known = {
-        "type", "shard", "re_type", "active_bound", "min_rows", "optimizer",
+        "type", "shard", "re_type", "active_bound", "min_rows", "max_features", "optimizer",
         "max_iter", "tol", "reg", "alpha", "reg_weights", "downsample",
         "variance", "incremental",
     }
@@ -89,7 +89,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
         )
     shard = kv.get("shard", "global")
     if ctype == "fixed":
-        for k in ("re_type", "active_bound", "min_rows"):
+        for k in ("re_type", "active_bound", "min_rows", "max_features"):
             if k in kv:
                 raise ValueError(f"coordinate {cid!r}: {k} is random-effect only")
         data: CoordinateDataConfig = FixedEffectDataConfig(feature_shard=shard)
@@ -101,6 +101,9 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
             feature_shard=shard,
             active_bound=int(kv["active_bound"]) if "active_bound" in kv else None,
             min_entity_rows=int(kv.get("min_rows", 1)),
+            max_features_per_entity=(
+                int(kv["max_features"]) if "max_features" in kv else None
+            ),
         )
 
     reg_type = RegularizationType(kv.get("reg", "NONE").upper())

@@ -147,6 +147,41 @@ def down_sample_dataset(
     return dataclasses.replace(dataset, buckets=tuple(new_buckets))
 
 
+def pearson_scores(
+    local: np.ndarray,
+    vals: np.ndarray,
+    labels_e: np.ndarray,
+    n_cols: int,
+) -> np.ndarray:
+    """|Pearson correlation| of each local feature column with the label over
+    one entity's rows, treating absent entries as 0 (sparse semantics —
+    reference ⟦LocalDataset.filterFeaturesByPearsonCorrelationScore⟧).
+    Assumes each row indexes a column at most once (squares accumulate
+    per-entry, so duplicate (row, col) entries would skew the variance).
+
+    Zero-variance columns score 0 (the intercept is force-kept by the
+    caller, not through its score).
+    """
+    s = len(labels_e)
+    flat = local.ravel()
+    keep = flat < n_cols
+    cols = flat[keep]
+    v = vals.ravel()[keep]
+    y_rep = np.repeat(labels_e, local.shape[1])[keep]
+    sum_x = np.bincount(cols, weights=v, minlength=n_cols)
+    sum_x2 = np.bincount(cols, weights=v * v, minlength=n_cols)
+    sum_xy = np.bincount(cols, weights=v * y_rep, minlength=n_cols)
+    sum_y = labels_e.sum()
+    sum_y2 = (labels_e * labels_e).sum()
+    num = s * sum_xy - sum_x * sum_y
+    var_x = s * sum_x2 - sum_x * sum_x
+    var_y = s * sum_y2 - sum_y * sum_y
+    denom = np.sqrt(np.maximum(var_x, 0.0) * max(var_y, 0.0))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        corr = np.where(denom > 0, np.abs(num) / np.maximum(denom, 1e-30), 0.0)
+    return corr
+
+
 def build_random_effect_dataset(
     re_type: str,
     entity_keys_per_row: np.ndarray,
@@ -159,6 +194,7 @@ def build_random_effect_dataset(
     min_entity_rows: int = 1,
     intercept_index: Optional[int] = None,
     dtype=np.float32,
+    max_features_per_entity: Optional[int] = None,
 ) -> RandomEffectDataset:
     """Host-side builder: group rows by entity, project features, bucket+pad.
 
@@ -167,6 +203,13 @@ def build_random_effect_dataset(
     rows are dropped (reference: ``numActiveDataPointsLowerBound``).
     ``intercept_index``, when given, is force-included in every entity's
     subspace so each per-entity model can carry an intercept.
+
+    ``max_features_per_entity`` enables Pearson-correlation feature filtering
+    (reference ⟦LocalDataset.filterFeaturesByPearsonCorrelationScore⟧,
+    SURVEY.md §2.2): each entity keeps only its ``m`` features most
+    |correlated| with the label (ties broken by lower column id; the
+    intercept always kept on top of ``m``), shrinking per-entity subspaces
+    and bucket padding on wide shards.
     """
     n, k = idx.shape
     labels = np.asarray(labels, dtype)
@@ -186,6 +229,7 @@ def build_random_effect_dataset(
     for e in kept:
         rows = order[starts[e]:starts[e + 1]]
         e_idx = idx[rows]             # [s, k] global ids (ghost == global_dim)
+        e_val = val[rows]
         cols = np.unique(e_idx[e_idx < global_dim])
         if intercept_index is not None and intercept_index not in cols:
             cols = np.sort(np.append(cols, intercept_index))
@@ -194,7 +238,31 @@ def build_random_effect_dataset(
         # local remap: ghost -> len(cols) (local ghost)
         local = np.searchsorted(cols, np.minimum(e_idx, global_dim - 1)).astype(np.int32)
         local = np.where(e_idx >= global_dim, len(cols), local)
-        entities.append((e, rows, cols, local, val[rows]))
+
+        if (
+            max_features_per_entity is not None
+            and len(cols) > max_features_per_entity
+        ):
+            scores = pearson_scores(
+                local, e_val, np.asarray(labels[rows], np.float64), len(cols)
+            )
+            # Top-m by |corr|, ties to the lower column id (deterministic);
+            # the intercept is force-kept regardless of its (zero) score.
+            order_by_score = np.lexsort((np.arange(len(cols)), -scores))
+            chosen = np.zeros(len(cols), bool)
+            chosen[order_by_score[:max_features_per_entity]] = True
+            if intercept_index is not None:
+                at = int(np.searchsorted(cols, intercept_index))
+                if at < len(cols) and cols[at] == intercept_index:
+                    chosen[at] = True
+            cols = cols[chosen]
+            in_kept = np.isin(e_idx, cols)
+            local = np.searchsorted(
+                cols, np.minimum(e_idx, global_dim - 1)
+            ).astype(np.int32)
+            local = np.where(in_kept, local, len(cols))
+            e_val = np.where(in_kept, e_val, 0.0)
+        entities.append((e, rows, cols, local, e_val))
 
     # Bucket by (pow2 samples, pow2 local dim).
     bucket_map: dict[tuple[int, int], list] = {}

@@ -42,13 +42,17 @@ class RandomEffectDataConfig:
 
     ``active_bound`` caps rows used for *training* per entity (rows beyond it
     become passive: scored, not trained on); ``min_entity_rows`` drops
-    entities with too little data (they fall back to the zero model).
+    entities with too little data (they fall back to the zero model);
+    ``max_features_per_entity`` applies Pearson-correlation feature filtering
+    to each entity's local dataset before projection (reference
+    ⟦LocalDataset.filterFeaturesByPearsonCorrelationScore⟧).
     """
 
     re_type: str
     feature_shard: str = "global"
     active_bound: Optional[int] = None
     min_entity_rows: int = 1
+    max_features_per_entity: Optional[int] = None
 
 
 CoordinateDataConfig = Union[FixedEffectDataConfig, RandomEffectDataConfig]

@@ -92,17 +92,25 @@ def build_re_dataset_from_bundle(
             f"random effect {cfg.re_type!r} needs id tag column "
             f"{cfg.re_type!r}; bundle has {sorted(bundle.id_tags)}"
         )
+    val_np = np.asarray(jax.device_get(sf.val))
     return build_random_effect_dataset(
         re_type=cfg.re_type,
         entity_keys_per_row=bundle.id_tags[cfg.re_type],
         idx=np.asarray(jax.device_get(sf.idx)),
-        val=np.asarray(jax.device_get(sf.val)),
+        val=val_np,
         labels=bundle.labels,
         global_dim=sf.dim,
         weights=bundle.weights,
         active_bound=None if for_scoring else cfg.active_bound,
         min_entity_rows=1 if for_scoring else cfg.min_entity_rows,
         intercept_index=intercept_index,
+        max_features_per_entity=(
+            None if for_scoring else cfg.max_features_per_entity
+        ),
+        # Follow the bundle's feature precision (float64 under --dtype
+        # float64) so random effects train at the same precision as the
+        # fixed effect.
+        dtype=val_np.dtype,
     )
 
 
@@ -135,6 +143,11 @@ class GameEstimator:
     # Fixed-effect coordinates train feature-dimension-sharded over this
     # mesh axis when set (P3; random effects always shard over data_axis).
     model_axis: Optional[str] = None
+    # Auto-routing (SURVEY.md §2.6 P3): when ``model_axis`` is unset but the
+    # mesh HAS a "model" axis, fixed-effect coordinates whose feature dim
+    # exceeds this threshold train feature-sharded; smaller ones stay
+    # data-parallel (coefficients replicated over the model axis).
+    auto_p3_threshold: int = 1 << 20
     seed: int = 0
 
     def __post_init__(self):
@@ -385,6 +398,23 @@ class GameEstimator:
                         self.task, ocfg.down_sampling_rate
                     )
                     batch = sampler.down_sample(key, batch)
+                model_axis = self.model_axis
+                if (
+                    model_axis is None
+                    and self.mesh is not None
+                    and "model" in getattr(self.mesh, "axis_names", ())
+                    and batch.dim > self.auto_p3_threshold
+                    # Route only configurations fit_model_parallel supports;
+                    # others stay data-parallel (replicated over the model
+                    # axis) instead of failing mid-sweep.
+                    and problem.optimizer_type.name in ("LBFGS", "OWLQN")
+                    and problem.variance_type.name != "FULL"
+                    and not (
+                        prep["norm"][dcfg.feature_shard] is not None
+                        and problem.prior is not None
+                    )
+                ):
+                    model_axis = "model"
                 coordinates[cid] = FixedEffectCoordinate(
                     batch=batch,
                     problem=problem,
@@ -392,7 +422,7 @@ class GameEstimator:
                     mesh=self.mesh,
                     data_axis=self.data_axis,
                     normalization=prep["norm"][dcfg.feature_shard],
-                    model_axis=self.model_axis,
+                    model_axis=model_axis,
                 )
             else:
                 dataset = prep["train"][cid]

@@ -59,6 +59,13 @@ class Evaluator:
             if group_ids is None:
                 raise ValueError(f"{self.name} needs group_ids")
             v = metrics.grouped_auc(scores, labels, group_ids, weights, num_groups)
+        elif self.kind.startswith("GROUPED_"):
+            if group_ids is None:
+                raise ValueError(f"{self.name} needs group_ids")
+            v = metrics.grouped_pointwise(
+                self.kind[len("GROUPED_"):], scores, labels, group_ids,
+                weights, num_groups,
+            )
         elif self.kind == "PRECISION_AT_K":
             if group_ids is None:
                 raise ValueError(f"{self.name} needs group_ids")
@@ -106,10 +113,15 @@ def parse_evaluator(spec: str) -> Evaluator:
         )
     if ":" in s:
         head, col = s.split(":", 1)
-        if head.strip().upper() == "AUC":
+        head = head.strip().upper()
+        if head in _SIMPLE_KINDS:
+            # Grouped ("sharded"/Multi) family: AUC:col, RMSE:col,
+            # LOGISTIC_LOSS:col, ... — reference ⟦MultiEvaluator⟧ by-group
+            # averaging for every base metric.
+            kind = "GROUPED_AUC" if head == "AUC" else f"GROUPED_{head}"
             return Evaluator(
-                name=f"AUC:{col}", kind="GROUPED_AUC",
-                bigger_is_better=True, group_column=col,
+                name=f"{head}:{col}", kind=kind,
+                bigger_is_better=_SIMPLE_KINDS[head], group_column=col,
             )
         raise ValueError(f"unknown grouped evaluator {spec!r}")
     kind = s.upper()

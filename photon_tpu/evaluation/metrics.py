@@ -213,3 +213,49 @@ def grouped_precision_at_k(
         jnp.sum(jnp.where(has_rows, per_group, 0.0)) / jnp.maximum(n_valid, 1),
         jnp.nan,
     )
+
+
+def _per_row_loss(kind: str, scores: Array, labels: Array) -> Array:
+    if kind in ("RMSE", "SQUARED_LOSS"):
+        return (scores - labels) ** 2
+    if kind == "LOGISTIC_LOSS":
+        return jnp.logaddexp(0.0, scores) - labels * scores
+    if kind == "POISSON_LOSS":
+        return jnp.exp(scores) - labels * scores
+    if kind == "SMOOTHED_HINGE_LOSS":
+        t = jnp.where(labels > 0.5, 1.0, -1.0)
+        z = t * scores
+        return jnp.where(
+            z >= 1.0, 0.0, jnp.where(z <= 0.0, 0.5 - z, 0.5 * (1.0 - z) ** 2)
+        )
+    raise ValueError(f"no per-row loss for {kind}")
+
+
+def grouped_pointwise(
+    kind: str,
+    scores: Array,
+    labels: Array,
+    group_ids: Array,
+    weights: Array | None = None,
+    num_groups: int | None = None,
+) -> Array:
+    """Generic grouped ("sharded") variant of the pointwise metrics: the
+    within-group weighted mean of the per-row loss (root-mean for RMSE), then
+    the UNWEIGHTED mean over non-empty groups — the reference
+    ⟦MultiEvaluator⟧ convention grouped AUC already follows. NaN when every
+    group is empty."""
+    w = jnp.ones_like(scores) if weights is None else weights
+    m = num_groups if num_groups is not None else scores.shape[0]
+    per_row = _per_row_loss(kind, scores, labels)
+    num = jax.ops.segment_sum(w * per_row, group_ids, num_segments=m)
+    den = jax.ops.segment_sum(w, group_ids, num_segments=m)
+    val = num / jnp.maximum(den, _EPS)
+    if kind == "RMSE":
+        val = jnp.sqrt(val)
+    valid = den > 0
+    n_valid = jnp.sum(valid)
+    return jnp.where(
+        n_valid > 0,
+        jnp.sum(jnp.where(valid, val, 0.0)) / jnp.maximum(n_valid, 1),
+        jnp.nan,
+    )

@@ -66,16 +66,12 @@ class FixedEffectCoordinate:
         else:
             w0 = jnp.zeros((batch.dim,), batch.labels.dtype)
         if self.mesh is not None and self.model_axis is not None:
-            if self.normalization is not None:
-                raise ValueError(
-                    "model-parallel fixed-effect training does not support "
-                    "normalization contexts yet"
-                )
             from photon_tpu.parallel.model_parallel import fit_model_parallel
 
             model, result = fit_model_parallel(
                 self.problem, batch, w0, self.mesh,
                 self.data_axis, self.model_axis,
+                normalization=self.normalization,
             )
         elif self.mesh is not None:
             model, result = fit_data_parallel(

@@ -91,8 +91,12 @@ class GameDataBundle:
     def n_rows(self) -> int:
         return len(self.labels)
 
-    def batch(self, shard: str, dtype=jnp.float32) -> LabeledBatch:
+    def batch(self, shard: str, dtype=None) -> LabeledBatch:
+        """``dtype=None`` follows the feature values' dtype, so a bundle read
+        with ``dtype=np.float64`` (the x64 mode) trains double end-to-end."""
         feats = self.features[shard]
+        if dtype is None:
+            dtype = feats.val.dtype
         return LabeledBatch(
             features=feats,
             labels=jnp.asarray(self.labels, dtype),

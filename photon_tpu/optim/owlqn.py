@@ -34,6 +34,7 @@ from photon_tpu.optim.base import (
 from photon_tpu.optim.lbfgs import (
     LBFGSHistory,
     empty_history,
+    make_dot,
     two_loop_direction,
     update_history,
 )
@@ -74,7 +75,14 @@ class OWLQN(Optimizer):
     ``optimize(value_and_grad, x0, l1_weights)``: ``value_and_grad`` must be
     the *smooth* part (loss + any L2 term); ``l1_weights`` is the [D] vector of
     per-coefficient L1 penalties (zeros for unpenalized entries).
+
+    With ``axis_name`` set, ``x0``/gradients/history are SHARDS over that
+    mesh axis (run inside ``shard_map``; SURVEY.md §2.6 P3). The orthant
+    machinery — pseudo-gradient, alignment, projection — is elementwise and
+    therefore shard-local; only inner products and the L1 term psum.
     """
+
+    axis_name: str = None
 
     def optimize(  # type: ignore[override]
         self, value_and_grad: ValueAndGrad, x0: Array, l1_weights: Array
@@ -85,14 +93,16 @@ class OWLQN(Optimizer):
         dim = x0.shape[-1]
         dtype = x0.dtype
         l1 = jnp.asarray(l1_weights, dtype)
+        dot = make_dot(self.axis_name)
+        norm = lambda v: jnp.sqrt(dot(v, v))
 
         def total(x, fsmooth):
-            return fsmooth + jnp.sum(l1 * jnp.abs(x))
+            return fsmooth + dot(l1, jnp.abs(x))
 
         f0s, g0 = value_and_grad(x0)
         f0 = total(x0, f0s)
         pg0 = pseudo_gradient(x0, g0, l1)
-        gnorm0 = l2_norm(pg0)
+        gnorm0 = norm(pg0)
         values = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(f0)
         gnorms = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(gnorm0)
 
@@ -110,11 +120,12 @@ class OWLQN(Optimizer):
 
         def body(st: _LoopState) -> _LoopState:
             pg = pseudo_gradient(st.x, st.g, l1)
-            d = two_loop_direction(pg, st.hist)
+            d = two_loop_direction(pg, st.hist, dot)
             # Align the direction with −pg (zero out disagreeing components).
             d = jnp.where(d * (-pg) > 0.0, d, 0.0)
-            # Fallback to steepest descent if alignment annihilated d.
-            d = jnp.where(jnp.any(d != 0.0), d, -pg)
+            # Fallback to steepest descent if alignment annihilated d
+            # (a GLOBAL test under sharding: any shard non-zero keeps d).
+            d = jnp.where(dot(d, d) > 0.0, d, -pg)
             xi = orthant(st.x, pg)
 
             def project(xt):
@@ -132,7 +143,7 @@ class OWLQN(Optimizer):
                 fts, gt = value_and_grad(xt)
                 ft = total(xt, fts)
                 # Armijo via the projected displacement, per OWL-QN.
-                decrease = jnp.dot(pg, xt - st.x)
+                decrease = dot(pg, xt - st.x)
                 ok = jnp.isfinite(ft) & (ft <= st.f + 1e-4 * decrease)
                 return (jnp.where(ok, t, 0.5 * t), ft, fts, gt, xt, it + 1, ok)
 
@@ -147,10 +158,10 @@ class OWLQN(Optimizer):
             f_new = jnp.where(accept, ft, st.f)
             g_new = jnp.where(accept, gt, st.g)
 
-            hist = update_history(st.hist, x_new - st.x, g_new - st.g)
+            hist = update_history(st.hist, x_new - st.x, g_new - st.g, dot)
             it = st.it + 1
             pg_new = pseudo_gradient(x_new, g_new, l1)
-            gnorm = l2_norm(pg_new)
+            gnorm = norm(pg_new)
             reason = check_convergence(it, st.f, f_new, gnorm, st.gnorm0, cfg)
             reason = jnp.where(
                 (~accept) & (reason == NOT_CONVERGED),
@@ -170,7 +181,7 @@ class OWLQN(Optimizer):
         reason = finalize_reason(st.reason, st.it, max_it)
         pg_fin = pseudo_gradient(st.x, st.g, l1)
         return OptimizerResult(
-            x=st.x, value=st.f, grad_norm=l2_norm(pg_fin),
+            x=st.x, value=st.f, grad_norm=norm(pg_fin),
             iterations=st.it, converged_reason=reason,
             values=st.values, grad_norms=st.grad_norms,
             data_passes=st.passes,

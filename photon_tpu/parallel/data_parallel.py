@@ -35,7 +35,13 @@ from jax import shard_map
 from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.functions.objective import GLMObjective
 from photon_tpu.functions.problem import GLMOptimizationProblem
-from photon_tpu.parallel.mesh import DATA_AXIS, replicated, shard_batch_pytree
+from photon_tpu.parallel.mesh import (
+    DATA_AXIS,
+    axes_size,
+    axis_tuple,
+    replicated,
+    shard_batch_pytree,
+)
 
 Array = jax.Array
 
@@ -50,13 +56,18 @@ def fit_data_parallel(
 ):
     """Run the full solve with the batch row-sharded over ``data_axis``.
 
+    ``data_axis`` may be one mesh axis or a tuple — pass ``("dcn", "data")``
+    on a 2-level multi-slice mesh (``make_multislice_mesh``) to shard rows
+    over slices × chips; XLA lowers the gradient AllReduce hierarchically
+    (ICI within each slice, DCN across slices — SURVEY.md §5.8).
+
     Row counts that don't divide the axis size are padded with weight-0 rows
     (padding is invisible to the objective — SURVEY.md batch semantics).
     Returns (GeneralizedLinearModel, OptimizerResult), both replicated.
     """
     from photon_tpu.parallel.mesh import pad_rows_to_multiple
 
-    axis_size = mesh.shape[data_axis]
+    axis_size = axes_size(mesh, data_axis)
     if getattr(batch.features, "fast", None) is not None:
         # The column-sorted fast-path table is not row-shardable.
         batch = dataclasses.replace(
@@ -102,14 +113,17 @@ def spmd_value_and_grad(
     The returned closure can be handed straight to any Optimizer — the psum
     rides ICI inside whatever jit the optimizer loop compiles into. The L2
     term is added once globally (outside the psum), not once per shard.
+    ``data_axis`` may be a tuple (multi-slice: the psum over
+    ``("dcn", "data")`` is the hierarchical treeAggregate replacement).
     """
+    axes = axis_tuple(data_axis)
     data_obj = GLMObjective(loss=obj.loss, l2_weight=0.0, reg_mask=None)
     if getattr(batch.features, "fast", None) is not None:
         batch = dataclasses.replace(
             batch, features=batch.features.without_fast_path()
         )
     batch_specs = jax.tree.map(
-        lambda leaf: P(data_axis, *([None] * (leaf.ndim - 1))), batch
+        lambda leaf: P(axes, *([None] * (leaf.ndim - 1))), batch
     )
 
     @partial(
@@ -120,7 +134,7 @@ def spmd_value_and_grad(
     )
     def _vg(w, local_batch):
         v, g = data_obj.value_and_grad(w, local_batch)
-        return lax.psum(v, data_axis), lax.psum(g, data_axis)
+        return lax.psum(v, axes), lax.psum(g, axes)
 
     sharded = shard_batch_pytree(batch, mesh, data_axis)
 

@@ -24,6 +24,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 ENTITY_AXIS = "entity"
 FEATURE_AXIS = "feature"
+DCN_AXIS = "dcn"
+
+# An axis argument throughout parallel/ may be one mesh axis name or a tuple
+# of names (e.g. ("dcn", "data") — rows sharded over slices x chips, with
+# psum lowering hierarchically: ICI within a slice, DCN across slices).
+AxisSpec = "str | tuple[str, ...]"
+
+
+def axis_tuple(axis) -> tuple:
+    return (axis,) if isinstance(axis, str) else tuple(axis)
+
+
+def axes_size(mesh: Mesh, axis) -> int:
+    return int(np.prod([mesh.shape[a] for a in axis_tuple(axis)]))
 
 
 def make_mesh(
@@ -45,24 +59,78 @@ def make_mesh(
     return Mesh(dev_array, names)
 
 
-def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+def make_multislice_mesh(
+    n_slices: int,
+    axis_sizes: dict[str, int] | None = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    dcn_axis: str = DCN_AXIS,
+) -> Mesh:
+    """2-level mesh: an outer ``dcn`` axis over slices (slowest-varying) and
+    the given ICI axes within each slice — the multi-slice deployment shape
+    (SURVEY.md §5.8: hierarchical psum replaces treeAggregate; ICI within a
+    slice, DCN across).
+
+    On real multi-slice TPU topologies the device order comes from
+    ``mesh_utils.create_hybrid_device_mesh`` so that the outer axis truly
+    crosses slice boundaries (minimizing DCN traffic for inner-axis
+    collectives); on single-slice or host-simulated devices it falls back to
+    a plain reshape, which exercises identical program structure.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) % n_slices:
+        raise ValueError(f"{len(devices)} devices not divisible by {n_slices} slices")
+    per_slice = len(devices) // n_slices
+    if not axis_sizes:
+        axis_sizes = {DATA_AXIS: per_slice}
+    inner = tuple(axis_sizes.values())
+    if int(np.prod(inner)) != per_slice:
+        raise ValueError(
+            f"inner axes {axis_sizes} want {int(np.prod(inner))} devices/slice, "
+            f"have {per_slice}"
+        )
+    names = (dcn_axis,) + tuple(axis_sizes)
+    slice_ids = {getattr(d, "slice_index", 0) for d in devices}
+    if len(slice_ids) > 1 and len(slice_ids) != n_slices:
+        # On real multi-slice hardware a mismatched dcn size would silently
+        # put inner-axis collectives on DCN links — exactly the pathology a
+        # 2-level mesh exists to prevent. Refuse instead.
+        raise ValueError(
+            f"devices span {len(slice_ids)} slices but n_slices={n_slices}; "
+            "the dcn axis must match the physical slice count"
+        )
+    if n_slices > 1 and len(slice_ids) == n_slices:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1,) + inner,
+            dcn_mesh_shape=(n_slices,) + (1,) * len(inner),
+            devices=devices,
+        )
+    else:
+        dev_array = np.asarray(devices).reshape((n_slices,) + inner)
+    return Mesh(dev_array, names)
+
+
+def batch_sharding(mesh: Mesh, axis=DATA_AXIS) -> NamedSharding:
     """Shard the leading (row) dimension over ``axis``; replicate the rest."""
-    return NamedSharding(mesh, P(axis))
+    return NamedSharding(mesh, P(axis_tuple(axis)))
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch_pytree(batch, mesh: Mesh, axis: str = DATA_AXIS):
-    """Device-put every array leaf of a batch pytree row-sharded over ``axis``.
+def shard_batch_pytree(batch, mesh: Mesh, axis=DATA_AXIS):
+    """Device-put every array leaf of a batch pytree row-sharded over ``axis``
+    (one name or a tuple, e.g. ``("dcn", "data")``).
 
     All leaves of a LabeledBatch share the same leading row count, so one
     spec applies uniformly (ELL idx/val are [N, K]; labels/offsets/weights
     are [N]).
     """
+    ax = axis_tuple(axis)
 
     def put(leaf):
-        spec = P(axis, *([None] * (leaf.ndim - 1)))
+        spec = P(ax, *([None] * (leaf.ndim - 1)))
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree.map(put, batch)

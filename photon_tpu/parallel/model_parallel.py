@@ -35,9 +35,9 @@ from photon_tpu.data.batch import DenseFeatures, LabeledBatch, SparseFeatures
 from photon_tpu.functions.problem import GLMOptimizationProblem
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import GeneralizedLinearModel
-from photon_tpu.optim import LBFGS, OptimizerType
+from photon_tpu.optim import LBFGS, OWLQN, OptimizerType
 from photon_tpu.ops.losses import loss_for_task
-from photon_tpu.parallel.mesh import pad_rows_to_multiple
+from photon_tpu.parallel.mesh import axes_size, axis_tuple, pad_rows_to_multiple
 
 Array = jax.Array
 
@@ -58,25 +58,41 @@ def fit_model_parallel(
     mesh,
     data_axis: str = DATA_AXIS,
     model_axis: str = MODEL_AXIS,
+    normalization=None,
 ):
-    """Full L-BFGS solve with coefficients sharded over ``model_axis`` and
-    rows over ``data_axis``. Returns (GeneralizedLinearModel, OptimizerResult)
-    with full-length (host-assembled) coefficients.
+    """Full solve with coefficients sharded over ``model_axis`` and rows over
+    ``data_axis`` (one axis or a tuple — e.g. ``("dcn", "data")``). Returns
+    (GeneralizedLinearModel, OptimizerResult) with full-length
+    (host-assembled) coefficients.
 
-    Supports LBFGS with NONE variance and no normalization (the P3
-    scale path; other optimizers/options use the data-parallel path).
+    Supports L-BFGS and OWL-QN (orthant ops are elementwise → shard-local;
+    only inner products psum), NONE/SIMPLE variances (SIMPLE's Hessian
+    diagonal is computed per feature shard), and normalization contexts (the
+    coefficient-space map's shift correction is one scalar psum over the
+    model axis; SURVEY.md §7 hard-part #5). TRON and FULL variance use the
+    data-parallel path: TRON's inner CG and a D×D inverse don't fit the
+    sharded-state design.
     """
-    if problem.optimizer_type != OptimizerType.LBFGS:
+    if problem.optimizer_type not in (OptimizerType.LBFGS, OptimizerType.OWLQN):
         raise ValueError(
-            "model-parallel training currently supports LBFGS only "
+            "model-parallel training supports LBFGS and OWLQN "
             f"(got {problem.optimizer_type.name})"
         )
-    if problem.variance_type.name != "NONE":
-        raise ValueError("model-parallel training does not compute variances")
-    if problem.regularization.l1_weight(problem.reg_weight) > 0.0:
-        raise ValueError("model-parallel training supports smooth (L2) regularization only")
+    if problem.variance_type.name == "FULL":
+        raise ValueError(
+            "model-parallel training computes NONE/SIMPLE variances only "
+            "(FULL materializes a DxD Hessian)"
+        )
+    if normalization is not None and normalization.is_identity:
+        normalization = None
+    if normalization is not None and problem.prior is not None:
+        raise ValueError(
+            "model-parallel training does not combine a normalization "
+            "context with an incremental-training prior"
+        )
 
-    n_data = mesh.shape[data_axis]
+    data_axes = axis_tuple(data_axis)
+    n_data = axes_size(mesh, data_axes)
     n_model = mesh.shape[model_axis]
     d = batch.dim
     d_pad = -d % n_model
@@ -88,12 +104,12 @@ def fit_model_parallel(
     if isinstance(feats, SparseFeatures):
         feats = _pad_dim_sparse(feats, d_full)
         feats_specs = SparseFeatures(
-            idx=P(data_axis, None), val=P(data_axis, None), dim=feats.dim
+            idx=P(data_axes, None), val=P(data_axes, None), dim=feats.dim
         )
     elif isinstance(feats, DenseFeatures):
         if d_pad:
             feats = DenseFeatures(jnp.pad(feats.x, ((0, 0), (0, d_pad))))
-        feats_specs = DenseFeatures(x=P(data_axis, model_axis))
+        feats_specs = DenseFeatures(x=P(data_axes, model_axis))
     else:  # pragma: no cover - union is closed
         raise TypeError(f"unknown feature container {type(feats)}")
     batch = dataclasses.replace(batch, features=feats)
@@ -109,12 +125,36 @@ def fit_model_parallel(
 
     shard_d = d_full // n_model
     l2 = problem.regularization.l2_weight(problem.reg_weight)
+    l1 = problem.regularization.l1_weight(problem.reg_weight)
+    if l1 > 0.0 and problem.optimizer_type != OptimizerType.OWLQN:
+        # Reference parity (same guard as GLMOptimizationProblem.run): L1 is
+        # only handled by OWL-QN; silently training unregularized is worse.
+        raise ValueError(
+            f"{problem.regularization.reg_type.name} regularization requires "
+            f"OptimizerType.OWLQN, got {problem.optimizer_type.name}"
+        )
     loss = loss_for_task(problem.task)
     prior = problem.prior
     if prior is not None:
         prior = jax.tree.map(lambda a: jnp.pad(a, (0, d_pad)), prior)
 
-    row_specs = P(data_axis)
+    # Normalization arrays, sanitized (intercept slot forced to factor 1 /
+    # shift 0) and padded to the sharded width. Padding columns get factor 1
+    # so the map stays invertible there (they carry zero data and zero w).
+    norm_f = norm_s = norm_onehot = None
+    if normalization is not None:
+        nf, ns = normalization._effective()
+        if nf is not None:
+            norm_f = jnp.pad(nf.astype(w0.dtype), (0, d_pad), constant_values=1.0)
+        if ns is not None:
+            norm_s = jnp.pad(ns.astype(w0.dtype), (0, d_pad))
+            norm_onehot = (
+                jnp.zeros((d_full,), w0.dtype)
+                .at[normalization.intercept_index]
+                .set(1.0)
+            )
+
+    row_specs = P(data_axes)
     batch_specs = LabeledBatch(
         features=feats_specs, labels=row_specs, offsets=row_specs,
         weights=row_specs,
@@ -128,6 +168,8 @@ def fit_model_parallel(
         converged_reason=P(), values=P(), grad_norms=P(), data_passes=P(),
     )
 
+    norm_arrays = (norm_f, norm_s, norm_onehot)
+
     @partial(
         shard_map,
         mesh=mesh,
@@ -136,12 +178,14 @@ def fit_model_parallel(
             batch_specs,
             P(model_axis),
             jax.tree.map(lambda _: P(model_axis), prior),
+            jax.tree.map(lambda _: P(model_axis), norm_arrays),
         ),
-        out_specs=(P(model_axis), res_specs),
+        out_specs=((P(model_axis), P(model_axis)), res_specs),
         check_vma=False,
     )
-    def solve(w_shard, local_batch, lam_shard, prior_shard):
+    def solve(w_shard, local_batch, lam_shard, prior_shard, norm_shards):
         lf = local_batch.features
+        f_sh, s_sh, onehot_sh = norm_shards
 
         if isinstance(lf, SparseFeatures):
             lo = lax.axis_index(model_axis) * shard_d
@@ -162,6 +206,15 @@ def fit_model_parallel(
                 g = jnp.zeros((shard_d + 1,), contrib.dtype)
                 g = g.at[li.ravel()].add(contrib.ravel())
                 return g[:shard_d]
+
+            def sq_shard(dz):
+                li = lf.idx - lo
+                own = (li >= 0) & (li < shard_d)
+                li = jnp.where(own, li, shard_d)
+                contrib = lf.val * lf.val * dz[:, None]
+                g = jnp.zeros((shard_d + 1,), contrib.dtype)
+                g = g.at[li.ravel()].add(contrib.ravel())
+                return g[:shard_d]
         else:
 
             def margins(ws):
@@ -170,13 +223,54 @@ def fit_model_parallel(
             def grad_shard(dz):
                 return lf.x.T @ dz
 
-        def vg(ws):
-            z = margins(ws) + local_batch.offsets
+            def sq_shard(dz):
+                return (lf.x * lf.x).T @ dz
+
+        # Coefficient-space maps for normalization (SURVEY.md §7 hard-part
+        # #5): shard-local elementwise scaling; the shift correction and its
+        # pullback each cost ONE scalar psum over the model axis.
+        #   to_original:  w = (I − e·sᵀ)·F·w'      (e = intercept one-hot)
+        #   pullback:     ∇w' = F·(∇w − s·(eᵀ∇w))
+        def to_original(wp):
+            out = wp if f_sh is None else wp * f_sh
+            if s_sh is not None:
+                corr = lax.psum(jnp.sum(out * s_sh), model_axis)
+                out = out - onehot_sh * corr
+            return out
+
+        def pullback(g):
+            if s_sh is not None:
+                g_int = lax.psum(jnp.sum(onehot_sh * g), model_axis)
+                g = g - s_sh * g_int
+            if f_sh is None:
+                return g
+            return g * f_sh
+
+        def to_transformed(w):
+            if s_sh is not None:
+                corr = lax.psum(jnp.sum(w * s_sh), model_axis)
+                w = w + onehot_sh * corr
+            return w if f_sh is None else w / f_sh
+
+        use_norm = f_sh is not None or s_sh is not None
+
+        def data_vg(w_orig):
+            z = margins(w_orig) + local_batch.offsets
             lv = jnp.sum(local_batch.weights * loss.loss(z, local_batch.labels))
-            lv = lax.psum(lv, data_axis)
+            lv = lax.psum(lv, data_axes)
             dz = local_batch.weights * loss.d1(z, local_batch.labels)
-            g = lax.psum(grad_shard(dz), data_axis)
-            lam = l2 * lam_shard
+            g = lax.psum(grad_shard(dz), data_axes)
+            return lv, g
+
+        lam = l2 * lam_shard
+
+        def vg(ws):
+            # Data term at the original-space point; regularization on the
+            # transformed-space coefficients (what the optimizer sees) —
+            # reference semantics.
+            lv, g = data_vg(to_original(ws) if use_norm else ws)
+            if use_norm:
+                g = pullback(g)
             # L2 value is a model-axis-sharded sum; data term already global.
             lv = lv + lax.psum(0.5 * jnp.sum(lam * ws * ws), model_axis)
             g = g + lam * ws
@@ -185,24 +279,57 @@ def fit_model_parallel(
                 g = g + prior_shard.gradient(ws)
             return lv, g
 
-        result = LBFGS(key.optimizer_config, axis_name=model_axis).optimize(
-            vg, w_shard
-        )
-        return result.x, dataclasses.replace(result, x=jnp.zeros((0,), w_shard.dtype))
+        w_start = to_transformed(w_shard) if use_norm else w_shard
+        if key.optimizer_type == OptimizerType.OWLQN:
+            result = OWLQN(key.optimizer_config, axis_name=model_axis).optimize(
+                vg, w_start, l1 * lam_shard
+            )
+        else:
+            result = LBFGS(key.optimizer_config, axis_name=model_axis).optimize(
+                vg, w_start
+            )
+        x_orig = to_original(result.x) if use_norm else result.x
 
-    x_sharded, result = solve(
-        jax.device_put(
-            w0, NamedSharding(mesh, P(model_axis))
-        ),
+        # SIMPLE variance (reference VarianceComputationType.SIMPLE): inverse
+        # Hessian diagonal of the trained objective, per feature shard. Under
+        # normalization the effective original-space penalty is λ/f².
+        if key.variance_type.name == "SIMPLE":
+            z = margins(x_orig) + local_batch.offsets
+            d2 = local_batch.weights * loss.d2(z, local_batch.labels)
+            diag = lax.psum(sq_shard(d2), data_axes)
+            lam_eff = lam if f_sh is None else lam / (f_sh * f_sh)
+            diag = diag + lam_eff
+            if prior_shard is not None:
+                diag = diag + prior_shard.hessian_diagonal()
+            variances = 1.0 / jnp.maximum(diag, 1e-12)
+        else:
+            variances = jnp.zeros_like(x_orig)
+
+        return (x_orig, variances), dataclasses.replace(
+            result, x=jnp.zeros((0,), w_shard.dtype)
+        )
+
+    put_model = lambda a: (
+        None if a is None
+        else jax.device_put(a, NamedSharding(mesh, P(model_axis)))
+    )
+    (x_sharded, var_sharded), result = solve(
+        put_model(w0),
         _shard_batch(batch, mesh, batch_specs),
-        jax.device_put(lam_mask, NamedSharding(mesh, P(model_axis))),
-        jax.tree.map(
-            lambda a: jax.device_put(a, NamedSharding(mesh, P(model_axis))), prior
-        ),
+        put_model(lam_mask),
+        jax.tree.map(put_model, prior),
+        jax.tree.map(put_model, norm_arrays),
     )
     x = jnp.asarray(x_sharded)[:d]
     result = dataclasses.replace(result, x=x)
-    model = GeneralizedLinearModel(Coefficients(means=x), problem.task)
+    variances = (
+        jnp.asarray(var_sharded)[:d]
+        if problem.variance_type.name == "SIMPLE"
+        else None
+    )
+    model = GeneralizedLinearModel(
+        Coefficients(means=x, variances=variances), problem.task
+    )
     return model, result
 
 

@@ -120,3 +120,80 @@ def test_uneven_rows_reject_or_pad(rng, mesh):
     m_ref, _ = prob.run(batch, jnp.zeros(9, jnp.float64))
     np.testing.assert_allclose(m_pad.coefficients.means,
                                m_ref.coefficients.means, atol=1e-8)
+
+
+class TestMultiSliceDCN:
+    """2-level dcn x ici meshes (SURVEY.md §5.8): the 8 virtual devices play
+    2 slices x 4 chips; psums over ("dcn", "data") lower hierarchically on
+    real multi-slice topologies and must be numerically identical to the
+    single-axis path here."""
+
+    @pytest.fixture(scope="class")
+    def mesh2(self):
+        from photon_tpu.parallel.mesh import make_multislice_mesh
+
+        return make_multislice_mesh(n_slices=2, axis_sizes={"data": 4})
+
+    def test_mesh_shape_and_axis_order(self, mesh2):
+        assert mesh2.axis_names == ("dcn", "data")
+        assert mesh2.shape["dcn"] == 2 and mesh2.shape["data"] == 4
+
+    def test_spmd_value_and_grad_hierarchical(self, rng, mesh2):
+        batch = _data(rng)
+        obj = GLMObjective(loss=LogisticLoss, l2_weight=0.5,
+                           reg_mask=intercept_reg_mask(9, 0))
+        w = jnp.asarray(rng.normal(size=9))
+        v_local, g_local = obj.value_and_grad(w, batch)
+        vg = spmd_value_and_grad(obj, batch, mesh2, data_axis=("dcn", "data"))
+        v, g = vg(w)
+        np.testing.assert_allclose(v, v_local, rtol=1e-10)
+        np.testing.assert_allclose(g, g_local, rtol=1e-9)
+
+    def test_fit_matches_single_slice(self, rng, mesh2):
+        batch = _data(rng)
+        problem = _make_problem()
+        w0 = jnp.zeros(9, jnp.float64)
+        m_single, r_single = jax.jit(problem.run)(batch, w0)
+        m_dcn, r_dcn = fit_data_parallel(
+            problem, batch, w0, mesh2, data_axis=("dcn", "data")
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_dcn.coefficients.means),
+            np.asarray(m_single.coefficients.means), atol=1e-7,
+        )
+        np.testing.assert_allclose(
+            float(r_dcn.value), float(r_single.value), rtol=1e-9
+        )
+
+    def test_uneven_rows_padded_over_both_axes(self, rng, mesh2):
+        batch = _data(rng, n=301)   # 301 % 8 != 0 -> weight-0 padding
+        problem = _make_problem()
+        w0 = jnp.zeros(9, jnp.float64)
+        m_single, _ = jax.jit(problem.run)(batch, w0)
+        m_dcn, _ = fit_data_parallel(
+            problem, batch, w0, mesh2, data_axis=("dcn", "data")
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_dcn.coefficients.means),
+            np.asarray(m_single.coefficients.means), atol=1e-7,
+        )
+
+    def test_model_parallel_on_dcn_mesh(self, rng):
+        from photon_tpu.parallel.mesh import make_multislice_mesh
+        from photon_tpu.parallel.model_parallel import fit_model_parallel
+
+        mesh3 = make_multislice_mesh(
+            n_slices=2, axis_sizes={"data": 2, "model": 2}
+        )
+        assert mesh3.axis_names == ("dcn", "data", "model")
+        batch = _data(rng, n=320)
+        problem = _make_problem()
+        w0 = jnp.zeros(9, jnp.float64)
+        m_single, _ = jax.jit(problem.run)(batch, w0)
+        m_mp, _ = fit_model_parallel(
+            problem, batch, w0, mesh3, data_axis=("dcn", "data")
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_mp.coefficients.means),
+            np.asarray(m_single.coefficients.means), atol=2e-5,
+        )

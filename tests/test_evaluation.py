@@ -180,3 +180,66 @@ def test_missing_group_ids_raises(rng):
     suite = EvaluationSuite.parse(["AUC:q"])
     with pytest.raises(ValueError):
         suite.evaluate(jnp.zeros(10), jnp.zeros(10))
+
+
+class TestGroupedPointwiseEvaluators:
+    """VERDICT round-3 ask #8: the full grouped family (RMSE:col, grouped
+    losses) via the segment machinery, vs a NumPy per-group reference."""
+
+    def _data(self, rng, n=200, g=7):
+        scores = rng.normal(size=n)
+        labels = (rng.random(n) < 0.5).astype(float)
+        weights = rng.uniform(0.5, 2.0, size=n)
+        gids = rng.integers(0, g, size=n)
+        return scores, labels, weights, gids, g
+
+    @pytest.mark.parametrize("spec,rowfn,sqrt", [
+        ("RMSE:q", lambda s, y: (s - y) ** 2, True),
+        ("SQUARED_LOSS:q", lambda s, y: (s - y) ** 2, False),
+        ("LOGISTIC_LOSS:q",
+         lambda s, y: np.logaddexp(0.0, s) - y * s, False),
+        ("POISSON_LOSS:q", lambda s, y: np.exp(s) - y * s, False),
+        ("SMOOTHED_HINGE_LOSS:q",
+         lambda s, y: np.where(np.where(y > 0.5, 1, -1) * s >= 1, 0.0,
+                               np.where(np.where(y > 0.5, 1, -1) * s <= 0,
+                                        0.5 - np.where(y > 0.5, 1, -1) * s,
+                                        0.5 * (1 - np.where(y > 0.5, 1, -1) * s) ** 2)),
+         False),
+    ])
+    def test_matches_numpy_reference(self, rng, spec, rowfn, sqrt):
+        scores, labels, weights, gids, g = self._data(rng)
+        ev = parse_evaluator(spec)
+        assert ev.group_column == "q"
+        assert not ev.bigger_is_better
+        got = ev.evaluate(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights),
+            jnp.asarray(gids), g,
+        )
+        vals = []
+        for grp in range(g):
+            m = gids == grp
+            if not m.any():
+                continue
+            v = np.sum(weights[m] * rowfn(scores[m], labels[m])) / np.sum(weights[m])
+            vals.append(np.sqrt(v) if sqrt else v)
+        np.testing.assert_allclose(got, np.mean(vals), rtol=1e-10)
+
+    def test_empty_groups_skipped(self, rng):
+        scores, labels, weights, gids, g = self._data(rng)
+        gids = np.where(gids == 3, 1, gids)   # group 3 empty
+        ev = parse_evaluator("RMSE:q")
+        got = ev.evaluate(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights),
+            jnp.asarray(gids), g,
+        )
+        assert np.isfinite(got)
+
+    def test_suite_integration(self, rng):
+        scores, labels, weights, gids, g = self._data(rng)
+        suite = EvaluationSuite.parse(["AUC", "RMSE:q", "LOGISTIC_LOSS:q"])
+        res = suite.evaluate(
+            jnp.asarray(scores), jnp.asarray(labels), jnp.asarray(weights),
+            {"q": jnp.asarray(gids)}, {"q": g},
+        )
+        assert set(res.values) == {"AUC", "RMSE:q", "LOGISTIC_LOSS:q"}
+        assert all(np.isfinite(v) for v in res.values.values())

@@ -385,3 +385,87 @@ class TestReviewRegressions:
             {"g": imap}, columns=InputColumnNames(response="target"))
         with pytest.raises(ValueError, match="missing required column"):
             reader.read(str(p))
+
+
+class TestSkewedRandomEffectLoader:
+    def test_skewed_model_loads_size_bucketed(self, tmp_path, rng):
+        """Round-2 weak #5 / ask #6: one dense entity among many sparse ones
+        must load into size-bucketed stacks with memory O(Σ 2·nnz), not one
+        E × P_max bucket — and still score identically."""
+        d = 3000
+        imap = build_index_from_features(
+            [("f", str(j)) for j in range(d)], add_intercept=False
+        )
+        n_sparse = 400
+        # Dense entity: 2000 active features; sparse entities: 4 each.
+        rows = [("DENSE", rng.choice(d, size=2000, replace=False))]
+        for i in range(n_sparse):
+            rows.append((f"s{i}", rng.choice(d, size=4, replace=False)))
+        from photon_tpu.game.random_effect import RandomEffectModel
+
+        entity_keys = [k for k, _ in rows]
+        sparse = [
+            (np.sort(gi).astype(np.int64), rng.normal(size=len(gi)))
+            for _, gi in rows
+        ]
+        from photon_tpu.io.model_io import _synthetic_random_effect_model
+
+        m = _synthetic_random_effect_model(
+            "userId", TaskType.LOGISTIC_REGRESSION, entity_keys,
+            sparse, d, None,
+        )
+        total_cells = sum(int(np.prod(c.shape)) for c in m.bucket_coefs)
+        total_nnz = sum(len(gi) for gi, _ in sparse)
+        assert total_cells <= 2 * total_nnz + 64, (total_cells, total_nnz)
+        assert len(m.bucket_coefs) >= 2  # genuinely bucketed
+        # Old layout for comparison: 401 entities x 2048-wide = ~821K cells.
+        assert total_cells < (n_sparse + 1) * 2048 / 50
+        # Per-entity coefficients survive the bucketing exactly.
+        for i, key in enumerate(entity_keys):
+            gi, gv = sparse[i]
+            li, lv = m.coefficients_for(key)
+            np.testing.assert_array_equal(np.asarray(li), gi)
+            np.testing.assert_allclose(np.asarray(lv), gv, rtol=1e-6)
+
+    def test_save_load_roundtrip_with_buckets(self, tmp_path, rng):
+        """Full save/load round trip through the Avro layout with a skewed
+        coordinate still scores identically."""
+        from photon_tpu.functions.problem import GLMOptimizationProblem
+        from photon_tpu.game.random_effect import train_random_effects
+        from photon_tpu.optim import OptimizerConfig, OptimizerType
+
+        n, d = 140, 64
+        imap = build_index_from_features(
+            [("f", str(j)) for j in range(d)], add_intercept=False
+        )
+        # One heavy user (60 rows, wide features), many light users.
+        users = np.asarray(
+            ["heavy"] * 60 + [f"u{i % 20}" for i in range(n - 60)], object
+        )
+        k_heavy, k_light = 24, 3
+        idx = np.zeros((n, k_heavy), np.int32)
+        val = np.zeros((n, k_heavy))
+        idx[:60] = rng.integers(0, d, size=(60, k_heavy))
+        val[:60] = rng.normal(size=(60, k_heavy))
+        idx[60:, :k_light] = rng.integers(0, d, size=(n - 60, k_light))
+        idx[60:, k_light:] = d  # ghost padding
+        val[60:, :k_light] = rng.normal(size=(n - 60, k_light))
+        y = (rng.uniform(size=n) < 0.5).astype(np.float64)
+        ds = build_random_effect_dataset(
+            "userId", users, idx, val, y, global_dim=d, dtype=np.float64
+        )
+        prob = GLMOptimizationProblem(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_type=OptimizerType.LBFGS,
+            optimizer_config=OptimizerConfig(max_iterations=25),
+            reg_weight=1.0,
+        )
+        model, _ = train_random_effects(prob, ds, jnp.zeros((n,), jnp.float64))
+        gm = GameModel({"perUser": model})
+        mdir = str(tmp_path / "skew")
+        save_game_model(mdir, gm, {"global": imap},
+                        shard_by_coordinate={"perUser": "global"})
+        loaded, _ = load_game_model(mdir, {"global": imap})
+        s_orig = np.asarray(model.score_dataset(ds))
+        s_load = np.asarray(loaded["perUser"].score_new_dataset(ds))
+        np.testing.assert_allclose(s_load, s_orig, rtol=1e-4, atol=1e-5)

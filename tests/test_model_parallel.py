@@ -152,24 +152,125 @@ class TestModelParallelValidation:
     def test_unsupported_options_raise(self, rng, problem, mesh_4x2):
         batch = _sparse_problem(rng)
         w0 = jnp.zeros(batch.dim, jnp.float64)
-        with pytest.raises(ValueError, match="LBFGS only"):
+        with pytest.raises(ValueError, match="LBFGS and OWLQN"):
             fit_model_parallel(
                 dataclasses.replace(problem, optimizer_type=OptimizerType.TRON),
                 batch, w0, mesh_4x2)
         from photon_tpu.functions.problem import VarianceComputationType
 
-        with pytest.raises(ValueError, match="variances"):
+        with pytest.raises(ValueError, match="FULL"):
             fit_model_parallel(
                 dataclasses.replace(
-                    problem, variance_type=VarianceComputationType.SIMPLE),
+                    problem, variance_type=VarianceComputationType.FULL),
                 batch, w0, mesh_4x2)
         from photon_tpu.optim.regularization import elastic_net_context
 
-        with pytest.raises(ValueError, match="L2"):
+        with pytest.raises(ValueError, match="OWLQN"):
             fit_model_parallel(
                 dataclasses.replace(
                     problem, regularization=elastic_net_context(0.5)),
                 batch, w0, mesh_4x2)
+
+
+class TestP3Breadth:
+    """Round-3 P3 completion (VERDICT ask #4): OWL-QN, normalization, and
+    SIMPLE variance under feature sharding, each vs the replicated
+    single-device reference."""
+
+    def test_owlqn_l1_matches_single_device(self, rng, mesh_4x2):
+        p = GLMOptimizationProblem(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_type=OptimizerType.OWLQN,
+            optimizer_config=OptimizerConfig(max_iterations=80),
+            regularization=RegularizationContext(RegularizationType.L1),
+            reg_weight=0.8,
+        )
+        batch = _sparse_problem(rng)
+        w0 = jnp.zeros(batch.dim, jnp.float64)
+        m_ref, r_ref = p.fit(batch, w0)
+        m_mp, r_mp = fit_model_parallel(p, batch, w0, mesh_4x2)
+        np.testing.assert_allclose(
+            np.asarray(m_mp.coefficients.means),
+            np.asarray(m_ref.coefficients.means), atol=1e-6,
+        )
+        # The L1 solution's sparsity pattern must survive sharding exactly.
+        np.testing.assert_array_equal(
+            np.asarray(m_mp.coefficients.means) == 0.0,
+            np.asarray(m_ref.coefficients.means) == 0.0,
+        )
+
+    def test_simple_variance_matches_single_device(self, rng, problem, mesh_4x2):
+        from photon_tpu.functions.problem import VarianceComputationType
+
+        p = dataclasses.replace(
+            problem, variance_type=VarianceComputationType.SIMPLE
+        )
+        batch = _sparse_problem(rng)
+        w0 = jnp.zeros(batch.dim, jnp.float64)
+        m_ref, _ = p.fit(batch, w0)
+        m_mp, _ = fit_model_parallel(p, batch, w0, mesh_4x2)
+        np.testing.assert_allclose(
+            np.asarray(m_mp.coefficients.variances),
+            np.asarray(m_ref.coefficients.variances), rtol=1e-6,
+        )
+
+    @pytest.mark.parametrize("norm_type", [
+        "SCALE_WITH_STANDARD_DEVIATION", "STANDARDIZATION",
+    ])
+    def test_normalization_matches_single_device(self, rng, problem, mesh_4x2,
+                                                 norm_type):
+        from photon_tpu.data.normalization import (
+            NormalizationType,
+            context_from_statistics,
+        )
+        from photon_tpu.data.statistics import compute_feature_statistics
+
+        batch = _sparse_problem(rng)
+        # Give the shard an intercept column (id 0, value 1 in every row) so
+        # STANDARDIZATION has somewhere to absorb shifts.
+        idx = np.asarray(batch.features.idx)
+        val = np.asarray(batch.features.val)
+        idx = np.concatenate([np.zeros((len(idx), 1), np.int32), idx], axis=1)
+        val = np.concatenate([np.ones((len(val), 1)), val], axis=1)
+        batch = dataclasses.replace(
+            batch,
+            features=SparseFeatures(jnp.asarray(idx), jnp.asarray(val),
+                                    batch.features.dim),
+        )
+        stats = compute_feature_statistics(batch)
+        ctx = context_from_statistics(
+            stats, NormalizationType[norm_type], intercept_index=0
+        )
+        p = dataclasses.replace(
+            problem,
+            reg_mask=jnp.ones(batch.dim, jnp.float64).at[0].set(0.0),
+        )
+        w0 = jnp.zeros(batch.dim, jnp.float64)
+        m_ref, r_ref = p.fit(batch, w0, normalization=ctx)
+        m_mp, r_mp = fit_model_parallel(
+            p, batch, w0, mesh_4x2, normalization=ctx
+        )
+        np.testing.assert_allclose(
+            float(r_mp.value), float(r_ref.value), rtol=1e-9
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_mp.coefficients.means),
+            np.asarray(m_ref.coefficients.means), atol=1e-6,
+        )
+
+    def test_estimator_auto_routes_wide_coordinates(self, rng):
+        """With a model axis in the mesh and dim above the threshold, the
+        estimator picks P3 automatically (and below it, stays data-parallel).
+        Both must train successfully on the same 2D mesh."""
+        from tests.test_estimator import BASE, _bundle, _estimator
+
+        train, val = _bundle(rng), _bundle(rng, seed_shift=1)
+        mesh = make_mesh({"data": 4, "model": 2})
+        est_auto = _estimator(n_sweeps=1, mesh=mesh, auto_p3_threshold=8)
+        est_ref = _estimator(n_sweeps=1)
+        auc_auto = est_auto.fit(train, val, [BASE])[0].evaluation.values["AUC"]
+        auc_ref = est_ref.fit(train, val, [BASE])[0].evaluation.values["AUC"]
+        assert auc_auto == pytest.approx(auc_ref, abs=5e-3)
 
 
 def test_estimator_with_model_axis(rng):
