@@ -40,8 +40,14 @@ import time
 
 import numpy as np
 
-N_ROWS, DIM, K = 1 << 19, 1 << 18, 32
-MAX_ITER = 40
+# PHOTON_BENCH_SMOKE=1 shrinks every workload to toy shapes so ci.sh can
+# exercise the full bench code path on CPU in ~a minute. Smoke numbers are
+# NOT performance claims; they are written to BENCH_DETAILS.smoke.json
+# (never to BENCH_DETAILS.json, which holds only real-hardware numbers).
+SMOKE = os.environ.get("PHOTON_BENCH_SMOKE") == "1"
+
+N_ROWS, DIM, K = (1 << 14, 1 << 12, 32) if SMOKE else (1 << 19, 1 << 18, 32)
+MAX_ITER = 10 if SMOKE else 40
 
 
 def _make_data(n_rows: int, dim: int, k: int, seed: int = 0):
@@ -127,7 +133,7 @@ def measured_hbm_bandwidth() -> float:
     import jax.numpy as jnp
     from jax import lax
 
-    n = 1 << 26  # 256 MB of f32
+    n = 1 << 22 if SMOKE else 1 << 26  # 256 MB of f32 (16 MB in smoke mode)
 
     def make(iters):
         @jax.jit
@@ -233,7 +239,7 @@ def bench_owlqn_tron():
     )
     from photon_tpu.types import TaskType
 
-    n, dim, k = 1 << 17, 1 << 15, 16
+    n, dim, k = (1 << 12, 1 << 10, 16) if SMOKE else (1 << 17, 1 << 15, 16)
     rng = np.random.default_rng(1)
     idx = rng.integers(0, dim, size=(n, k)).astype(np.int32)
     val = rng.normal(size=(n, k)).astype(np.float32) / np.sqrt(k)
@@ -277,10 +283,6 @@ def bench_owlqn_tron():
 
 def bench_game():
     """Config-3 shape: fixed effect + per-user random effect, one sweep."""
-    import jax
-    import jax.numpy as jnp
-
-    from photon_tpu.data.batch import SparseFeatures
     from photon_tpu.estimators.config import (
         FixedEffectDataConfig,
         GLMOptimizationConfiguration,
@@ -288,35 +290,12 @@ def bench_game():
     )
     from photon_tpu.estimators.game_estimator import GameEstimator
     from photon_tpu.optim import RegularizationContext, RegularizationType
-    from photon_tpu.io.data_reader import GameDataBundle
     from photon_tpu.types import TaskType
 
-    n_users, rows_per_user, d_global, d_user = 512, 64, 4096, 16
+    n_users, rows_per_user, d_global, d_user = (
+        (64, 16, 256, 8) if SMOKE else (512, 64, 4096, 16))
     n = n_users * rows_per_user
-    rng = np.random.default_rng(2)
-    wg = rng.normal(size=d_global).astype(np.float32) * 0.5
-    dim = d_global + n_users * d_user
-    users = np.repeat(np.arange(n_users), rows_per_user)
-    rng.shuffle(users)
-    k = 12
-    gi = rng.integers(0, d_global, size=(n, k)).astype(np.int32)
-    gv = (rng.normal(size=(n, k)) / np.sqrt(k)).astype(np.float32)
-    ui = (d_global + users[:, None] * d_user
-          + rng.integers(0, d_user, size=(n, 4))).astype(np.int32)
-    uv = (rng.normal(size=(n, 4)) * 0.7).astype(np.float32)
-    idx = np.concatenate([gi, ui], axis=1)
-    val = np.concatenate([gv, uv], axis=1)
-    z = (gv * wg[gi]).sum(1) + uv.sum(1) * 0.3
-    labels = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float64)
-
-    bundle = GameDataBundle(
-        features={"global": SparseFeatures(jnp.asarray(idx), jnp.asarray(val), dim)},
-        labels=labels,
-        offsets=np.zeros(n),
-        weights=np.ones(n),
-        uids=np.arange(n).astype(object),
-        id_tags={"userId": np.array([f"u{u}" for u in users], object)},
-    )
+    bundle = _game_bundle(n_users, rows_per_user, d_global, d_user)
     estimator = GameEstimator(
         task=TaskType.LOGISTIC_REGRESSION,
         coordinate_data_configs={
@@ -348,6 +327,169 @@ def bench_game():
     }
 
 
+def _game_bundle(n_users, rows_per_user, d_global, d_user, n_items=0, seed=2):
+    """Synthetic GAME-shaped bundle: fixed-effect block + per-user (and
+    optionally per-item) feature blocks in one shard.
+
+    Latent weights (global + per-user + per-item) come from a FIXED rng so
+    train/val bundles with different ``seed`` share the same ground truth —
+    the RE coordinates have real per-entity structure to fit and validation
+    AUC reflects genuine lift, not noise."""
+    import jax.numpy as jnp
+
+    from photon_tpu.data.batch import SparseFeatures
+    from photon_tpu.io.data_reader import GameDataBundle
+
+    wrng = np.random.default_rng(1234)
+    wg = wrng.normal(size=d_global).astype(np.float32) * 0.5
+    wu = wrng.normal(size=(n_users, d_user)).astype(np.float32) * 0.8
+    wi = (wrng.normal(size=(n_items, d_user)).astype(np.float32) * 0.6
+          if n_items else None)
+
+    rng = np.random.default_rng(seed)
+    n = n_users * rows_per_user
+    dim = d_global + n_users * d_user + n_items * d_user
+    users = np.repeat(np.arange(n_users), rows_per_user)
+    rng.shuffle(users)
+    k = 12
+    gi = rng.integers(0, d_global, size=(n, k)).astype(np.int32)
+    gv = (rng.normal(size=(n, k)) / np.sqrt(k)).astype(np.float32)
+    ul = rng.integers(0, d_user, size=(n, 4))
+    ui = (d_global + users[:, None] * d_user + ul).astype(np.int32)
+    uv = (rng.normal(size=(n, 4)) / 2.0).astype(np.float32)
+    parts_i, parts_v = [gi, ui], [gv, uv]
+    tags = {"userId": np.array([f"u{u}" for u in users], object)}
+    z = (gv * wg[gi]).sum(1) + (uv * wu[users[:, None], ul]).sum(1)
+    if n_items:
+        items = rng.integers(0, n_items, size=n)
+        il = rng.integers(0, d_user, size=(n, 3))
+        ii = (d_global + n_users * d_user + items[:, None] * d_user
+              + il).astype(np.int32)
+        iv = (rng.normal(size=(n, 3)) / 2.0).astype(np.float32)
+        parts_i.append(ii)
+        parts_v.append(iv)
+        tags["itemId"] = np.array([f"i{it}" for it in items], object)
+        z = z + (iv * wi[items[:, None], il]).sum(1)
+    idx = np.concatenate(parts_i, axis=1)
+    val = np.concatenate(parts_v, axis=1)
+    labels = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float64)
+    return GameDataBundle(
+        features={"global": SparseFeatures(jnp.asarray(idx), jnp.asarray(val), dim)},
+        labels=labels,
+        offsets=np.zeros(n),
+        weights=np.ones(n),
+        uids=np.arange(n).astype(object),
+        id_tags=tags,
+    )
+
+
+def bench_game_scale():
+    """Config-3 at MovieLens scale (VERDICT round-3 ask #9): >=100K users,
+    per-coordinate-step time and RE-solve throughput."""
+    from photon_tpu.estimators.config import (
+        FixedEffectDataConfig,
+        GLMOptimizationConfiguration,
+        RandomEffectDataConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.optim import RegularizationContext, RegularizationType
+    from photon_tpu.types import TaskType
+
+    n_users, rows_per_user = (2_000, 8) if SMOKE else (100_000, 16)
+    bundle = _game_bundle(n_users, rows_per_user,
+                          d_global=1 << 10 if SMOKE else 1 << 14, d_user=8)
+    estimator = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={
+            "fixed": FixedEffectDataConfig("global"),
+            "perUser": RandomEffectDataConfig(re_type="userId",
+                                              feature_shard="global"),
+        },
+        n_sweeps=1,
+    )
+    gcfg = {
+        "fixed": GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=15),
+        "perUser": GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=15),
+    }
+    # Warm-up fit so the timed run reports steady-state step times, not XLA
+    # compile (same discipline as bench_game); the cold-start delta is
+    # reported separately.
+    t0 = time.perf_counter()
+    r = estimator.fit(bundle, None, [gcfg])
+    np.asarray(r[0].model["fixed"].model.coefficients.means)
+    cold = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    r = estimator.fit(bundle, None, [gcfg])
+    np.asarray(r[0].model["fixed"].model.coefficients.means)
+    total = time.perf_counter() - t0
+    steps = {rec.coordinate_id: rec.seconds for rec in r[0].tracker}
+    re_secs = steps.get("perUser", float("nan"))
+    return {
+        "game_scale_users": n_users,
+        "game_scale_rows": n_users * rows_per_user,
+        "game_scale_total_seconds": round(total, 2),
+        "game_scale_cold_fit_seconds": round(cold, 2),
+        "game_scale_fixed_step_seconds": round(steps.get("fixed", float("nan")), 3),
+        "game_scale_re_step_seconds": round(re_secs, 3),
+        "game_scale_re_entities_per_sec": round(n_users / re_secs, 1),
+        "game_scale_samples_per_sec": round(n_users * rows_per_user / total, 1),
+    }
+
+
+def bench_tuner():
+    """Config-4 shape: per-user + per-item CTR with the GP tuner in the loop
+    (BASELINE config 4); reports seconds per tuning trial."""
+    from photon_tpu.estimators.config import (
+        FixedEffectDataConfig,
+        GLMOptimizationConfiguration,
+        RandomEffectDataConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.hyperparameter.tuner import tune_regularization
+    from photon_tpu.optim import RegularizationContext, RegularizationType
+    from photon_tpu.types import TaskType
+
+    nu, dg, ni = (200, 512, 50) if SMOKE else (2000, 4096, 500)
+    train = _game_bundle(nu, 16, d_global=dg, d_user=8, n_items=ni, seed=5)
+    val = _game_bundle(nu, 4, d_global=dg, d_user=8, n_items=ni, seed=6)
+    estimator = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={
+            "fixed": FixedEffectDataConfig("global"),
+            "perUser": RandomEffectDataConfig(re_type="userId",
+                                              feature_shard="global"),
+            "perItem": RandomEffectDataConfig(re_type="itemId",
+                                              feature_shard="global"),
+        },
+        n_sweeps=1,
+        evaluator_specs=("AUC",),
+    )
+    l2 = RegularizationContext(RegularizationType.L2)
+    base = {
+        cid: GLMOptimizationConfiguration(
+            regularization=l2, reg_weight=1.0, max_iterations=10)
+        for cid in ("fixed", "perUser", "perItem")
+    }
+    n_trials = 2 if SMOKE else 5
+    t0 = time.perf_counter()
+    result = tune_regularization(
+        estimator, train, val, base,
+        reg_ranges={"fixed": (0.01, 100.0), "perUser": (0.01, 100.0),
+                    "perItem": (0.01, 100.0)},
+        n_iterations=n_trials, strategy="gp",
+    )
+    dt = time.perf_counter() - t0
+    return {
+        "tuner_trials": n_trials,
+        "tuner_seconds_per_trial": round(dt / n_trials, 2),
+        "tuner_best_auc": round(float(-result.search.best_value), 4),
+    }
+
+
 def bench_ingest():
     """Streaming Avro ingest throughput (io/streaming.py + native decoder).
 
@@ -370,7 +512,7 @@ def bench_ingest():
     if native.get_lib() is None:
         return {"ingest_rows_per_sec": None}
 
-    n, d, k = 200_000, 100_000, 12
+    n, d, k = (20_000, 10_000, 12) if SMOKE else (200_000, 100_000, 12)
     path = os.path.join(
         tempfile.gettempdir(), f"photon_bench_ingest_{n}_{d}_{k}.avro"
     )
@@ -426,7 +568,7 @@ def bench_ingest():
 
 
 def main():
-    details = {}
+    details = {"smoke_mode": True} if SMOKE else {}
     head, (idx, val, labels) = bench_fixed_effect_lbfgs()
     details["fixed_effect_lbfgs"] = {
         k: (round(v, 3) if isinstance(v, float) else v) for k, v in head.items()
@@ -454,10 +596,15 @@ def main():
 
     details.update(bench_owlqn_tron())
     details.update(bench_game())
+    details.update(bench_game_scale())
+    details.update(bench_tuner())
     details.update(bench_ingest())
 
+    # Smoke runs exercise the code path only — never overwrite the real
+    # TPU-measured details artifact with toy-shape numbers.
+    details_name = "BENCH_DETAILS.smoke.json" if SMOKE else "BENCH_DETAILS.json"
     with open(os.path.join(os.path.dirname(__file__) or ".",
-                           "BENCH_DETAILS.json"), "w") as f:
+                           details_name), "w") as f:
         json.dump(details, f, indent=2)
 
     print(json.dumps({

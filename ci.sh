@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# CI entry point: one command runs everything green (SURVEY.md §2.4; the
+# reference's Gradle `check` + Travis matrix collapse to this script).
+#
+#   ./ci.sh          # full test suite + multichip dryrun + bench smoke
+#   ./ci.sh fast     # test suite only
+#
+# Everything runs on a virtual 8-device CPU mesh so CI needs no TPU; the
+# driver separately compile-checks the entry points and runs bench.py on
+# real hardware.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+
+echo "== pytest (full suite, 8-device virtual CPU mesh) =="
+python -m pytest tests/ -x -q
+
+if [[ "${1:-}" == "fast" ]]; then
+  exit 0
+fi
+
+echo "== multichip dryrun (8-device mesh: dp, dp x mp, RE, dcn x dp) =="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
+
+echo "== entry compile check =="
+python -c "
+import jax
+import __graft_entry__ as g
+fn, args = g.entry()
+out = jax.jit(fn)(*args)
+jax.block_until_ready(out)
+print('entry ok')
+"
+
+echo "== bench smoke (tiny shapes; no perf claims) =="
+PHOTON_BENCH_SMOKE=1 python bench.py
+
+echo "CI GREEN"

@@ -106,6 +106,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--weight-column", default="weight")
     p.add_argument("--response-column", default="response")
     p.add_argument("--uid-column", default="uid")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace of the run to this "
+                        "directory (viewable in TensorBoard / Perfetto; "
+                        "reference parity: Timed/PhotonLogger sections -> "
+                        "on-device profiler, SURVEY.md §5.1)")
+    p.add_argument("--debug-nans", action="store_true",
+                   help="enable jax_debug_nans: any NaN produced on device "
+                        "raises at the op that made it instead of "
+                        "propagating (SURVEY.md §5.2 numeric guards; slows "
+                        "training — debugging aid only)")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"],
                    help="training precision. float64 enables jax x64 and "
                         "matches the reference's double-precision (Breeze) "
@@ -178,8 +188,29 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         import jax
 
         jax.config.update("jax_enable_x64", True)
+    if args.debug_nans:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
     task = TaskType[args.task]
     os.makedirs(args.output_dir, exist_ok=True)
+    profiling = False
+    if args.profile_dir:
+        import jax.profiler
+
+        os.makedirs(args.profile_dir, exist_ok=True)
+        jax.profiler.start_trace(args.profile_dir)
+        profiling = True
+    try:
+        return _run_inner(args, task)
+    finally:
+        if profiling:
+            import jax.profiler
+
+            jax.profiler.stop_trace()
+
+
+def _run_inner(args, task) -> dict:
     with PhotonLogger(args.output_dir) as logger:
         specs = parse_coordinates(args.coordinate)
         data_configs, configs = configs_from_specs(specs)

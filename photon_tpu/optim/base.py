@@ -66,7 +66,9 @@ class OptimizerResult:
     """Terminal state + per-iteration history (the states tracker).
 
     ``values[i]`` / ``grad_norms[i]`` are valid for i < iterations; beyond that
-    they hold padding. ``converged_reason`` is a code from this module.
+    they hold ``inf`` padding (inf, not NaN, so ``--debug-nans`` /
+    ``jax_debug_nans`` stays usable on healthy runs). ``converged_reason`` is
+    a code from this module.
 
     ``data_passes`` is an *instrumented* on-device counter of full-data
     touches (one pass = one matvec OR one rmatvec over all N·K feature

@@ -242,8 +242,8 @@ class LBFGS(Optimizer):
         norm = lambda v: jnp.sqrt(dot(v, v))
 
         gnorm0 = norm(g0)
-        values = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(f0)
-        gnorms = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(gnorm0)
+        values = jnp.full((max_it + 1,), jnp.inf, dtype).at[0].set(f0)
+        gnorms = jnp.full((max_it + 1,), jnp.inf, dtype).at[0].set(gnorm0)
 
         init = _LoopState(
             x=x0, f=f0, g=g0, extra=extra0,

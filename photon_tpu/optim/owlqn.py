@@ -103,8 +103,8 @@ class OWLQN(Optimizer):
         f0 = total(x0, f0s)
         pg0 = pseudo_gradient(x0, g0, l1)
         gnorm0 = norm(pg0)
-        values = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(f0)
-        gnorms = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(gnorm0)
+        values = jnp.full((max_it + 1,), jnp.inf, dtype).at[0].set(f0)
+        gnorms = jnp.full((max_it + 1,), jnp.inf, dtype).at[0].set(gnorm0)
 
         init = _LoopState(
             x=x0, f=f0, g=g0,

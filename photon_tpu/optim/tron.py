@@ -147,8 +147,8 @@ class TRON(Optimizer):
 
         f0, g0 = value_and_grad(x0)
         gnorm0 = l2_norm(g0)
-        values = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(f0)
-        gnorms = jnp.full((max_it + 1,), jnp.nan, dtype).at[0].set(gnorm0)
+        values = jnp.full((max_it + 1,), jnp.inf, dtype).at[0].set(f0)
+        gnorms = jnp.full((max_it + 1,), jnp.inf, dtype).at[0].set(gnorm0)
 
         init = _LoopState(
             x=x0, f=f0, g=g0, delta=gnorm0,

@@ -350,3 +350,32 @@ def test_training_driver_auto_tuning(game_data, tmp_path):
     assert s["n_configs"] == 1
     assert s["evaluation"]["AUC"] > 0.6
     assert 0.001 <= s["best_config"]["fixed"]["reg_weight"] <= 100
+
+
+def test_training_driver_profile_and_debug_nans(game_data, tmp_path):
+    """--profile-dir writes a jax.profiler trace (SURVEY.md §5.1) and
+    --debug-nans turns on the NaN guard (§5.2) without disturbing results."""
+    import glob
+
+    d, _, _ = game_data
+    out = tmp_path / "prof_out"
+    prof = tmp_path / "trace"
+    s = game_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate", "fixed:type=fixed,shard=global,reg=L2,max_iter=15,reg_weights=1",
+        "--devices", "1",
+        "--profile-dir", str(prof),
+        "--debug-nans",
+    ])
+    try:
+        assert s["n_configs"] == 1
+        # the profiler writes plugins/profile/<ts>/*.trace.json.gz (or .xplane.pb)
+        traces = glob.glob(str(prof / "**" / "*.*"), recursive=True)
+        assert traces, f"no profiler trace written under {prof}"
+    finally:
+        import jax
+
+        jax.config.update("jax_debug_nans", False)
