@@ -1,0 +1,332 @@
+"""Legacy single-GLM training driver: the pre-GAME pipeline with diagnostics.
+
+Parity: reference ⟦photon-client/.../Driver.scala⟧ + ⟦.../diagnostics/⟧
+(SURVEY.md §2.3 "Legacy GLM driver"): read training (+validation) Avro →
+optional normalization → train one fixed-effect GLM per regularization
+weight in the grid → validate and select → diagnostics on the selected model
+(bootstrap coefficient CIs, Hosmer–Lemeshow calibration, feature importance)
+→ save model + HTML fit report.
+
+TPU-first: the per-λ fits reuse one jit-compiled solve (shapes/config are
+identical across the grid, only ``reg_weight`` changes → one trace, many
+executions); bootstrap replicates run as a single vmapped batch of solves.
+
+Usage example:
+
+    python -m photon_tpu.cli.glm_training_driver \
+      --train-data data/train --validation-data data/val \
+      --output-dir out --task LOGISTIC_REGRESSION \
+      --regularization L2 --reg-weights 0.01 0.1 1 10 \
+      --bootstrap-replicates 32
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.cli.params import parse_feature_shard
+from photon_tpu.data.normalization import NormalizationType, context_from_statistics
+from photon_tpu.data.statistics import compute_feature_statistics
+from photon_tpu.data.validators import DataValidationType, sanity_check_data
+from photon_tpu.evaluation import EvaluationSuite
+from photon_tpu.functions.problem import (
+    GLMOptimizationProblem,
+    VarianceComputationType,
+)
+from photon_tpu.index.index_map import MmapIndexMap, build_mmap_index
+from photon_tpu.io.data_reader import (
+    AvroDataReader,
+    FeatureShardConfig,
+    InputColumnNames,
+    build_index_from_avro,
+)
+from photon_tpu.io.model_io import save_game_model
+from photon_tpu.optim import (
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.types import TaskType
+from photon_tpu.utils import PhotonLogger, Timed
+
+SHARD = "global"
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="glm-training-driver",
+        description="Train a single fixed-effect GLM with diagnostics "
+                    "(the reference's legacy pre-GAME Driver).",
+    )
+    p.add_argument("--train-data", nargs="+", required=True)
+    p.add_argument("--validation-data", nargs="+", default=None)
+    p.add_argument("--output-dir", required=True)
+    p.add_argument("--task", required=True, choices=[t.name for t in TaskType])
+    p.add_argument("--feature-shard", default="global:features",
+                   metavar="SHARD[:BAG+BAG][:no-intercept]",
+                   help="single feature-shard spec (shard name must be "
+                        f"'{SHARD}')")
+    p.add_argument("--optimizer", default="LBFGS",
+                   choices=[o.name for o in OptimizerType])
+    p.add_argument("--regularization", default="L2",
+                   choices=[r.name for r in RegularizationType])
+    p.add_argument("--elastic-net-alpha", type=float, default=0.5)
+    p.add_argument("--reg-weights", nargs="+", type=float, default=[1.0],
+                   help="regularization-weight grid (reference's λ list)")
+    p.add_argument("--max-iterations", type=int, default=80)
+    p.add_argument("--tolerance", type=float, default=1e-7)
+    p.add_argument("--normalization", default="NONE",
+                   choices=[n.name for n in NormalizationType])
+    p.add_argument("--data-validation", default="VALIDATE_FULL",
+                   choices=[v.name for v in DataValidationType])
+    p.add_argument("--evaluators", nargs="+", default=None,
+                   help="evaluator specs; first is primary; defaults per task")
+    p.add_argument("--variance", default="SIMPLE",
+                   choices=[v.name for v in VarianceComputationType],
+                   help="coefficient variances saved with the model")
+    p.add_argument("--index-dir", default=None)
+    # Diagnostics (reference ⟦.../diagnostics/⟧):
+    p.add_argument("--bootstrap-replicates", type=int, default=0,
+                   help="0 disables bootstrap CIs")
+    p.add_argument("--bootstrap-confidence", type=float, default=0.95)
+    p.add_argument("--hl-bins", type=int, default=10,
+                   help="Hosmer-Lemeshow bins (logistic task only)")
+    p.add_argument("--no-report", action="store_true",
+                   help="skip the HTML fit report")
+    p.add_argument("--offset-column", default="offset")
+    p.add_argument("--weight-column", default="weight")
+    p.add_argument("--response-column", default="response")
+    p.add_argument("--uid-column", default="uid")
+    p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    return p
+
+
+def _default_evaluators(task: TaskType) -> tuple[str, ...]:
+    return {
+        TaskType.LOGISTIC_REGRESSION: ("AUC", "LOGISTIC_LOSS"),
+        TaskType.LINEAR_REGRESSION: ("RMSE",),
+        TaskType.POISSON_REGRESSION: ("POISSON_LOSS",),
+        TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: ("AUC",),
+    }[task]
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    args = build_arg_parser().parse_args(argv)
+    if args.dtype == "float64":
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    task = TaskType[args.task]
+    os.makedirs(args.output_dir, exist_ok=True)
+    with PhotonLogger(args.output_dir) as logger:
+        shard_spec = parse_feature_shard(args.feature_shard)
+        if shard_spec.shard != SHARD:
+            raise ValueError(
+                f"the single-GLM driver uses one shard named '{SHARD}', got "
+                f"{shard_spec.shard!r}"
+            )
+        shard_cfg = FeatureShardConfig(
+            feature_bags=shard_spec.feature_bags,
+            add_intercept=shard_spec.add_intercept,
+        )
+        if args.index_dir:
+            imap = MmapIndexMap(os.path.join(args.index_dir, SHARD))
+        else:
+            imap = build_index_from_avro(
+                args.train_data,
+                feature_bags=shard_cfg.feature_bags,
+                add_intercept=shard_cfg.add_intercept,
+            )
+        logger.info("index: %d features", len(imap))
+
+        reader = AvroDataReader(
+            {SHARD: imap},
+            {SHARD: shard_cfg},
+            columns=InputColumnNames(
+                uid=args.uid_column,
+                response=args.response_column,
+                offset=args.offset_column,
+                weight=args.weight_column,
+            ),
+        )
+        read_dtype = np.float64 if args.dtype == "float64" else np.float32
+        with Timed("read training data", logger):
+            train = reader.read(args.train_data, dtype=read_dtype)
+        batch = train.batch(SHARD)
+        sanity_check_data(batch, task, DataValidationType[args.data_validation])
+        val_batch = None
+        if args.validation_data:
+            with Timed("read validation data", logger):
+                val_batch = reader.read(
+                    args.validation_data, dtype=read_dtype
+                ).batch(SHARD)
+
+        import jax.numpy as jnp
+
+        # One stats pass serves both the normalization context and the
+        # feature-importance diagnostic.
+        stats = compute_feature_statistics(batch)
+        norm = None
+        if NormalizationType[args.normalization] != NormalizationType.NONE:
+            norm = context_from_statistics(
+                stats, NormalizationType[args.normalization],
+                imap.intercept_index,
+            )
+
+        suite = EvaluationSuite.parse(
+            list(args.evaluators or _default_evaluators(task))
+        )
+        reg = RegularizationContext(
+            RegularizationType[args.regularization],
+            elastic_net_alpha=args.elastic_net_alpha,
+        )
+        opt_type = OptimizerType[args.optimizer]
+        d = batch.features.dim
+        w0 = jnp.zeros((d,), batch.labels.dtype)
+
+        def make_problem(lam: float, variance: VarianceComputationType):
+            return GLMOptimizationProblem(
+                task=task,
+                optimizer_type=opt_type,
+                optimizer_config=OptimizerConfig(
+                    max_iterations=args.max_iterations,
+                    tolerance=args.tolerance,
+                ),
+                regularization=reg,
+                reg_weight=lam,
+                variance_type=variance,
+            )
+
+        eval_batch = val_batch if val_batch is not None else batch
+        sweep, best_i = [], 0
+        models = []
+        # Sweep with variances OFF (reg_weight is a dynamic jit argument, so
+        # the whole grid shares one compiled solve); the winner's variances
+        # are computed once afterwards via a warm-started refit.
+        with Timed("regularization sweep", logger):
+            for i, lam in enumerate(args.reg_weights):
+                model, result = make_problem(
+                    lam, VarianceComputationType.NONE
+                ).fit(batch, w0, normalization=norm)
+                scores = model.compute_score(
+                    eval_batch.features, eval_batch.offsets
+                )
+                ev = suite.evaluate(scores, eval_batch.labels, eval_batch.weights)
+                sweep.append({
+                    "reg_weight": lam,
+                    "iterations": int(result.iterations),
+                    "objective": float(result.value),
+                    **{k: float(v) for k, v in ev.values.items()},
+                })
+                models.append(model)
+                if suite.primary.better_than(
+                    ev.primary, sweep[best_i][suite.primary.name]
+                ) and i > 0:
+                    best_i = i
+                logger.info("λ=%g: %s", lam, sweep[-1])
+        best = models[best_i]
+        best_lam = args.reg_weights[best_i]
+        logger.info("selected λ=%g (%s)", best_lam, suite.primary.name)
+        variance_type = VarianceComputationType[args.variance]
+        if variance_type != VarianceComputationType.NONE:
+            with Timed("selected-model variances", logger):
+                best, _ = make_problem(best_lam, variance_type).fit(
+                    batch, best.coefficients.means, normalization=norm
+                )
+
+        # ---- diagnostics on the selected model (reference ⟦diagnostics/⟧)
+        from photon_tpu.diagnostics import (
+            bootstrap_coefficients,
+            feature_importance,
+            hosmer_lemeshow,
+            write_fit_report,
+        )
+
+        boot = None
+        if args.bootstrap_replicates > 0:
+            with Timed("bootstrap CIs", logger):
+                boot = bootstrap_coefficients(
+                    make_problem(best_lam, VarianceComputationType.NONE),
+                    batch, w0,
+                    n_replicates=args.bootstrap_replicates,
+                    confidence=args.bootstrap_confidence,
+                    normalization=norm,
+                )
+        hl = None
+        if task == TaskType.LOGISTIC_REGRESSION and args.hl_bins > 1:
+            scores = best.compute_score(eval_batch.features, eval_batch.offsets)
+            hl = hosmer_lemeshow(scores, eval_batch.labels, n_bins=args.hl_bins,
+                                 weights=eval_batch.weights)
+            logger.info("Hosmer-Lemeshow: stat=%.3f df=%d p=%.4f",
+                        hl.statistic, hl.df, hl.p_value)
+        imp = feature_importance(np.asarray(best.coefficients.means), stats)
+
+        with Timed("save model", logger):
+            from photon_tpu.game.descent import GameModel
+            from photon_tpu.game.coordinates import FixedEffectModel
+
+            gm = GameModel(models={
+                "fixed": FixedEffectModel(model=best, feature_shard=SHARD)
+            })
+            save_game_model(
+                os.path.join(args.output_dir, "best"), gm,
+                {SHARD: imap}, {"fixed": SHARD}, {SHARD: shard_cfg},
+            )
+            idir = os.path.join(args.output_dir, "index", SHARD)
+            if isinstance(imap, MmapIndexMap):
+                if not os.path.exists(idir):
+                    import shutil
+
+                    shutil.copytree(imap._dir, idir)
+            else:
+                build_mmap_index(imap, idir)
+
+        report_path = None
+        if not args.no_report:
+            names = [imap.get_feature(j) for j in range(len(imap))]
+            report_path = write_fit_report(
+                args.output_dir,
+                task=task.name,
+                feature_names=[f"{n}:{t}" if t else n for n, t in names],
+                coefficients=np.asarray(best.coefficients.means),
+                config_summary={
+                    "optimizer": opt_type.name,
+                    "regularization": reg.reg_type.name,
+                    "selected_reg_weight": best_lam,
+                    "normalization": args.normalization,
+                    "dtype": args.dtype,
+                    "n_rows": train.n_rows,
+                    "n_features": d,
+                },
+                sweep_metrics=sweep,
+                bootstrap=boot,
+                hosmer_lemeshow=hl,
+                importance=imp,
+            )
+            logger.info("fit report: %s", report_path)
+
+        summary = {
+            "task": task.name,
+            "selected_reg_weight": best_lam,
+            "sweep": sweep,
+            "evaluation": sweep[best_i],
+            "hosmer_lemeshow_p": None if hl is None else hl.p_value,
+            "report": report_path,
+            "model_dir": os.path.join(args.output_dir, "best"),
+        }
+        with open(os.path.join(args.output_dir, "training-summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        return summary
+
+
+def main() -> None:  # pragma: no cover - console entry
+    run()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

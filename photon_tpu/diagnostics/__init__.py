@@ -1,0 +1,33 @@
+"""Model diagnostics for the legacy single-GLM pipeline.
+
+Parity: reference ⟦photon-client/.../diagnostics/⟧ (SURVEY.md §2.3 "Legacy
+GLM driver": bootstrap confidence intervals, Hosmer–Lemeshow calibration,
+feature importance, HTML fit report).
+
+TPU-first: the bootstrap refits all B replicates in ONE vmapped solve (the
+reference trains replicate models sequentially as Spark jobs); Hosmer–
+Lemeshow bins and the chi-square statistic are computed on device.
+"""
+from photon_tpu.diagnostics.bootstrap import (
+    BootstrapResult,
+    bootstrap_coefficients,
+)
+from photon_tpu.diagnostics.hosmer_lemeshow import (
+    HosmerLemeshowResult,
+    hosmer_lemeshow,
+)
+from photon_tpu.diagnostics.importance import (
+    FeatureImportance,
+    feature_importance,
+)
+from photon_tpu.diagnostics.report import write_fit_report
+
+__all__ = [
+    "BootstrapResult",
+    "bootstrap_coefficients",
+    "HosmerLemeshowResult",
+    "hosmer_lemeshow",
+    "FeatureImportance",
+    "feature_importance",
+    "write_fit_report",
+]

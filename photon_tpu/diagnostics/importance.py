@@ -1,0 +1,42 @@
+"""Feature importance for fitted GLMs.
+
+Parity: reference ⟦photon-client/.../diagnostics/featureimportance/⟧ — the
+legacy Driver ranks features by expected |impact| on the linear score and
+reports the top of the list in its fit report.
+
+Importance of feature j is |w_j| · std_j (coefficient magnitude scaled by the
+feature's spread in the training data), the standardized-coefficient measure
+the reference's importance diagnostic approximates; features the model never
+saw (std 0) rank by |w_j| · |mean_j| so constant-but-used columns (e.g. the
+intercept) still appear.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from photon_tpu.data.statistics import FeatureDataStatistics
+
+
+@dataclasses.dataclass(frozen=True)
+class FeatureImportance:
+    """Ranked importance. All arrays are [D], sorted descending."""
+
+    order: np.ndarray        # int indices into the coefficient vector
+    importance: np.ndarray   # importance score, aligned with ``order``
+
+    def top(self, k: int) -> list[tuple[int, float]]:
+        k = min(k, len(self.order))
+        return [(int(self.order[i]), float(self.importance[i])) for i in range(k)]
+
+
+def feature_importance(
+    coefficients: np.ndarray, stats: FeatureDataStatistics
+) -> FeatureImportance:
+    w = np.asarray(coefficients, np.float64)
+    std = np.asarray(stats.std(), np.float64)
+    mean = np.asarray(stats.mean, np.float64)
+    score = np.abs(w) * np.where(std > 0, std, np.abs(mean))
+    order = np.argsort(-score, kind="stable")
+    return FeatureImportance(order=order, importance=score[order])

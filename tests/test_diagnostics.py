@@ -1,0 +1,177 @@
+"""Diagnostics: bootstrap CIs, Hosmer-Lemeshow, importance, fit report, and
+the legacy single-GLM driver end-to-end (SURVEY.md §2.3 legacy Driver +
+diagnostics package)."""
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu.data.batch import make_dense_batch
+from photon_tpu.data.statistics import compute_feature_statistics
+from photon_tpu.diagnostics import (
+    bootstrap_coefficients,
+    feature_importance,
+    hosmer_lemeshow,
+    write_fit_report,
+)
+from photon_tpu.functions.problem import GLMOptimizationProblem
+from photon_tpu.optim import (
+    OptimizerConfig,
+    OptimizerType,
+    RegularizationContext,
+    RegularizationType,
+)
+from photon_tpu.types import TaskType
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(11)
+
+
+def _linear_problem(lam=1.0, max_iter=60):
+    return GLMOptimizationProblem(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer_type=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=max_iter),
+        regularization=RegularizationContext(RegularizationType.L2),
+        reg_weight=lam,
+    )
+
+
+def test_bootstrap_ci_covers_truth_and_scales(rng):
+    """CIs from vmapped replicate fits cover the generating coefficients and
+    tighten with more data (the defining bootstrap property)."""
+    d = 4
+    w_true = np.array([1.5, -2.0, 0.7, 0.0])
+
+    def make(n):
+        x = rng.normal(size=(n, d))
+        y = x @ w_true + 0.3 * rng.normal(size=n)
+        return make_dense_batch(x, y, dtype=jnp.float32)
+
+    res_small = bootstrap_coefficients(
+        _linear_problem(lam=1e-3), make(150), jnp.zeros(d, jnp.float32),
+        n_replicates=48, seed=1,
+    )
+    res_big = bootstrap_coefficients(
+        _linear_problem(lam=1e-3), make(3000), jnp.zeros(d, jnp.float32),
+        n_replicates=48, seed=2,
+    )
+    assert res_small.samples.shape == (48, d)
+    assert res_small.converged.all()
+    # truth inside the 95% band (generous: exact coverage is statistical)
+    assert np.all(res_big.lower - 0.05 <= w_true)
+    assert np.all(w_true <= res_big.upper + 0.05)
+    # 20x data → clearly tighter intervals
+    assert np.mean(res_big.upper - res_big.lower) < 0.5 * np.mean(
+        res_small.upper - res_small.lower
+    )
+
+
+def test_bootstrap_matches_sequential_reference(rng):
+    """The vmapped path equals fitting each resample separately."""
+    d, n = 3, 80
+    x = rng.normal(size=(n, d))
+    y = x @ np.array([1.0, -1.0, 0.5]) + 0.2 * rng.normal(size=n)
+    batch = make_dense_batch(x, y, dtype=jnp.float32)
+    prob = _linear_problem()
+    res = bootstrap_coefficients(
+        prob, batch, jnp.zeros(d, jnp.float32), n_replicates=3, seed=7
+    )
+    counts = np.random.default_rng(7).multinomial(
+        n, np.full(n, 1.0 / n), size=3
+    )
+    for b in range(3):
+        rep = make_dense_batch(x, y, dtype=jnp.float32)
+        import dataclasses
+
+        rep = dataclasses.replace(
+            rep, weights=jnp.asarray(counts[b], jnp.float32)
+        )
+        model, _ = prob.run(rep, jnp.zeros(d, jnp.float32))
+        np.testing.assert_allclose(
+            res.samples[b], np.asarray(model.coefficients.means),
+            rtol=0, atol=2e-5,
+        )
+
+
+def test_hosmer_lemeshow_calibrated_vs_miscalibrated(rng):
+    """A well-specified logistic model passes (large p); a squashed one
+    fails (tiny p). Statistic cross-checked against a NumPy reference."""
+    n = 20000
+    z = rng.normal(size=n) * 2.0
+    y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float64)
+    good = hosmer_lemeshow(jnp.asarray(z), jnp.asarray(y))
+    bad = hosmer_lemeshow(jnp.asarray(0.3 * z), jnp.asarray(y))
+    assert good.p_value > 0.01
+    assert bad.p_value < 1e-6
+    assert bad.statistic > good.statistic
+    assert good.df == 8
+
+    # NumPy reference for the statistic on the same deciles.
+    p = 1 / (1 + np.exp(-z))
+    edges = np.quantile(p, np.linspace(0, 1, 11)[1:-1])
+    g = np.searchsorted(edges, p, side="right")
+    stat = 0.0
+    for k in range(10):
+        m = g == k
+        ng, og, eg = m.sum(), y[m].sum(), p[m].sum()
+        stat += (og - eg) ** 2 / (eg * (1 - eg / ng))
+    assert good.statistic == pytest.approx(stat, rel=2e-3)
+
+
+def test_feature_importance_ranking(rng):
+    n, d = 500, 5
+    x = rng.normal(size=(n, d)) * np.array([1.0, 10.0, 0.1, 1.0, 1.0])
+    y = x[:, 0] + rng.normal(size=n)
+    batch = make_dense_batch(x, y, dtype=jnp.float32)
+    stats = compute_feature_statistics(batch)
+    w = np.array([1.0, 1.0, 1.0, 0.0, 0.01])
+    imp = feature_importance(w, stats)
+    # w * std ranks the wide feature first, zero-coef feature last
+    assert imp.order[0] == 1
+    assert imp.order[-1] == 3
+    assert imp.importance[0] >= imp.importance[-1]
+    top = imp.top(2)
+    assert top[0][0] == 1 and len(top) == 2
+
+
+def test_fit_report_renders(tmp_path, rng):
+    n, d = 400, 3
+    x = rng.normal(size=(n, d))
+    z = x @ np.array([1.0, -0.5, 0.0])
+    y = (rng.random(n) < 1 / (1 + np.exp(-z))).astype(np.float64)
+    batch = make_dense_batch(x, y.astype(np.float32), dtype=jnp.float32)
+    prob = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_type=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=40),
+        regularization=RegularizationContext(RegularizationType.L2),
+        reg_weight=0.1,
+    )
+    model, _ = prob.run(batch, jnp.zeros(d, jnp.float32))
+    w = np.asarray(model.coefficients.means)
+    boot = bootstrap_coefficients(prob, batch, jnp.zeros(d, jnp.float32),
+                                  n_replicates=8)
+    scores = model.compute_score(batch.features, batch.offsets)
+    hl = hosmer_lemeshow(scores, batch.labels, n_bins=8)
+    imp = feature_importance(w, compute_feature_statistics(batch))
+    path = write_fit_report(
+        str(tmp_path),
+        task="LOGISTIC_REGRESSION",
+        feature_names=[f"f{j}" for j in range(d)],
+        coefficients=w,
+        config_summary={"optimizer": "LBFGS", "reg_weight": 0.1},
+        sweep_metrics=[{"reg_weight": 0.1, "AUC": 0.8}],
+        bootstrap=boot,
+        hosmer_lemeshow=hl,
+        importance=imp,
+    )
+    text = open(path).read()
+    assert "Hosmer" in text and "f0" in text and "CI low" in text
+    machine = json.load(open(os.path.join(tmp_path, "fit-report.json")))
+    assert machine["hosmer_lemeshow"]["df"] == hl.df
+    assert machine["n_bootstrap_replicates"] == 8

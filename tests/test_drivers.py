@@ -379,3 +379,42 @@ def test_training_driver_profile_and_debug_nans(game_data, tmp_path):
         import jax
 
         jax.config.update("jax_debug_nans", False)
+
+
+def test_legacy_glm_driver_end_to_end(game_data, tmp_path):
+    """The legacy single-GLM Driver: reg-weight grid + diagnostics + HTML
+    report (SURVEY.md §2.3 legacy Driver; reference ⟦Driver.scala⟧ +
+    ⟦diagnostics/⟧)."""
+    from photon_tpu.cli import glm_training_driver
+
+    d, _, n_val = game_data
+    out = tmp_path / "glm_out"
+    s = glm_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--validation-data", str(d / "val.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--reg-weights", "0.01", "1.0", "100.0",
+        "--max-iterations", "40",
+        "--bootstrap-replicates", "6",
+        "--hl-bins", "5",
+    ])
+    assert len(s["sweep"]) == 3
+    assert s["selected_reg_weight"] in (0.01, 1.0, 100.0)
+    assert s["evaluation"]["AUC"] > 0.55
+    assert 0.0 <= s["hosmer_lemeshow_p"] <= 1.0
+    report = open(s["report"]).read()
+    assert "Hosmer" in report and "Bootstrap: 6" in report
+    assert os.path.exists(out / "best" / "game-metadata.json")
+    # the saved model scores through the standard scoring driver
+    score_out = tmp_path / "glm_scores"
+    ssum = game_scoring_driver.run([
+        "--data", str(d / "val.avro"),
+        "--model-dir", str(out / "best"),
+        "--output-dir", str(score_out),
+        "--evaluators", "AUC",
+    ])
+    assert ssum["n_rows"] == n_val
+    assert ssum["evaluation"]["AUC"] == pytest.approx(
+        s["evaluation"]["AUC"], abs=0.02
+    )

@@ -6,6 +6,7 @@ training path (SURVEY.md §7 stage 3).
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from photon_tpu.data.batch import make_dense_batch
 from photon_tpu.functions.objective import intercept_reg_mask
@@ -130,3 +131,98 @@ def test_smoothed_hinge_trains(rng):
     model, res = prob.run(batch, jnp.zeros(d, jnp.float64))
     acc = float(((x @ np.asarray(model.coefficients.means) > 0) == y).mean())
     assert acc > 0.95
+
+
+def test_full_variance_refuses_wide_models(rng, monkeypatch):
+    """FULL variance on a wide shard fails fast with guidance instead of
+    letting XLA materialize a D x D Hessian (VERDICT round-2 weak #6)."""
+    import photon_tpu.functions.problem as problem_mod
+
+    monkeypatch.setattr(problem_mod, "FULL_VARIANCE_MAX_DIM", 64)
+    n, d = 30, 65
+    x = rng.normal(size=(n, d))
+    y = x[:, 0] + 0.1 * rng.normal(size=n)
+    batch = make_dense_batch(x, y, dtype=jnp.float32)
+    prob = GLMOptimizationProblem(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer_type=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=5),
+        regularization=L2RegularizationContext,
+        reg_weight=1.0,
+        variance_type=VarianceComputationType.FULL,
+    )
+    with pytest.raises(ValueError, match="FULL variance.*SIMPLE"):
+        prob.run(batch, jnp.zeros(d, jnp.float32))
+
+
+def test_reg_weight_sweep_shares_one_executable(rng, monkeypatch):
+    """fit() treats reg_weight as a dynamic argument: a λ grid must not
+    re-trace per point (the legacy driver's sweep relies on this). Traces
+    are counted by wrapping ``run`` — it executes once per trace and never
+    on a jit-cache hit."""
+    import photon_tpu.functions.problem as pm
+
+    n, d = 60, 4
+    x = rng.normal(size=(n, d))
+    y = x @ rng.normal(size=d)
+    batch = make_dense_batch(x, y, dtype=jnp.float32)
+    pm._fit_jitted.clear_cache()
+    base = GLMOptimizationProblem(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer_type=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=30),
+        regularization=L2RegularizationContext,
+        reg_weight=0.0,
+    )
+    import dataclasses as dc
+
+    traces = {"n": 0}
+    orig_run = pm.GLMOptimizationProblem.run
+
+    def counting_run(self, *a, **k):
+        traces["n"] += 1
+        return orig_run(self, *a, **k)
+
+    monkeypatch.setattr(pm.GLMOptimizationProblem, "run", counting_run)
+    values = []
+    for lam in (0.01, 0.1, 1.0, 10.0):
+        model, _ = dc.replace(base, reg_weight=lam).fit(
+            batch, jnp.zeros(d, jnp.float32)
+        )
+        values.append(np.asarray(model.coefficients.means))
+    monkeypatch.setattr(pm.GLMOptimizationProblem, "run", orig_run)
+    assert traces["n"] == 1
+    # λ actually took effect: heavier regularization shrinks the solution
+    norms = [np.linalg.norm(v) for v in values]
+    assert norms[0] > norms[-1] * 1.05
+    # and each grid point matches a fresh direct (uncached) solve
+    direct, _ = jax.jit(dc.replace(base, reg_weight=10.0).run)(
+        batch, jnp.zeros(d, jnp.float32)
+    )
+    np.testing.assert_allclose(
+        values[-1], np.asarray(direct.coefficients.means), atol=1e-6
+    )
+
+
+def test_run_reg_weight_override_keeps_l1_guard(rng):
+    """A concrete reg_weight override participates in the L1-routing guard:
+    enabling L1 through the override on a smooth optimizer must still raise."""
+    n, d = 40, 3
+    x = rng.normal(size=(n, d))
+    y = x @ rng.normal(size=d)
+    batch = make_dense_batch(x, y, dtype=jnp.float32)
+    prob = GLMOptimizationProblem(
+        task=TaskType.LINEAR_REGRESSION,
+        optimizer_type=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=5),
+        regularization=L1RegularizationContext,
+        reg_weight=0.0,
+    )
+    with pytest.raises(ValueError, match="OWLQN"):
+        prob.run(batch, jnp.zeros(d, jnp.float32), reg_weight=1.0)
+    # and a zero override on a nonzero-configured problem is legal
+    import dataclasses as dc
+
+    dc.replace(prob, reg_weight=1.0).run(
+        batch, jnp.zeros(d, jnp.float32), reg_weight=0.0
+    )
