@@ -167,6 +167,32 @@ def measured_hbm_bandwidth() -> float:
 
 # ---------------------------------------------------------------- workloads
 
+def _pallas_kernels_work() -> bool:
+    """True iff the Pallas sparse kernels compile AND execute here."""
+    import jax
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        return False
+    try:
+        import jax.numpy as jnp
+
+        from photon_tpu.ops.pallas_sparse import build_pallas_aux, matvec_pallas
+
+        rng = np.random.default_rng(0)
+        idx = rng.integers(0, 256, size=(128, 4)).astype(np.int32)
+        val = rng.normal(size=(128, 4)).astype(np.float32)
+        aux = build_pallas_aux(idx, val, 256)
+        z = np.asarray(matvec_pallas(aux, jnp.ones(256, jnp.float32)))
+        ref = val.sum(axis=1)
+        return bool(np.allclose(z, ref, atol=1e-4))
+    except Exception as e:  # noqa: BLE001 - any failure means "don't use"
+        import sys
+
+        print(f"pallas probe failed ({type(e).__name__}: {e}); XLA path",
+              file=sys.stderr, flush=True)
+        return False
+
+
 def bench_fixed_effect_lbfgs():
     import jax
     import jax.numpy as jnp
@@ -182,9 +208,12 @@ def bench_fixed_effect_lbfgs():
     from photon_tpu.types import TaskType
 
     idx, val, labels = _make_data(N_ROWS, DIM, K)
-    sf = SparseFeatures(
-        idx=jnp.asarray(idx), val=jnp.asarray(val), dim=DIM
-    ).with_fast_path()
+    sf = SparseFeatures(idx=jnp.asarray(idx), val=jnp.asarray(val), dim=DIM)
+    # Pallas kernels when they actually run on this backend (probed on a toy
+    # op first — an unexpected Mosaic lowering failure must degrade to the
+    # XLA fast path, not kill the bench); XLA fast path otherwise.
+    sf = sf.with_pallas_path() if _pallas_kernels_work() else sf.with_fast_path()
+    use_pallas = sf.pallas is not None   # attach can no-op on oversize data
     batch = LabeledBatch(
         features=sf,
         labels=jnp.asarray(labels),
@@ -222,6 +251,7 @@ def bench_fixed_effect_lbfgs():
         "samples_per_sec": N_ROWS * iters / dt,
         "entries_per_sec": N_ROWS * K * passes / dt,
         "ms_per_iteration": 1e3 * dt / max(iters, 1),
+        "sparse_path": "pallas" if use_pallas else "xla_fast",
     }, (idx, val, labels)
 
 

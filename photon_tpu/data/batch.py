@@ -83,12 +83,20 @@ class SparseFeatures:
     MXU-friendly layouts; when present, matvec/rmatvec take the fast path
     (row-slice gather + one-hot reduce) instead of XLA's slow generic
     gather/scatter lowering. Attach with ``with_fast_path()``.
+
+    ``pallas`` (optional, see ``ops/pallas_sparse.py``) carries the Pallas
+    slot tables; on a TPU backend (f32 data) matvec/rmatvec then run as
+    hand-written kernels — hardware dynamic-gather + fused one-hot MXU
+    reduce, no 128-wide gather blow-up. Attach with ``with_pallas_path()``;
+    off-TPU the XLA paths are used (set ``PHOTON_PALLAS_INTERPRET=1`` to
+    force the kernels through the Pallas interpreter, tests only).
     """
 
     idx: Array
     val: Array
     dim: int = dataclasses.field(metadata=dict(static=True))
     fast: Optional[object] = None
+    pallas: Optional[object] = None
 
     @property
     def n_rows(self) -> int:
@@ -110,15 +118,52 @@ class SparseFeatures:
         )
         return dataclasses.replace(self, fast=aux)
 
+    def with_pallas_path(self) -> "SparseFeatures":
+        """Build the Pallas slot tables (host-side, once) and attach them,
+        plus the XLA fast path as the off-TPU fallback. No-op (XLA fast path
+        only) when the dataset exceeds the single-chunk table sizes."""
+        from photon_tpu.ops.pallas_sparse import (
+            PallasSparseAux,
+            build_pallas_aux,
+        )
+
+        out = self.with_fast_path()
+        if out.pallas is not None or not PallasSparseAux.supports(
+            self.n_rows, self.dim
+        ):
+            return out
+        aux = build_pallas_aux(
+            jax.device_get(self.idx), jax.device_get(self.val), self.dim
+        )
+        return dataclasses.replace(out, pallas=aux)
+
     def without_fast_path(self) -> "SparseFeatures":
-        """Drop the fast layouts (e.g. before row-sharding: the column-sorted
-        table is not partitionable along the row axis)."""
-        if self.fast is None:
+        """Drop the fast/pallas layouts (e.g. before row-sharding: the
+        column-sorted tables are not partitionable along the row axis)."""
+        if self.fast is None and self.pallas is None:
             return self
-        return dataclasses.replace(self, fast=None)
+        return dataclasses.replace(self, fast=None, pallas=None)
+
+    def _pallas_mode(self, dtype) -> Optional[bool]:
+        """None = don't use the kernels; else the ``interpret`` flag."""
+        import os
+
+        if self.pallas is None or jnp.dtype(dtype) != jnp.float32:
+            return None
+        if os.environ.get("PHOTON_PALLAS_INTERPRET") == "1":
+            return True
+        return False if jax.default_backend() in ("tpu", "axon") else None
+
+    def _use_pallas(self, dtype) -> bool:
+        return self._pallas_mode(dtype) is not None
 
     def matvec(self, w: Array) -> Array:
         pass_counter.record("matvec")
+        interp = self._pallas_mode(w.dtype)
+        if interp is not None:
+            from photon_tpu.ops.pallas_sparse import matvec_pallas
+
+            return matvec_pallas(self.pallas, w, interpret=interp)
         if self.fast is not None:
             from photon_tpu.ops.fast_sparse import matvec_fast
 
@@ -130,6 +175,11 @@ class SparseFeatures:
 
     def rmatvec(self, v: Array) -> Array:
         pass_counter.record("rmatvec")
+        interp = self._pallas_mode(v.dtype)
+        if interp is not None:
+            from photon_tpu.ops.pallas_sparse import rmatvec_pallas
+
+            return rmatvec_pallas(self.pallas, v, interpret=interp)
         if self.fast is not None:
             from photon_tpu.ops.fast_sparse import rmatvec_fast
 
@@ -142,6 +192,12 @@ class SparseFeatures:
 
     def sq_rmatvec(self, v: Array) -> Array:
         pass_counter.record("sq_rmatvec")
+        interp = self._pallas_mode(v.dtype)
+        if interp is not None:
+            from photon_tpu.ops.pallas_sparse import rmatvec_pallas
+
+            return rmatvec_pallas(self.pallas, v, square_vals=True,
+                                  interpret=interp)
         if self.fast is not None:
             from photon_tpu.ops.fast_sparse import rmatvec_fast
 

@@ -210,7 +210,257 @@ def build_random_effect_dataset(
     |correlated| with the label (ties broken by lower column id; the
     intercept always kept on top of ``m``), shrinking per-entity subspaces
     and bucket padding on wide shards.
+
+    Fully vectorized over entities (VERDICT round-2 weak #7): per-entity
+    subspaces come from ONE ``unique`` over (entity, column) pair keys, the
+    local remap is ONE ``searchsorted`` against those keys, Pearson sums are
+    global ``bincount``s, and bucket packing is flat fancy-index writes —
+    no per-entity Python. ``_build_reference_loop`` keeps the original
+    entity-at-a-time implementation as the oracle for the equivalence test.
     """
+    n, k = idx.shape
+    idx = np.asarray(idx)
+    val = np.asarray(val)
+    labels = np.asarray(labels, dtype)
+    weights = np.ones(n, dtype) if weights is None else np.asarray(weights, dtype)
+
+    keys, inv = _sorted_factorize(entity_keys_per_row)
+    counts_all = np.bincount(inv, minlength=len(keys))
+    kept = np.flatnonzero(counts_all >= min_entity_rows)
+    e_count = len(kept)
+    if e_count == 0:
+        return RandomEffectDataset(
+            re_type=re_type, buckets=(), entity_keys=[], entity_to_slot={},
+            n_rows=n, global_dim=global_dim,
+        )
+    new_id = np.full(len(keys), -1, np.int64)
+    new_id[kept] = np.arange(e_count)
+    dense_e = new_id[inv]                       # [n] dense entity id, -1 dropped
+    row_kept = dense_e >= 0
+    counts = counts_all[kept]                   # [E] rows per kept entity
+
+    # ---- per-entity column subspaces: one unique over (entity, col) keys
+    stride = global_dim + 1
+    ee = np.repeat(dense_e, k)                  # entity of each ELL entry
+    flat_idx = idx.ravel().astype(np.int64)
+    entry_ok = (ee >= 0) & (flat_idx < global_dim)
+    pair_parts = [ee[entry_ok] * stride + flat_idx[entry_ok]]
+    if intercept_index is not None:
+        pair_parts.append(np.arange(e_count, dtype=np.int64) * stride + intercept_index)
+    else:
+        # entities with no real entries still need a 1-column subspace ([0])
+        nz_per_ent = np.bincount(ee[entry_ok], minlength=e_count)
+        empty = np.flatnonzero(nz_per_ent == 0)
+        if len(empty):
+            pair_parts.append(empty.astype(np.int64) * stride)
+    upairs, pair_inv = np.unique(np.concatenate(pair_parts),
+                                 return_inverse=True)
+    ent_of_col = upairs // stride
+    entry_pos = pair_inv[: int(entry_ok.sum())]      # pair id of each ok entry
+
+    if max_features_per_entity is not None:
+        chosen = _choose_pairs_by_pearson(
+            upairs, ent_of_col, stride, entry_pos, entry_ok,
+            val.ravel(), labels, dense_e, counts, e_count,
+            max_features_per_entity, intercept_index,
+        )
+        # remap surviving pair ids to their rank in the filtered set
+        new_pos = np.cumsum(chosen, dtype=np.int64) - 1
+        survived = chosen[entry_pos]
+        entry_pos = np.where(survived, new_pos[entry_pos], -1)
+        upairs, ent_of_col = upairs[chosen], ent_of_col[chosen]
+    ncols = np.bincount(ent_of_col, minlength=e_count).astype(np.int64)
+    col_off = np.zeros(e_count + 1, np.int64)
+    np.cumsum(ncols, out=col_off[1:])
+
+    # ---- local remap straight from the unique inverse (no searchsorted)
+    ee_safe = np.maximum(ee, 0)
+    local_flat = ncols[ee_safe].astype(np.int32)     # default: local ghost
+    ok_ix = np.flatnonzero(entry_ok)
+    hit_ok = entry_pos >= 0
+    local_flat[ok_ix[hit_ok]] = (
+        entry_pos[hit_ok] - col_off[ee[ok_ix[hit_ok]]]
+    ).astype(np.int32)
+    local = local_flat.reshape(n, k)
+    hit = np.zeros(n * k, bool)
+    hit[ok_ix[hit_ok]] = True
+    val_eff = np.where(hit.reshape(n, k), val, 0.0).astype(val.dtype)
+
+    # ---- bucket by (pow2 samples, pow2 local dim); dense ids in the same
+    # (bucket-sorted, then ascending-entity) order as the reference loop
+    s_pad_e = _next_pow2_vec(counts)
+    p_pad_e = _next_pow2_vec(ncols)
+    ent_sort = np.lexsort((np.arange(e_count), p_pad_e, s_pad_e))
+    dense_of = np.empty(e_count, np.int64)
+    dense_of[ent_sort] = np.arange(e_count)          # entity -> dense id
+
+    # group boundaries of (s_pad, p_pad) buckets over the sorted entities
+    sp_sorted = np.stack([s_pad_e[ent_sort], p_pad_e[ent_sort]], axis=1)
+    bucket_break = np.any(np.diff(sp_sorted, axis=0) != 0, axis=1)
+    bucket_starts = np.concatenate([[0], np.flatnonzero(bucket_break) + 1, [e_count]])
+
+    # rows re-sorted by dense id (stable keeps original row order per entity)
+    row_dense = np.where(row_kept, dense_of[np.maximum(dense_e, 0)], e_count)
+    row_order = np.argsort(row_dense, kind="stable")[: int(row_kept.sum())]
+    rcounts = counts[ent_sort]                        # rows per dense id
+    rstarts = np.zeros(e_count + 1, np.int64)
+    np.cumsum(rcounts, out=rstarts[1:])
+    within_row = np.arange(len(row_order)) - rstarts[row_dense[row_order]]
+
+    # column entries re-sorted by dense id
+    col_dense = dense_of[ent_of_col]
+    col_order = np.argsort(col_dense, kind="stable")
+    ccounts = ncols[ent_sort]
+    cstarts = np.zeros(e_count + 1, np.int64)
+    np.cumsum(ccounts, out=cstarts[1:])
+    within_col = np.arange(len(col_order)) - cstarts[col_dense[col_order]]
+    cols_flat = upairs % stride
+
+    buckets = []
+    entity_keys_out = list(keys[kept][ent_sort])
+    entity_to_slot = {}
+    for b, (mb, me) in enumerate(zip(bucket_starts[:-1], bucket_starts[1:])):
+        ecount = int(me - mb)
+        s_pad = int(sp_sorted[mb, 0])
+        p_pad = int(sp_sorted[mb, 1])
+        b_idx = np.full((ecount, s_pad, k), p_pad, np.int32)
+        b_val = np.zeros((ecount, s_pad, k), dtype)
+        b_lab = np.zeros((ecount, s_pad), dtype)
+        b_w = np.zeros((ecount, s_pad), dtype)
+        b_tw = np.zeros((ecount, s_pad), dtype)
+        b_rows = np.full((ecount, s_pad), n, np.int32)
+        b_proj = np.full((ecount, p_pad), global_dim, np.int32)
+
+        rsl = slice(rstarts[mb], rstarts[me])
+        rows_b = row_order[rsl]                       # original row ids
+        lane_r = row_dense[rows_b] - mb
+        wr = within_row[rsl]
+        b_idx[lane_r, wr] = local[rows_b]
+        b_val[lane_r, wr] = val_eff[rows_b]
+        b_lab[lane_r, wr] = labels[rows_b]
+        b_w[lane_r, wr] = weights[rows_b]
+        tw = weights[rows_b].copy()
+        if active_bound is not None:
+            tw[wr >= active_bound] = 0.0              # passive rows
+        b_tw[lane_r, wr] = tw
+        b_rows[lane_r, wr] = rows_b
+
+        csl = slice(cstarts[mb], cstarts[me])
+        centries = col_order[csl]
+        b_proj[col_dense[centries] - mb, within_col[csl]] = cols_flat[centries]
+
+        for lane in range(ecount):
+            entity_to_slot[int(mb + lane)] = (b, lane)
+        buckets.append(EntityBucket(
+            idx=jnp.asarray(b_idx), val=jnp.asarray(b_val),
+            labels=jnp.asarray(b_lab), weights=jnp.asarray(b_w),
+            train_weights=jnp.asarray(b_tw), row_ids=jnp.asarray(b_rows),
+            proj=jnp.asarray(b_proj),
+            entity_ids=jnp.asarray(np.arange(mb, me, dtype=np.int32)),
+        ))
+
+    return RandomEffectDataset(
+        re_type=re_type,
+        buckets=tuple(buckets),
+        entity_keys=entity_keys_out,
+        entity_to_slot=entity_to_slot,
+        n_rows=n,
+        global_dim=global_dim,
+    )
+
+
+def _next_pow2_vec(x: np.ndarray) -> np.ndarray:
+    x = np.maximum(np.asarray(x, np.int64), 1)
+    return 1 << np.ceil(np.log2(x)).astype(np.int64)
+
+
+def _sorted_factorize(keys_per_row: np.ndarray):
+    """(sorted unique keys, inverse) — np.unique semantics, hash-based speed.
+
+    np.unique comparison-sorts the raw key column; for millions of object
+    strings that sort IS the old builder's profile hot spot. pandas'
+    hash-based factorize + a sort of the (small) unique set is ~5x faster
+    and produces the identical (sorted-unique, inverse) pair."""
+    try:
+        import pandas as pd
+    except ImportError:  # pragma: no cover - pandas ships in the image
+        return np.unique(keys_per_row, return_inverse=True)
+    codes, uniq = pd.factorize(keys_per_row, sort=True)
+    if (codes < 0).any():
+        # pd.factorize drops NaN/None (code -1); np.unique keeps them as
+        # keys — fall back so missing-key behavior matches.
+        return np.unique(keys_per_row, return_inverse=True)
+    return np.asarray(uniq), codes.astype(np.int64)
+
+
+def _choose_pairs_by_pearson(
+    upairs, ent_of_col, stride, entry_pos, entry_ok, flat_val,
+    labels, dense_e, counts, e_count, max_features, intercept_index,
+):
+    """Vectorized Pearson top-m per entity over the (entity, col) pair keys;
+    returns the keep mask over ``upairs``.
+
+    Matches ``pearson_scores`` semantics (absent entries are zeros) with
+    global bincounts instead of per-entity passes; entities at or under the
+    cap keep their full subspace, ties break toward lower column ids, and
+    the intercept is force-kept on top of ``m``.
+    """
+    pos = entry_pos
+    v_raw = flat_val[entry_ok]                       # source dtype, like
+    v = np.asarray(v_raw, np.float64)                # pearson_scores' v
+    y_row = np.asarray(labels, np.float64)
+    k = len(entry_ok) // dense_e.shape[0]
+    y_ent = np.repeat(y_row, k)[entry_ok]            # label of each entry's row
+    npairs = len(upairs)
+    sum_x = np.bincount(pos, weights=v, minlength=npairs)
+    # v*v in the SOURCE dtype (f32 upstream) so scores are bit-identical to
+    # pearson_scores — exact ties must break the same way in both builders.
+    sum_x2 = np.bincount(pos, weights=np.asarray(v_raw * v_raw, np.float64),
+                         minlength=npairs)
+    sum_xy = np.bincount(pos, weights=v * y_ent, minlength=npairs)
+    row_of_kept = dense_e >= 0
+    sum_y_e = np.bincount(dense_e[row_of_kept], weights=y_row[row_of_kept],
+                          minlength=e_count)
+    sum_y2_e = np.bincount(dense_e[row_of_kept],
+                           weights=y_row[row_of_kept] ** 2, minlength=e_count)
+    s_e = counts.astype(np.float64)
+    s, sy, sy2 = s_e[ent_of_col], sum_y_e[ent_of_col], sum_y2_e[ent_of_col]
+    num = s * sum_xy - sum_x * sy
+    var_x = s * sum_x2 - sum_x * sum_x
+    var_y = s * sy2 - sy * sy
+    denom = np.sqrt(np.maximum(var_x, 0.0) * np.maximum(var_y, 0.0))
+    with np.errstate(invalid="ignore", divide="ignore"):
+        score = np.where(denom > 0, np.abs(num) / np.maximum(denom, 1e-30), 0.0)
+
+    cols = upairs % stride
+    rank_order = np.lexsort((cols, -score, ent_of_col))
+    off = np.zeros(e_count + 1, np.int64)
+    np.cumsum(np.bincount(ent_of_col, minlength=e_count), out=off[1:])
+    rank = np.empty(npairs, np.int64)
+    rank[rank_order] = np.arange(npairs) - off[ent_of_col[rank_order]]
+    over_cap = (off[1:] - off[:-1]) > max_features     # per entity
+    chosen = ~over_cap[ent_of_col] | (rank < max_features)
+    if intercept_index is not None:
+        chosen |= cols == intercept_index
+    return chosen
+
+
+def _build_reference_loop(
+    re_type: str,
+    entity_keys_per_row: np.ndarray,
+    idx: np.ndarray,
+    val: np.ndarray,
+    labels: np.ndarray,
+    global_dim: int,
+    weights: Optional[np.ndarray] = None,
+    active_bound: Optional[int] = None,
+    min_entity_rows: int = 1,
+    intercept_index: Optional[int] = None,
+    dtype=np.float32,
+    max_features_per_entity: Optional[int] = None,
+) -> RandomEffectDataset:
+    """Original entity-at-a-time builder, kept as the oracle for the
+    vectorized path's equivalence test (tests/test_random_effect.py)."""
     n, k = idx.shape
     labels = np.asarray(labels, dtype)
     weights = np.ones(n, dtype) if weights is None else np.asarray(weights, dtype)

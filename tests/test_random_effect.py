@@ -367,3 +367,48 @@ class TestPearsonFiltering:
         for c in range(p):
             expect = abs(np.corrcoef(dense[:, c], y)[0, 1])
             np.testing.assert_allclose(scores[c], expect, rtol=1e-10)
+
+
+class TestVectorizedBuilderEquivalence:
+    """The vectorized builder is bit-identical to the original
+    entity-at-a-time implementation (_build_reference_loop) across the
+    option space (VERDICT round-2 weak #7)."""
+
+    @pytest.mark.parametrize("opts", [
+        dict(),
+        dict(min_entity_rows=3),
+        dict(active_bound=2),
+        dict(intercept_index=0),
+        dict(max_features_per_entity=3),
+        dict(intercept_index=0, max_features_per_entity=3, active_bound=2,
+             min_entity_rows=2),
+    ])
+    def test_matches_reference_loop(self, opts):
+        from photon_tpu.data.random_effect import (
+            _build_reference_loop,
+            build_random_effect_dataset,
+        )
+
+        rng = np.random.default_rng(17)
+        n_ent, dg, k = 37, 50, 5
+        ents = rng.integers(0, n_ent, size=200)
+        n = len(ents)
+        idx = rng.integers(0, dg + 1, size=(n, k)).astype(np.int32)  # some ghost
+        val = np.where(idx < dg, rng.normal(size=(n, k)), 0.0).astype(np.float32)
+        labels = (rng.random(n) < 0.5).astype(np.float32)
+        weights = rng.uniform(0.5, 2.0, size=n).astype(np.float32)
+        keys = np.array([f"e{e:03d}" for e in ents], object)
+
+        a = build_random_effect_dataset(
+            "re", keys, idx, val, labels, dg, weights=weights, **opts)
+        b = _build_reference_loop(
+            "re", keys, idx, val, labels, dg, weights=weights, **opts)
+
+        assert a.entity_keys == b.entity_keys
+        assert a.entity_to_slot == b.entity_to_slot
+        assert len(a.buckets) == len(b.buckets)
+        for ba, bb in zip(a.buckets, b.buckets):
+            for f in ("idx", "val", "labels", "weights", "train_weights",
+                      "row_ids", "proj", "entity_ids"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(ba, f)), np.asarray(getattr(bb, f)), err_msg=f)
