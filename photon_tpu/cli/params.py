@@ -10,13 +10,14 @@ Coordinate spec mini-DSL (one ``--coordinate`` flag per coordinate):
 
     <cid>:<k>=<v>,<k>=<v>,...
 
-keys: ``type`` fixed|random (required); ``shard`` feature shard id;
-``re_type`` entity id column (random only, required); ``active_bound`` int;
-``min_rows`` int; ``optimizer`` LBFGS|OWLQN|TRON; ``max_iter`` int; ``tol``
-float; ``reg`` NONE|L1|L2|ELASTIC_NET; ``alpha`` elastic-net α;
+keys: ``type`` fixed|random|factored (required); ``shard`` feature shard id;
+``re_type`` entity id column (random/factored, required); ``active_bound``
+int; ``min_rows`` int; ``optimizer`` LBFGS|OWLQN|TRON; ``max_iter`` int;
+``tol`` float; ``reg`` NONE|L1|L2|ELASTIC_NET; ``alpha`` elastic-net α;
 ``reg_weights`` '|'-separated floats (sweep, default 0); ``downsample`` rate;
 ``variance`` NONE|SIMPLE|FULL; ``incremental`` prior weight for incremental
-training from --model-input-dir (requires it).
+training from --model-input-dir (requires it); ``latent``/``alternations``
+(factored only) latent dimension and alternation count.
 
 Example:
     --coordinate "fixed:type=fixed,shard=global,optimizer=LBFGS,reg=L2,reg_weights=0.1|1|10"
@@ -29,6 +30,7 @@ from typing import Optional, Sequence
 
 from photon_tpu.estimators.config import (
     CoordinateDataConfig,
+    FactoredRandomEffectDataConfig,
     FixedEffectDataConfig,
     GLMOptimizationConfiguration,
     RandomEffectDataConfig,
@@ -76,27 +78,29 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
     known = {
         "type", "shard", "re_type", "active_bound", "min_rows", "max_features", "optimizer",
         "max_iter", "tol", "reg", "alpha", "reg_weights", "downsample",
-        "variance", "incremental",
+        "variance", "incremental", "latent", "alternations",
     }
     unknown = set(kv) - known
     if unknown:
         raise ValueError(f"coordinate {cid!r}: unknown keys {sorted(unknown)}")
 
     ctype = kv.get("type")
-    if ctype not in ("fixed", "random"):
+    if ctype not in ("fixed", "random", "factored"):
         raise ValueError(
-            f"coordinate {cid!r}: type must be 'fixed' or 'random', got {ctype!r}"
+            f"coordinate {cid!r}: type must be 'fixed', 'random' or "
+            f"'factored', got {ctype!r}"
         )
     shard = kv.get("shard", "global")
     if ctype == "fixed":
-        for k in ("re_type", "active_bound", "min_rows", "max_features"):
+        for k in ("re_type", "active_bound", "min_rows", "max_features",
+                  "latent", "alternations"):
             if k in kv:
                 raise ValueError(f"coordinate {cid!r}: {k} is random-effect only")
         data: CoordinateDataConfig = FixedEffectDataConfig(feature_shard=shard)
     else:
         if "re_type" not in kv:
             raise ValueError(f"coordinate {cid!r}: random effects need re_type")
-        data = RandomEffectDataConfig(
+        re_kwargs = dict(
             re_type=kv["re_type"],
             feature_shard=shard,
             active_bound=int(kv["active_bound"]) if "active_bound" in kv else None,
@@ -105,6 +109,18 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
                 int(kv["max_features"]) if "max_features" in kv else None
             ),
         )
+        if ctype == "factored":
+            data = FactoredRandomEffectDataConfig(
+                latent_dim=int(kv.get("latent", 8)),
+                n_alternations=int(kv.get("alternations", 2)),
+                **re_kwargs,
+            )
+        else:
+            if "latent" in kv or "alternations" in kv:
+                raise ValueError(
+                    f"coordinate {cid!r}: latent/alternations need type=factored"
+                )
+            data = RandomEffectDataConfig(**re_kwargs)
 
     reg_type = RegularizationType(kv.get("reg", "NONE").upper())
     if reg_type == RegularizationType.ELASTIC_NET:

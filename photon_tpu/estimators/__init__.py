@@ -2,6 +2,7 @@
 .../transformers⟧ (SURVEY.md §1 L6)."""
 from photon_tpu.estimators.config import (
     CoordinateDataConfig,
+    FactoredRandomEffectDataConfig,
     FixedEffectDataConfig,
     GameOptimizationConfiguration,
     GLMOptimizationConfiguration,
@@ -18,6 +19,7 @@ from photon_tpu.estimators.game_transformer import GameTransformer
 
 __all__ = [
     "CoordinateDataConfig",
+    "FactoredRandomEffectDataConfig",
     "FixedEffectDataConfig",
     "RandomEffectDataConfig",
     "GLMOptimizationConfiguration",

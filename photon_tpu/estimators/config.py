@@ -55,7 +55,28 @@ class RandomEffectDataConfig:
     max_features_per_entity: Optional[int] = None
 
 
-CoordinateDataConfig = Union[FixedEffectDataConfig, RandomEffectDataConfig]
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectDataConfig(RandomEffectDataConfig):
+    """Random effects constrained to a learned latent space ``w_e = P·β_e``
+    — reference ⟦FactoredRandomEffectDataConfiguration⟧ (fork-vintage; see
+    game/factored_random_effect.py). Dataset preparation is identical to a
+    plain random effect; training alternates latent/projection steps."""
+
+    latent_dim: int = 8
+    n_alternations: int = 2
+
+    def __post_init__(self):
+        if self.latent_dim < 1:
+            raise ValueError(f"latent_dim must be >= 1, got {self.latent_dim}")
+        if self.n_alternations < 1:
+            raise ValueError(
+                f"n_alternations must be >= 1, got {self.n_alternations}"
+            )
+
+
+CoordinateDataConfig = Union[
+    FixedEffectDataConfig, RandomEffectDataConfig, FactoredRandomEffectDataConfig
+]
 
 
 @dataclasses.dataclass(frozen=True)

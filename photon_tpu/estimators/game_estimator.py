@@ -38,6 +38,7 @@ from photon_tpu.data.sampling import down_sampler_for_task
 from photon_tpu.data.statistics import compute_feature_statistics
 from photon_tpu.estimators.config import (
     CoordinateDataConfig,
+    FactoredRandomEffectDataConfig,
     FixedEffectDataConfig,
     GameOptimizationConfiguration,
     GLMOptimizationConfiguration,
@@ -423,6 +424,33 @@ class GameEstimator:
                     data_axis=self.data_axis,
                     normalization=prep["norm"][dcfg.feature_shard],
                     model_axis=model_axis,
+                )
+            elif isinstance(dcfg, FactoredRandomEffectDataConfig):
+                from photon_tpu.game.coordinates import (
+                    FactoredRandomEffectCoordinate,
+                )
+
+                # Unsupported knobs fail loudly rather than silently no-op.
+                unsupported = []
+                if ocfg.incremental_weight > 0.0:
+                    unsupported.append("incremental training")
+                if ocfg.down_sampling_rate < 1.0:
+                    unsupported.append("down-sampling")
+                if ocfg.variance_type.name != "NONE":
+                    unsupported.append("coefficient variances")
+                if prep["norm"][dcfg.feature_shard] is not None:
+                    unsupported.append("feature normalization")
+                if unsupported:
+                    raise ValueError(
+                        f"coordinate {cid!r}: {', '.join(unsupported)} "
+                        "not supported for factored random effects"
+                    )
+                coordinates[cid] = FactoredRandomEffectCoordinate(
+                    dataset=prep["train"][cid],
+                    problem=problem,
+                    latent_dim=dcfg.latent_dim,
+                    n_alternations=dcfg.n_alternations,
+                    seed=self.seed,
                 )
             else:
                 dataset = prep["train"][cid]

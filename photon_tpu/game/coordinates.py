@@ -136,5 +136,53 @@ class RandomEffectCoordinate:
         return model.score_new_dataset(self.dataset)
 
 
-Coordinate = Union[FixedEffectCoordinate, RandomEffectCoordinate]
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectCoordinate:
+    """Per-entity models in a learned latent space — reference
+    ⟦FactoredRandomEffectCoordinate⟧ (see game/factored_random_effect.py)."""
+
+    dataset: RandomEffectDataset
+    problem: GLMOptimizationProblem
+    latent_dim: int = 8
+    n_alternations: int = 2
+    seed: int = 0
+
+    def train(self, offsets: Array, init=None):
+        from photon_tpu.game.factored_random_effect import (
+            FactoredRandomEffectModel,
+            train_factored_random_effects,
+        )
+
+        # A loaded warm start arrives as the saved EFFECTIVE RandomEffectModel;
+        # train_factored_random_effects re-factors it spectrally (the
+        # effective matrix is exactly rank-p, so the SVD recovers the saved
+        # factorization's subspace).
+        if not isinstance(init, (FactoredRandomEffectModel, RandomEffectModel)):
+            init = None
+        return train_factored_random_effects(
+            self.problem, self.dataset, offsets,
+            latent_dim=self.latent_dim,
+            n_alternations=self.n_alternations,
+            seed=self.seed,
+            init=init,
+        )
+
+    def score(self, model) -> Array:
+        # Score through the effective per-entity model; a foreign model
+        # (loaded warm start / locked coordinate, possibly a plain
+        # RandomEffectModel) goes through key-matched re-projection.
+        eff = getattr(model, "effective", model)
+        same = len(eff.bucket_proj) == len(self.dataset.buckets) and all(
+            p is b.proj for p, b in zip(eff.bucket_proj, self.dataset.buckets)
+        )
+        return (
+            eff.score_dataset(self.dataset)
+            if same
+            else eff.score_new_dataset(self.dataset)
+        )
+
+
+Coordinate = Union[
+    FixedEffectCoordinate, RandomEffectCoordinate, FactoredRandomEffectCoordinate
+]
 DatumScoringModel = Union[FixedEffectModel, RandomEffectModel]

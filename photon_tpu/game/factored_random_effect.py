@@ -1,0 +1,316 @@
+"""Factored random effects: per-entity models in a learned latent space.
+
+Parity: reference ⟦photon-api/.../algorithm/FactoredRandomEffectCoordinate⟧ +
+⟦.../projector/ProjectionMatrix, RandomProjectionMatrix⟧ (SURVEY.md §2.2
+Projectors, L5 layer map — fork-vintage component). Each entity's
+coefficients are constrained to ``w_e = P · β_e`` with a SHARED projection
+``P [D, p]`` and per-entity latent vectors ``β_e [p]``; training alternates
+
+  1. latent step — fit every entity's ``β_e`` against features projected
+     through the current ``P`` (small dense per-entity problems), and
+  2. projection step — refit ``P`` against the pooled data with all ``β_e``
+     fixed (one D·p-parameter smooth problem).
+
+TPU-first: the latent step is ONE vmapped dense solve per bucket (the
+reference trains per-entity models executor-side and the matrix step as a
+separate Spark job); the projection step differentiates straight through the
+feature-projection gather with autodiff and runs the shared L-BFGS core.
+``P`` is initialized as a Gaussian random projection (reference
+⟦RandomProjectionMatrix⟧) and the final model also materializes the
+EFFECTIVE per-entity coefficients ``P_local · β_e`` as a standard
+:class:`RandomEffectModel`, so scoring, validation, export, and warm-start
+projection all reuse the plain random-effect machinery.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from photon_tpu.data.batch import DenseFeatures, LabeledBatch
+from photon_tpu.data.random_effect import EntityBucket, RandomEffectDataset
+from photon_tpu.functions.problem import GLMOptimizationProblem
+from photon_tpu.game.random_effect import RandomEffectModel
+from photon_tpu.ops.losses import loss_for_task
+from photon_tpu.optim import LBFGS, OptimizerResult
+from photon_tpu.types import TaskType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class FactoredRandomEffectModel:
+    """``w_e = P · β_e`` plus the materialized effective RE model.
+
+    ``effective`` carries the per-entity coefficients in each entity's local
+    subspace and serves every scoring/export path; ``projection`` and
+    ``bucket_latent`` are kept for warm-starting further factored training.
+    """
+
+    re_type: str
+    task: TaskType
+    projection: Array                   # [D, p]
+    bucket_latent: Sequence[Array]      # per bucket: [E, p]
+    effective: RandomEffectModel
+
+    @property
+    def latent_dim(self) -> int:
+        return self.projection.shape[1]
+
+    @property
+    def n_entities(self) -> int:
+        return self.effective.n_entities
+
+    # Scoring/export delegate to the effective per-entity model so the
+    # factored coordinate plugs into descent/validation/IO unchanged.
+    def score_dataset(self, dataset: RandomEffectDataset) -> Array:
+        return self.effective.score_dataset(dataset)
+
+    def score_new_dataset(self, dataset: RandomEffectDataset) -> Array:
+        return self.effective.score_new_dataset(dataset)
+
+    def coefficients_for(self, entity_key):
+        return self.effective.coefficients_for(entity_key)
+
+
+def _project_bucket_features(P_ext: Array, bucket: EntityBucket) -> Array:
+    """Latent features ``Xp[e, s, :] = Σ_k val[e,s,k] · P[col(e,s,k), :]``.
+
+    ``P_ext`` is ``P`` with one zero ghost row; ``bucket.proj`` routes local
+    column ids to global rows of ``P`` (its own ghost slots hit the zero
+    row), so padded entries contribute nothing. Differentiable w.r.t. ``P``
+    (the projection step's autodiff path goes through these gathers).
+    """
+    Pl = P_ext[bucket.proj]                           # [E, Ppad, p]
+    Pl = jnp.concatenate(                             # local ghost row
+        [Pl, jnp.zeros_like(Pl[:, :1])], axis=1
+    )
+
+    def one(pl, idx, val):
+        return jnp.einsum("skp,sk->sp", pl[idx], val)
+
+    return jax.vmap(one)(Pl, bucket.idx, bucket.val)
+
+
+@partial(jax.jit, static_argnums=0)
+def _latent_step(problem, P, bucket, offsets, b0):
+    """Vmapped dense solve for all of one bucket's latent vectors."""
+    P_ext = jnp.concatenate([P, jnp.zeros_like(P[:1])])
+    xp = _project_bucket_features(P_ext, bucket)
+    base = bucket.local_batches(offsets)
+
+    def solve(x, lab, off, wts, w0):
+        b = LabeledBatch(DenseFeatures(x), lab, off, wts)
+        model, result = problem.run(b, w0)
+        return model.coefficients.means, result
+
+    return jax.vmap(solve)(xp, base.labels, base.offsets, base.weights, b0)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _projection_step(problem, n_iter: int, P, buckets, offsets, lats):
+    """Refit ``P`` with every β fixed: L-BFGS over vec(P) through autodiff."""
+    loss = loss_for_task(problem.task)
+    lam = problem.regularization.l2_weight(problem.reg_weight)
+    shape = P.shape
+    # Loop-invariant: batch assembly (offset gather) depends only on
+    # (buckets, offsets) — hoist it out of the L-BFGS objective.
+    bases = [bucket.local_batches(offsets) for bucket in buckets]
+
+    def objective(p_flat):
+        P_ = p_flat.reshape(shape)
+        P_ext = jnp.concatenate([P_, jnp.zeros_like(P_[:1])])
+        total = 0.0
+        for bucket, base, beta in zip(buckets, bases, lats):
+            xp = _project_bucket_features(P_ext, bucket)
+            z = jnp.einsum("esp,ep->es", xp, beta) + base.offsets
+            total = total + jnp.sum(base.weights * loss.loss(z, base.labels))
+        return total + 0.5 * lam * jnp.sum(p_flat * p_flat)
+
+    cfg = dataclasses.replace(problem.optimizer_config, max_iterations=n_iter)
+    result = LBFGS(cfg).optimize(jax.value_and_grad(objective), P.reshape(-1))
+    return result.x.reshape(shape), result
+
+
+def _spectral_init(
+    problem: GLMOptimizationProblem,
+    dataset: RandomEffectDataset,
+    offsets: Array,
+    latent_dim: int,
+    seed: int,
+) -> tuple[Array, list[Array]]:
+    """(P0, β0) from the top-``latent_dim`` SVD of the plain per-entity fit.
+
+    The plain coefficients form a sparse [E, D] matrix (each entity's local
+    subspace scattered to global columns); ``W ≈ U S Vᵀ`` gives ``P0 = V``
+    (orthonormal) and ``β0 = U S`` — the best rank-p summary of what
+    unconstrained per-entity fits learned.
+    """
+    from photon_tpu.game.random_effect import train_random_effects
+
+    if not dataset.buckets:
+        return (
+            jnp.zeros((dataset.global_dim, latent_dim)),
+            [],
+        )
+    plain, _ = train_random_effects(problem, dataset, offsets)
+    return _factor_model(plain, dataset, latent_dim, seed)
+
+
+def _factor_model(
+    source: "RandomEffectModel",
+    dataset: RandomEffectDataset,
+    latent_dim: int,
+    seed: int,
+) -> tuple[Array, list[Array]]:
+    """Top-p SVD of ``source``'s sparse per-entity coefficients, with β rows
+    matched to ``dataset``'s entities BY KEY (entities the source never saw
+    start at 0). Used both for the spectral init and for re-factoring a
+    loaded effective model (whose coefficient matrix is exactly rank-p)."""
+    import numpy as np
+    import scipy.sparse as sp
+    from scipy.sparse.linalg import svds
+
+    rows, cols, vals = [], [], []
+    for coefs, proj, eids in zip(
+        source.bucket_coefs, source.bucket_proj, source.bucket_entity_ids
+    ):
+        c = np.asarray(coefs, np.float64)
+        p = np.asarray(proj)
+        e = np.asarray(eids)
+        lane_ok = e >= 0
+        col_ok = p < dataset.global_dim
+        ok = lane_ok[:, None] & col_ok
+        rows.append(np.broadcast_to(e[:, None], p.shape)[ok])
+        cols.append(p[ok])
+        vals.append(c[ok])
+    n_src = source.n_entities
+    W = sp.csr_matrix(
+        (np.concatenate(vals), (np.concatenate(rows), np.concatenate(cols))),
+        shape=(n_src, source.global_dim),
+    )
+    k = min(latent_dim, min(W.shape) - 1)
+    P0 = np.zeros((dataset.global_dim, latent_dim))
+    B_src = np.zeros((n_src, latent_dim))
+    if k >= 1:
+        # deterministic ARPACK start vector (svds' random_state plumbing
+        # varies across scipy versions)
+        v0 = np.random.default_rng(seed).normal(size=min(W.shape))
+        u, s, vt = svds(W, k=k, v0=v0)
+        order = np.argsort(-s)
+        u, s, vt = u[:, order], s[order], vt[order]
+        P0[: source.global_dim, :k] = vt.T
+        B_src[:, :k] = u * s
+    # β rows matched by entity KEY (source == dataset for the fresh-init
+    # path, where this reduces to the identity mapping).
+    B0 = np.zeros((dataset.n_entities + 1, latent_dim))
+    if source.entity_keys is dataset.entity_keys:
+        B0[:-1] = B_src                              # fresh-init fast path
+    else:
+        key_to_src = source._key_to_dense
+        for dense_new, key in enumerate(dataset.entity_keys):
+            src = key_to_src.get(key)
+            if src is not None:
+                B0[dense_new] = B_src[src]
+    lats = [
+        jnp.asarray(B0[np.asarray(b.entity_ids)])   # -1 pad -> zero last row
+        for b in dataset.buckets
+    ]
+    return jnp.asarray(P0), lats
+
+
+def train_factored_random_effects(
+    problem: GLMOptimizationProblem,
+    dataset: RandomEffectDataset,
+    offsets: Array,
+    latent_dim: int = 8,
+    n_alternations: int = 2,
+    seed: int = 0,
+    init=None,
+) -> tuple[FactoredRandomEffectModel, list[OptimizerResult]]:
+    """Alternating factored-RE training over all buckets.
+
+    ``problem`` configures both steps (its optimizer config drives the latent
+    solves; the projection step reuses its L2 weight and iteration budget).
+    ``init`` may be a :class:`FactoredRandomEffectModel` (same structure →
+    resume its factors) or a plain :class:`RandomEffectModel` (a loaded
+    warm start → its coefficients are re-factored spectrally).
+    """
+    dtype = dataset.buckets[0].val.dtype if dataset.buckets else jnp.float32
+    d = dataset.global_dim
+    same_init = (
+        isinstance(init, FactoredRandomEffectModel)
+        and init.projection.shape == (d, latent_dim)
+        and len(init.bucket_latent) == len(dataset.buckets)
+        and all(
+            b.shape[0] == bk.n_entities
+            for b, bk in zip(init.bucket_latent, dataset.buckets)
+        )
+    )
+    if same_init:
+        P = init.projection.astype(dtype)
+        lats = [b.astype(dtype) for b in init.bucket_latent]
+    elif (
+        isinstance(init, RandomEffectModel) and init.global_dim == d
+        and dataset.buckets
+    ):
+        # Loaded effective model (the saved form of a factored coordinate,
+        # or any plain RE warm start): re-factor ITS coefficients instead of
+        # refitting the plain solve from scratch.
+        P, lats = _factor_model(init, dataset, latent_dim, seed)
+        P = P.astype(dtype)
+        lats = [b.astype(dtype) for b in lats]
+    else:
+        # Spectral init: one plain per-entity solve, then the top-p SVD of
+        # its sparse coefficient matrix seeds (P, β). A Gaussian random P
+        # (the reference RandomProjectionMatrix) makes the alternation lock
+        # onto the random subspace — the first β-step fits noise the random
+        # P happens to span and the P-step then reinforces it; starting in
+        # the plain solution's principal subspace lands in the right basin.
+        P, lats = _spectral_init(problem, dataset, offsets, latent_dim, seed)
+        P = P.astype(dtype)
+        lats = [b.astype(dtype) for b in lats]
+
+    results: list[OptimizerResult] = []
+    for _ in range(max(1, n_alternations)):
+        results = []
+        for i, bucket in enumerate(dataset.buckets):
+            lats[i], res = _latent_step(problem, P, bucket, offsets, lats[i])
+            results.append(res)
+        P, _ = _projection_step(
+            problem, problem.optimizer_config.max_iterations, P,
+            tuple(dataset.buckets), offsets, tuple(lats),
+        )
+    # Final latent refresh so β is optimal for the returned P.
+    results = []
+    for i, bucket in enumerate(dataset.buckets):
+        lats[i], res = _latent_step(problem, P, bucket, offsets, lats[i])
+        results.append(res)
+
+    # Effective per-entity coefficients in each local subspace.
+    P_ext = jnp.concatenate([P, jnp.zeros_like(P[:1])])
+    eff_coefs = [
+        jnp.einsum("eqp,ep->eq", P_ext[b.proj], lat)
+        for b, lat in zip(dataset.buckets, lats)
+    ]
+    effective = RandomEffectModel(
+        re_type=dataset.re_type,
+        task=problem.task,
+        bucket_coefs=eff_coefs,
+        bucket_proj=[b.proj for b in dataset.buckets],
+        bucket_entity_ids=[b.entity_ids for b in dataset.buckets],
+        entity_keys=dataset.entity_keys,
+        entity_to_slot=dataset.entity_to_slot,
+        global_dim=dataset.global_dim,
+    )
+    model = FactoredRandomEffectModel(
+        re_type=dataset.re_type,
+        task=problem.task,
+        projection=P,
+        bucket_latent=lats,
+        effective=effective,
+    )
+    return model, results

@@ -104,6 +104,15 @@ def save_game_model(
 
     for cid in model.keys():
         m = model[cid]
+        factored_extra = None
+        if hasattr(m, "effective") and hasattr(m, "projection"):
+            # Factored random effect: persist the EFFECTIVE per-entity
+            # coefficients in the standard random-effect layout — scoring
+            # round-trips through the normal loader, and a factored warm
+            # start re-factors them spectrally (the effective matrix is
+            # exactly rank-p). projection.npy rides along for inspection.
+            factored_extra = np.asarray(m.projection)
+            m = m.effective
         if isinstance(m, FixedEffectModel):
             shard = shard_by_coordinate.get(cid, m.feature_shard)
             imap = index_maps[shard]
@@ -164,6 +173,11 @@ def save_game_model(
                 "task": m.task.value,
                 "re_type": m.re_type,
             }
+            if factored_extra is not None:
+                np.save(os.path.join(cdir, "projection.npy"), factored_extra)
+                meta["coordinates"][cid]["factored_latent_dim"] = int(
+                    factored_extra.shape[1]
+                )
         else:
             raise TypeError(f"coordinate {cid}: unknown model type {type(m)}")
 
