@@ -184,6 +184,11 @@ def _make_mesh(n_devices: int, mesh_spec: Optional[str] = None):
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     """Run training; returns a result summary dict (also written to disk)."""
     args = build_arg_parser().parse_args(argv)
+    # Join the multi-host runtime first (no-op single-process) so
+    # jax.devices() below sees the whole pod slice (SURVEY.md §5.8).
+    from photon_tpu.parallel.distributed import initialize_distributed
+
+    initialize_distributed()
     if args.dtype == "float64":
         import jax
 

@@ -12,3 +12,8 @@ from photon_tpu.parallel.data_parallel import (  # noqa: F401
     fit_data_parallel,
     spmd_value_and_grad,
 )
+from photon_tpu.parallel.distributed import (  # noqa: F401
+    global_batch_from_local,
+    initialize_distributed,
+    process_file_shard,
+)

@@ -1,0 +1,106 @@
+"""Multi-host runtime bring-up and host-side data feeding.
+
+Parity: the reference's communication backend is the Spark driver/executor
+runtime (SURVEY.md §1 layer R, §5.8 comm backend): cluster membership from
+YARN, data distribution via HDFS splits, gradients via ``treeAggregate``.
+Here the same responsibilities map to the JAX distributed runtime:
+
+* membership   → ``jax.distributed.initialize`` (one process per host; on
+  TPU pods coordinator/process ids auto-detect from the metadata server),
+* data feed    → per-process file shards (``StreamingAvroReader.iter_chunks``
+  with ``file_shard``) assembled into globally-sharded arrays with
+  ``jax.make_array_from_process_local_data``,
+* collectives  → XLA psum/all-gather over ICI/DCN inside the jitted step
+  (see ``parallel/mesh.py`` / ``parallel/data_parallel.py``).
+
+Everything degrades to a no-op in a single-process run, so the same driver
+code serves a laptop, one TPU VM, and a multi-host pod slice.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from photon_tpu.parallel.mesh import DATA_AXIS, axis_tuple
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Join the multi-host runtime; returns True iff it actually initialized.
+
+    Call once at driver start, BEFORE any other JAX API touches the backend.
+    With no arguments, TPU pod environments auto-detect everything; on other
+    platforms a single-process run is detected and left untouched (no-op).
+    """
+    global _initialized
+    if _initialized:
+        return False
+    if coordinator_address is None and num_processes is None:
+        # Decide from the environment ONLY — probing jax (even
+        # ``jax.process_count()``) would initialize the XLA backend and make
+        # ``jax.distributed.initialize`` unusable afterwards. Auto-initialize
+        # only where multi-host auto-detection exists: a multi-worker TPU pod
+        # (comma-separated TPU_WORKER_HOSTNAMES) or a megascale (multi-slice)
+        # coordinator.
+        import os
+
+        hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        multi_host = "," in hosts
+        multi_slice = bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+        if not (multi_host or multi_slice):
+            return False
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        # Backend already up (initialize must precede all other JAX use) or
+        # runtime already joined — either way, proceed single-runtime.
+        import logging
+
+        logging.getLogger("photon_tpu.parallel").warning(
+            "jax.distributed.initialize skipped: %s", e
+        )
+        return False
+    _initialized = True
+    return True
+
+
+def process_file_shard() -> tuple[int, int]:
+    """(process_index, process_count) — the per-host input-file shard spec,
+    directly usable as ``StreamingAvroReader.iter_chunks(..., file_shard=...)``
+    (the reference's per-executor HDFS splits)."""
+    return jax.process_index(), jax.process_count()
+
+
+def global_batch_from_local(batch, mesh: Mesh, axis=DATA_AXIS):
+    """Assemble a globally row-sharded batch from THIS process's local rows.
+
+    Each process passes its own local pytree (rows it read via its file
+    shard); the result is one global array pytree whose leading dimension is
+    the concatenation over processes, sharded over ``axis``. Single-process
+    this is exactly ``shard_batch_pytree``.
+
+    Local row counts must be equal across processes (pad the tail shard —
+    ``pad_rows_to_multiple`` — as the reference pads partitions).
+    """
+    ax = axis_tuple(axis)
+
+    def put(leaf):
+        leaf = np.asarray(leaf)
+        spec = P(ax, *([None] * (leaf.ndim - 1)))
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), leaf
+        )
+
+    return jax.tree.map(put, batch)

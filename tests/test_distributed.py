@@ -197,3 +197,38 @@ class TestMultiSliceDCN:
             np.asarray(m_mp.coefficients.means),
             np.asarray(m_single.coefficients.means), atol=2e-5,
         )
+
+
+class TestMultiHostPrimitives:
+    """parallel/distributed.py: single-process no-op semantics + the
+    process-local -> global assembly primitive (SURVEY.md §5.8)."""
+
+    def test_initialize_is_noop_single_process(self):
+        from photon_tpu.parallel.distributed import initialize_distributed
+
+        assert initialize_distributed() is False   # no coordinator spun up
+        assert jax.process_count() == 1
+
+    def test_process_file_shard(self):
+        from photon_tpu.parallel.distributed import process_file_shard
+
+        i, n = process_file_shard()
+        assert (i, n) == (0, 1)
+
+    def test_global_batch_from_local_matches_device_put(self, mesh):
+        from photon_tpu.parallel.distributed import global_batch_from_local
+        from photon_tpu.parallel.mesh import shard_batch_pytree
+
+        rng = np.random.default_rng(0)
+        batch = {
+            "x": rng.normal(size=(64, 5)).astype(np.float32),
+            "y": rng.normal(size=(64,)).astype(np.float32),
+        }
+        g = global_batch_from_local(batch, mesh)
+        ref = shard_batch_pytree(
+            {k: jnp.asarray(v) for k, v in batch.items()}, mesh
+        )
+        for k in batch:
+            assert g[k].shape == batch[k].shape
+            assert g[k].sharding == ref[k].sharding
+            np.testing.assert_array_equal(np.asarray(g[k]), batch[k])
