@@ -122,6 +122,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "convergence semantics; float32 is the TPU-fast "
                         "default with a convergence floor around 1e-6 "
                         "relative (documented in tests/test_precision.py)")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="restart the pipeline up to N times on retryable "
+                        "failures (device/runtime/IO errors); pair with "
+                        "--checkpoint-dir so each attempt resumes past "
+                        "completed coordinate steps instead of recomputing "
+                        "(the reference's Spark task-retry/lineage recovery, "
+                        "SURVEY.md §5.3, as checkpoint-restart)")
+    p.add_argument("--restart-backoff", type=float, default=5.0,
+                   help="seconds before the first restart (doubles each time)")
+    p.add_argument("--heartbeat-dir", default=None,
+                   help="shared directory for multi-host liveness beacons; "
+                        "each process writes a heartbeat file and restart "
+                        "attempts fail fast with the dead-host list instead "
+                        "of hanging in a collective (SURVEY.md §5.3)")
     return p
 
 
@@ -206,13 +220,64 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         os.makedirs(args.profile_dir, exist_ok=True)
         jax.profiler.start_trace(args.profile_dir)
         profiling = True
-    try:
+
+    from photon_tpu.supervisor import Heartbeat, RestartPolicy, run_with_recovery
+
+    heartbeat = None
+    if args.heartbeat_dir:
+        # Short interval: a retry must be able to tell "peer died with me"
+        # from "peer is fine", so the staleness window (3x interval) has to
+        # fit inside a restart backoff, not dwarf it.
+        heartbeat = Heartbeat(args.heartbeat_dir, interval_seconds=2.0).start()
+
+    def attempt(i: int) -> dict:
+        if i > 0 and heartbeat is not None:
+            import time as _time
+
+            import jax
+
+            # Let a freshly-dead peer's last beat age past the staleness
+            # window before judging: the check runs backoff seconds after
+            # our failure, so top up to 3x the beat interval if needed.
+            settle = max(
+                0.0, 3.0 * heartbeat.interval_seconds - args.restart_backoff
+            )
+            if settle:
+                _time.sleep(settle)
+            report = heartbeat.check_peers(range(jax.process_count()))
+            if not report.healthy:
+                raise RestartsUselessError(
+                    f"peer hosts dead={report.dead} missing={report.missing}; "
+                    "restart the job (checkpoint resume will fast-forward)"
+                )
         return _run_inner(args, task)
+
+    try:
+        if args.max_restarts > 0:
+            import logging
+
+            return run_with_recovery(
+                attempt,
+                RestartPolicy(
+                    max_restarts=args.max_restarts,
+                    backoff_seconds=args.restart_backoff,
+                ),
+                logger=logging.getLogger("photon_tpu.supervisor"),
+            )
+        return attempt(0)
     finally:
+        if heartbeat is not None:
+            heartbeat.stop()
         if profiling:
             import jax.profiler
 
             jax.profiler.stop_trace()
+
+
+class RestartsUselessError(Exception):
+    """A peer host is gone: in-process retry cannot succeed, so this escapes
+    the retry loop (it is not a retryable type) and fails the job fast; the
+    outer scheduler restarts all hosts and checkpoint resume takes over."""
 
 
 def _run_inner(args, task) -> dict:
@@ -358,16 +423,30 @@ def _run_inner(args, task) -> dict:
                 from photon_tpu.checkpoint import CheckpointManager
 
                 ckpt = CheckpointManager(args.checkpoint_dir)
-            with Timed("fit", logger) as fit_timer:
-                results = estimator.fit(
-                    train,
-                    validation if args.evaluators else None,
-                    configs,
-                    initial_model=initial_model,
-                    checkpoint_manager=ckpt,
-                )
-            if ckpt is not None:
-                ckpt.close()
+            try:
+                with Timed("fit", logger) as fit_timer:
+                    results = estimator.fit(
+                        train,
+                        validation if args.evaluators else None,
+                        configs,
+                        initial_model=initial_model,
+                        checkpoint_manager=ckpt,
+                    )
+                if ckpt is not None:
+                    ckpt.close()
+            except BaseException:
+                # Drain on the failure path too: a retrying supervisor
+                # (--max-restarts) re-enters with a fresh manager on the same
+                # directory; a leaked writer thread would race its GC and the
+                # enqueued last snapshot could land after the retry's
+                # load_latest. Secondary writer errors must not mask the
+                # original failure.
+                if ckpt is not None:
+                    try:
+                        ckpt.close()
+                    except Exception:
+                        pass
+                raise
 
         suite = (
             EvaluationSuite.parse(args.evaluators) if args.evaluators else None

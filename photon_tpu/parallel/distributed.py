@@ -22,9 +22,9 @@ from typing import Optional
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
-from photon_tpu.parallel.mesh import DATA_AXIS, axis_tuple
+from photon_tpu.parallel.mesh import DATA_AXIS
 
 _initialized = False
 
@@ -64,8 +64,14 @@ def initialize_distributed(
             process_id=process_id,
         )
     except RuntimeError as e:
-        # Backend already up (initialize must precede all other JAX use) or
-        # runtime already joined — either way, proceed single-runtime.
+        # Only "already initialized/joined" is benign (backend came up before
+        # this call — proceed single-runtime). Anything else — coordinator
+        # unreachable, barrier timeout — must fail LOUD: swallowing it would
+        # let every pod worker silently proceed as an independent single-host
+        # job, training on partial data and clobbering the shared output dir.
+        msg = str(e).lower()
+        if "already" not in msg:
+            raise
         import logging
 
         logging.getLogger("photon_tpu.parallel").warning(
@@ -94,13 +100,11 @@ def global_batch_from_local(batch, mesh: Mesh, axis=DATA_AXIS):
     Local row counts must be equal across processes (pad the tail shard —
     ``pad_rows_to_multiple`` — as the reference pads partitions).
     """
-    ax = axis_tuple(axis)
+    from photon_tpu.parallel.mesh import batch_sharding
+
+    sharding = batch_sharding(mesh, axis)
 
     def put(leaf):
-        leaf = np.asarray(leaf)
-        spec = P(ax, *([None] * (leaf.ndim - 1)))
-        return jax.make_array_from_process_local_data(
-            NamedSharding(mesh, spec), leaf
-        )
+        return jax.make_array_from_process_local_data(sharding, np.asarray(leaf))
 
     return jax.tree.map(put, batch)

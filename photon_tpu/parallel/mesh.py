@@ -112,7 +112,12 @@ def make_multislice_mesh(
 
 
 def batch_sharding(mesh: Mesh, axis=DATA_AXIS) -> NamedSharding:
-    """Shard the leading (row) dimension over ``axis``; replicate the rest."""
+    """Shard the leading (row) dimension over ``axis``; replicate the rest
+    (PartitionSpec leaves unmentioned trailing dims unsharded, for any rank).
+
+    The one spec used by every batch-distribution path (device_put here,
+    ``make_array_from_process_local_data`` in parallel/distributed.py), so
+    shardings from either compare equal."""
     return NamedSharding(mesh, P(axis_tuple(axis)))
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -127,13 +132,8 @@ def shard_batch_pytree(batch, mesh: Mesh, axis=DATA_AXIS):
     spec applies uniformly (ELL idx/val are [N, K]; labels/offsets/weights
     are [N]).
     """
-    ax = axis_tuple(axis)
-
-    def put(leaf):
-        spec = P(ax, *([None] * (leaf.ndim - 1)))
-        return jax.device_put(leaf, NamedSharding(mesh, spec))
-
-    return jax.tree.map(put, batch)
+    sharding = batch_sharding(mesh, axis)
+    return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), batch)
 
 
 def pad_rows_to_multiple(arrs_n_leading, multiple: int):

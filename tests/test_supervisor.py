@@ -1,0 +1,194 @@
+"""Elastic restart supervision + multi-host failure detection
+(photon_tpu/supervisor.py): the rebuild's replacement for the Spark-inherited
+task-retry / executor-loss recovery (SURVEY.md §5.3)."""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu.checkpoint import CheckpointManager
+from photon_tpu.supervisor import (
+    Heartbeat,
+    RestartPolicy,
+    RestartsExhausted,
+    run_with_recovery,
+)
+
+
+class FlakyRuntime(RuntimeError):
+    pass
+
+
+def test_retries_transient_then_succeeds():
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        if len(calls) < 3:
+            raise FlakyRuntime(f"transient #{len(calls)}")
+        return "done"
+
+    sleeps = []
+    out = run_with_recovery(
+        attempt, RestartPolicy(max_restarts=3, backoff_seconds=0.5),
+        sleep=sleeps.append,
+    )
+    assert out == "done"
+    assert calls == [0, 1, 2]
+    assert sleeps == [0.5, 1.0]  # exponential backoff between attempts
+
+
+def test_fatal_errors_propagate_immediately():
+    calls = []
+
+    def attempt(i):
+        calls.append(i)
+        raise ValueError("config bug")
+
+    with pytest.raises(ValueError, match="config bug"):
+        run_with_recovery(attempt, RestartPolicy(max_restarts=5), sleep=lambda s: None)
+    assert calls == [0]  # never retried
+
+
+def test_keyboard_interrupt_not_retried():
+    def attempt(i):
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        run_with_recovery(attempt, RestartPolicy(max_restarts=5), sleep=lambda s: None)
+
+
+def test_budget_exhausted_raises_with_history():
+    def attempt(i):
+        raise OSError(f"io fail {i}")
+
+    with pytest.raises(RestartsExhausted) as ei:
+        run_with_recovery(attempt, RestartPolicy(max_restarts=2, backoff_seconds=0),
+                          sleep=lambda s: None)
+    failures = ei.value.failures
+    assert [f.attempt for f in failures] == [0, 1, 2]
+    assert all(f.error_type == "OSError" for f in failures)
+    assert isinstance(ei.value.__cause__, OSError)
+
+
+def test_recovery_resumes_from_checkpoint_bit_identical(tmp_path):
+    """A training attempt killed mid-run by a retryable failure restarts
+    under the supervisor and, resuming from the checkpoint, produces the
+    exact final models of an uninterrupted run — the full §5.3 story:
+    failure -> restart -> fast-forward -> identical result."""
+    from tests.test_checkpoint import _bundle, _configs, _estimator, _final_arrays
+
+    bundle = _bundle()
+    ref = _estimator().fit(bundle, _bundle(seed=1), _configs())
+
+    ckdir = str(tmp_path / "ck")
+
+    class PreemptedManager(CheckpointManager):
+        """Simulates a host preemption delivered as a runtime error after
+        the Nth coordinate-step snapshot. (Uses its own counter — the base
+        class's ``fail_after`` raises KeyboardInterrupt, which is fatal to
+        the supervisor by design.)"""
+
+        preempt_after = None
+
+        def save(self, step, state, meta=None):
+            super().save(step, state, meta)
+            self.wait()
+            if self.preempt_after is not None and self._saves >= self.preempt_after:
+                raise FlakyRuntime("preempted")
+
+    attempts = []
+
+    def attempt(i):
+        attempts.append(i)
+        # First attempt dies after 3 steps; the retry runs clean. Each
+        # attempt opens its own manager on the shared directory, exactly
+        # like a restarted driver process.
+        mgr = PreemptedManager(ckdir)
+        mgr.preempt_after = 3 if i == 0 else None
+        try:
+            return _estimator().fit(bundle, _bundle(seed=1), _configs(),
+                                    checkpoint_manager=mgr)
+        finally:
+            mgr._queue.put(None)  # stop writer without re-raising
+
+    resumed = run_with_recovery(
+        attempt, RestartPolicy(max_restarts=2, backoff_seconds=0),
+        sleep=lambda s: None,
+    )
+    assert attempts == [0, 1]
+    for a, b in zip(_final_arrays(resumed), _final_arrays(ref)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_driver_max_restarts_flag(tmp_path, monkeypatch):
+    """--max-restarts rides through a transient estimator failure."""
+    from photon_tpu.cli import game_training_driver
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from tests.test_drivers import _write_game_avro
+
+    d = tmp_path / "data"
+    d.mkdir()
+    _write_game_avro(d / "train.avro", seed=1, n_users=4, rows_per_user=12)
+
+    real_fit = GameEstimator.fit
+    state = {"failed": False}
+
+    def flaky_fit(self, *a, **kw):
+        if not state["failed"]:
+            state["failed"] = True
+            raise RuntimeError("transient device hiccup")
+        return real_fit(self, *a, **kw)
+
+    monkeypatch.setattr(GameEstimator, "fit", flaky_fit)
+    summary = game_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--output-dir", str(tmp_path / "out"),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate",
+        "fixed:type=fixed,shard=global,reg=L2,max_iter=5,reg_weights=1",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--max-restarts", "1", "--restart-backoff", "0",
+        "--devices", "1",
+    ])
+    assert state["failed"] and summary["n_configs"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat / peer detection
+
+
+def test_heartbeat_detects_stale_and_missing(tmp_path):
+    hdir = str(tmp_path / "hb")
+    me = Heartbeat(hdir, process_id=0, interval_seconds=0.05)
+    peer = Heartbeat(hdir, process_id=1, interval_seconds=0.05)
+    me.beat_once()
+    peer.beat_once()
+
+    report = me.check_peers([0, 1, 2], max_age_seconds=10.0)
+    assert report.alive == [0, 1]
+    assert report.missing == [2]
+    assert not report.healthy
+
+    # Age out the peer's beat without sleeping: backdate its file mtime.
+    old = time.time() - 60.0
+    os.utime(os.path.join(hdir, "host-1.hb"), (old, old))
+    report = me.check_peers([0, 1], max_age_seconds=1.0)
+    assert report.alive == [0]
+    assert report.dead == [1]
+
+
+def test_heartbeat_background_thread(tmp_path):
+    hdir = str(tmp_path / "hb")
+    with Heartbeat(hdir, process_id=7, interval_seconds=0.02) as hb:
+        time.sleep(0.15)
+    # Several beats happened and the file parses as JSON.
+    import json
+
+    with open(os.path.join(hdir, "host-7.hb")) as f:
+        payload = json.load(f)
+    assert payload["process_id"] == 7
+    assert payload["beats"] >= 2
+    assert hb.check_peers([7], max_age_seconds=30.0).healthy
