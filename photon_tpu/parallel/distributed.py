@@ -64,13 +64,22 @@ def initialize_distributed(
             process_id=process_id,
         )
     except RuntimeError as e:
-        # Only "already initialized/joined" is benign (backend came up before
-        # this call — proceed single-runtime). Anything else — coordinator
-        # unreachable, barrier timeout — must fail LOUD: swallowing it would
-        # let every pod worker silently proceed as an independent single-host
-        # job, training on partial data and clobbering the shared output dir.
+        # Only "double init" / "backend already up" is benign (proceed
+        # single-runtime). jax 0.9.0's exact texts: "distributed.initialize
+        # should only be called once." and "jax.distributed.initialize() must
+        # be called before any JAX calls that might initialise the XLA
+        # backend" ("already" covers other versions' phrasings). Anything
+        # else — coordinator unreachable, barrier timeout — must fail LOUD:
+        # swallowing it would let every pod worker silently proceed as an
+        # independent single-host job, training on partial data and
+        # clobbering the shared output dir.
         msg = str(e).lower()
-        if "already" not in msg:
+        benign = (
+            "only be called once" in msg
+            or "must be called before" in msg
+            or "already" in msg
+        )
+        if not benign:
             raise
         import logging
 

@@ -243,10 +243,19 @@ class Heartbeat:
 
         ``max_age_seconds`` defaults to 3x the beat interval (one missed
         beat is a scheduling blip; three is a dead or wedged host).
+
+        Staleness is judged against OUR OWN heartbeat file's mtime, not the
+        local clock: both timestamps then come from the same clock (the
+        shared filesystem server's), so host-vs-fileserver skew cannot
+        misclassify healthy peers. Falls back to local time if we have not
+        beaten yet.
         """
         if max_age_seconds is None:
             max_age_seconds = 3.0 * self.interval_seconds
-        now = time.time()
+        try:
+            now = os.path.getmtime(self._path(self.process_id))
+        except OSError:
+            now = time.time()
         alive, dead, missing = [], [], []
         for pid in expected:
             try:
