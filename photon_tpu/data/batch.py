@@ -120,21 +120,20 @@ class SparseFeatures:
 
     def with_pallas_path(self) -> "SparseFeatures":
         """Build the Pallas slot tables (host-side, once) and attach them,
-        plus the XLA fast path as the off-TPU fallback. No-op (XLA fast path
-        only) when the dataset exceeds the single-chunk table sizes."""
-        from photon_tpu.ops.pallas_sparse import (
-            PallasSparseAux,
-            build_pallas_aux,
-        )
+        plus the XLA fast path as the off-TPU fallback. Large datasets chunk
+        (512K-row / 256K-feature table slices); no-op (XLA fast path only)
+        if the packed tables would blow the device-memory budget."""
+        from photon_tpu.ops.pallas_sparse import build_pallas_aux
 
         out = self.with_fast_path()
-        if out.pallas is not None or not PallasSparseAux.supports(
-            self.n_rows, self.dim
-        ):
+        if out.pallas is not None:
             return out
-        aux = build_pallas_aux(
-            jax.device_get(self.idx), jax.device_get(self.val), self.dim
-        )
+        try:
+            aux = build_pallas_aux(
+                jax.device_get(self.idx), jax.device_get(self.val), self.dim
+            )
+        except ValueError:  # over the table-memory budget
+            return out
         return dataclasses.replace(out, pallas=aux)
 
     def without_fast_path(self) -> "SparseFeatures":

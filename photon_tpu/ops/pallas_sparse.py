@@ -26,6 +26,16 @@ Design (SURVEY.md §7 hard-part #2, VERDICT round-2 ask #2):
 * Ghost/padding slots carry value 0 and index 0 — they contribute nothing
   and need no masking in the hot loop.
 
+* Datasets larger than one VMEM-resident lookup table (512K rows for the dz
+  table, 256K features for the w table) are CHUNKED: entries are split by
+  row range (rmatvec) / column range (matvec), each chunk packs its own slot
+  tables indexed against its slice of the lookup vector, and the op sums the
+  per-chunk group partials — same kernels, one ``pallas_call`` per chunk.
+  A ``max_table_bytes`` budget bounds total table memory (group padding is
+  per-chunk, so extreme row-chunking of a very wide dataset can inflate it);
+  over budget, construction raises and ``with_pallas_path`` falls back to
+  the XLA fast path.
+
 Layouts ride on ``SparseFeatures.pallas`` (see ``with_pallas_path``); the
 kernels are f32-only and fall back to the XLA path off-TPU (tests run them
 in Pallas interpret mode on CPU).
@@ -68,19 +78,19 @@ class _OpTables:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass(frozen=True)
 class PallasSparseAux:
-    """Static Pallas layouts for both ops of one dataset."""
+    """Static Pallas layouts for both ops of one dataset.
 
-    rmat: _OpTables
-    mat: _OpTables
+    ``rmat``/``mat`` hold one table set per non-empty chunk (row chunks of
+    512K for rmatvec, column chunks of 256K for matvec); ``rmat_chunks`` /
+    ``mat_chunks`` are the matching chunk indices into the dz / w vector
+    (static — chunk boundaries are compile-time slices)."""
+
+    rmat: tuple
+    mat: tuple
+    rmat_chunks: tuple = dataclasses.field(metadata=dict(static=True))
+    mat_chunks: tuple = dataclasses.field(metadata=dict(static=True))
     n_rows: int = dataclasses.field(metadata=dict(static=True))
     dim: int = dataclasses.field(metadata=dict(static=True))
-
-    @staticmethod
-    def supports(n_rows: int, dim: int) -> bool:
-        return (
-            n_rows <= TABLE_SUBLANES["rmatvec"] * LANE
-            and dim <= TABLE_SUBLANES["matvec"] * LANE
-        )
 
 
 def _pack_tables(
@@ -121,24 +131,77 @@ def _pack_tables(
     cg = np.full(total // CHUNK, n_groups, np.int32)     # ghost group at end
     used = np.repeat(np.arange(n_groups, dtype=np.int32), need // CHUNK)
     cg[: len(used)] = used
+    # numpy for now: the caller budget-checks total bytes across all chunks
+    # BEFORE anything is uploaded to device memory.
+    return _OpTables(hi=t_hi, lo=t_lo, val=t_val, chunk_group=cg,
+                     n_groups=n_groups)
+
+
+def _np_bytes(t: _OpTables) -> int:
+    return t.hi.nbytes + t.lo.nbytes + t.val.nbytes + t.chunk_group.nbytes
+
+
+def _to_device(t: _OpTables) -> _OpTables:
     return _OpTables(
-        hi=jnp.asarray(t_hi), lo=jnp.asarray(t_lo), val=jnp.asarray(t_val),
-        chunk_group=jnp.asarray(cg), n_groups=n_groups,
+        hi=jnp.asarray(t.hi), lo=jnp.asarray(t.lo), val=jnp.asarray(t.val),
+        chunk_group=jnp.asarray(t.chunk_group), n_groups=t.n_groups,
     )
 
 
-def build_pallas_aux(idx: np.ndarray, val: np.ndarray, dim: int) -> PallasSparseAux:
+def _chunked_tables(
+    split_key: np.ndarray,      # per entry: chunk index (row or col chunk)
+    chunk_elems: int,           # rows/cols covered by one chunk
+    group: np.ndarray,
+    lane: np.ndarray,
+    hi_global: np.ndarray,      # hi before localizing to the chunk's slice
+    lo: np.ndarray,
+    val: np.ndarray,
+    n_groups: int,
+    block_sublanes: int,
+) -> tuple[list, list]:
+    """Pack one table set per non-empty chunk; ``hi`` is localized to the
+    chunk's slice of the lookup vector (its table sublane index)."""
+    # One stable sort partitions all entries into contiguous chunk runs
+    # (each entry gathered once) instead of a full rescan per chunk.
+    order = np.argsort(split_key, kind="stable")
+    sk = split_key[order]
+    uniq, starts = np.unique(sk, return_index=True)
+    bounds = np.append(starts, len(sk))
+    tables, chunks = [], []
+    for c, lo_i, hi_i in zip(uniq, bounds[:-1], bounds[1:]):
+        sl = order[lo_i:hi_i]
+        tables.append(_pack_tables(
+            group=group[sl], lane=lane[sl],
+            hi=hi_global[sl] - int(c) * (chunk_elems // LANE), lo=lo[sl],
+            val=val[sl], n_groups=n_groups, block_sublanes=block_sublanes,
+        ))
+        chunks.append(int(c))
+    return tables, chunks
+
+
+def build_pallas_aux(
+    idx: np.ndarray, val: np.ndarray, dim: int,
+    max_table_bytes: int = 2 << 30,
+) -> PallasSparseAux:
     """Host-side construction of both directions' tables from ELL arrays
-    (``idx[N, K]`` with ghost column == ``dim``, value 0)."""
+    (``idx[N, K]`` with ghost column == ``dim``, value 0). Datasets beyond
+    one chunk (512K rows / 256K features) split into per-chunk tables;
+    raises ``ValueError`` if the packed tables would exceed
+    ``max_table_bytes`` (callers fall back to the XLA fast path)."""
     idx = np.asarray(idx)
     val = np.asarray(val, np.float32)
     n, k = idx.shape
-    if not PallasSparseAux.supports(n, dim):
-        raise ValueError(
-            f"dataset ({n} rows, {dim} features) exceeds the single-chunk "
-            f"Pallas table sizes ({TABLE_SUBLANES['rmatvec'] * LANE} rows, "
-            f"{TABLE_SUBLANES['matvec'] * LANE} features)"
-        )
+    # Cheap lower bound BEFORE any packing: each real entry occupies one
+    # 12-byte slot (hi+lo+val) in each direction's tables, so a dataset that
+    # cannot fit is rejected in O(1) instead of after two full lexsorts and
+    # multi-GB transient allocations.
+    if 24 * n * k > max_table_bytes * 4:  # k includes ghost padding; x4 slack
+        if 24 * int(np.count_nonzero(idx < dim)) > max_table_bytes:
+            raise ValueError(
+                f"Pallas slot tables need >= 24 bytes/entry x ~{n * k} "
+                f"entries (> {max_table_bytes / 1e9:.2f} GB budget); "
+                "falling back to the XLA fast path"
+            )
     flat = idx.ravel().astype(np.int64)
     keep = flat < dim
     col = flat[keep]
@@ -147,19 +210,36 @@ def build_pallas_aux(idx: np.ndarray, val: np.ndarray, dim: int) -> PallasSparse
 
     n_col_groups = -(-dim // LANE)
     n_row_groups = -(-n // LANE)
-    rmat = _pack_tables(
+    row_chunk_elems = TABLE_SUBLANES["rmatvec"] * LANE
+    col_chunk_elems = TABLE_SUBLANES["matvec"] * LANE
+
+    rmat, rmat_chunks = _chunked_tables(
+        split_key=row // row_chunk_elems, chunk_elems=row_chunk_elems,
         group=(col >> 7), lane=(row & 127).astype(np.int64),
-        hi=(row >> 7).astype(np.int64), lo=(col & 127).astype(np.int64),
+        hi_global=(row >> 7).astype(np.int64), lo=(col & 127).astype(np.int64),
         val=v, n_groups=n_col_groups,
         block_sublanes=TABLE_SUBLANES["rmatvec"],
     )
-    mat = _pack_tables(
+    mat, mat_chunks = _chunked_tables(
+        split_key=col // col_chunk_elems, chunk_elems=col_chunk_elems,
         group=(row >> 7), lane=(col & 127).astype(np.int64),
-        hi=(col >> 7).astype(np.int64), lo=(row & 127).astype(np.int64),
+        hi_global=(col >> 7).astype(np.int64), lo=(row & 127).astype(np.int64),
         val=v, n_groups=n_row_groups,
         block_sublanes=TABLE_SUBLANES["matvec"],
     )
-    return PallasSparseAux(rmat=rmat, mat=mat, n_rows=n, dim=dim)
+    total_bytes = sum(_np_bytes(t) for t in rmat + mat)
+    if total_bytes > max_table_bytes:
+        raise ValueError(
+            f"Pallas slot tables would take {total_bytes / 1e9:.2f} GB "
+            f"(> {max_table_bytes / 1e9:.2f} GB budget) for {n} rows x "
+            f"{dim} features; falling back to the XLA fast path"
+        )
+    return PallasSparseAux(
+        rmat=tuple(_to_device(t) for t in rmat),
+        mat=tuple(_to_device(t) for t in mat),
+        rmat_chunks=tuple(rmat_chunks), mat_chunks=tuple(mat_chunks),
+        n_rows=n, dim=dim,
+    )
 
 
 # ---------------------------------------------------------------- kernels
@@ -221,22 +301,45 @@ def _run_op(tables: _OpTables, vec2: Array, block_sublanes: int,
     )[: tables.n_groups]
 
 
+def _chunk_slice(vec: Array, chunk: int, chunk_elems: int, nb: int) -> Array:
+    """The chunk's slice of the lookup vector, zero-padded to a full
+    [nb, 128] table (static bounds — chunk indices are compile-time)."""
+    lo = chunk * chunk_elems
+    size = min(chunk_elems, vec.shape[0] - lo)
+    piece = jax.lax.slice_in_dim(vec, lo, lo + size, axis=0)
+    return jnp.pad(piece, (0, chunk_elems - size)).reshape(nb, LANE)
+
+
 def rmatvec_pallas(
     aux: PallasSparseAux, dz: Array, square_vals: bool = False,
     interpret: bool = False,
 ) -> Array:
-    """g[c] = Σ entries val·dz[row] (val² with ``square_vals``)."""
+    """g[c] = Σ entries val·dz[row] (val² with ``square_vals``); per-chunk
+    group partials sum across row chunks."""
     nb = TABLE_SUBLANES["rmatvec"]
-    dz2 = jnp.pad(dz.astype(jnp.float32), (0, nb * LANE - aux.n_rows))
-    out = _run_op(aux.rmat, dz2.reshape(nb, LANE), nb, square_vals, interpret)
+    dzf = dz.astype(jnp.float32)
+    out = None
+    for tables, chunk in zip(aux.rmat, aux.rmat_chunks):
+        dz2 = _chunk_slice(dzf, chunk, nb * LANE, nb)
+        part = _run_op(tables, dz2, nb, square_vals, interpret)
+        out = part if out is None else out + part
+    if out is None:  # dataset with zero real entries
+        return jnp.zeros((aux.dim,), jnp.float32)
     return out.reshape(-1)[: aux.dim]
 
 
 def matvec_pallas(
     aux: PallasSparseAux, w: Array, interpret: bool = False
 ) -> Array:
-    """z[r] = Σ entries val·w[col]."""
+    """z[r] = Σ entries val·w[col]; per-chunk row partials sum across
+    column chunks."""
     nb = TABLE_SUBLANES["matvec"]
-    w2 = jnp.pad(w.astype(jnp.float32), (0, nb * LANE - aux.dim))
-    out = _run_op(aux.mat, w2.reshape(nb, LANE), nb, False, interpret)
+    wf = w.astype(jnp.float32)
+    out = None
+    for tables, chunk in zip(aux.mat, aux.mat_chunks):
+        w2 = _chunk_slice(wf, chunk, nb * LANE, nb)
+        part = _run_op(tables, w2, nb, False, interpret)
+        out = part if out is None else out + part
+    if out is None:  # dataset with zero real entries
+        return jnp.zeros((aux.n_rows,), jnp.float32)
     return out.reshape(-1)[: aux.n_rows]

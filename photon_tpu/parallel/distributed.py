@@ -68,16 +68,17 @@ def initialize_distributed(
         # single-runtime). jax 0.9.0's exact texts: "distributed.initialize
         # should only be called once." and "jax.distributed.initialize() must
         # be called before any JAX calls that might initialise the XLA
-        # backend" ("already" covers other versions' phrasings). Anything
-        # else — coordinator unreachable, barrier timeout — must fail LOUD:
-        # swallowing it would let every pod worker silently proceed as an
-        # independent single-host job, training on partial data and
-        # clobbering the shared output dir.
+        # backend". Match those precisely — a looser pattern (e.g. bare
+        # "already") would also swallow genuine coordination failures like
+        # "process already registered". Anything else — coordinator
+        # unreachable, barrier timeout — must fail LOUD: swallowing it would
+        # let every pod worker silently proceed as an independent single-host
+        # job, training on partial data and clobbering the shared output dir.
         msg = str(e).lower()
         benign = (
             "only be called once" in msg
             or "must be called before" in msg
-            or "already" in msg
+            or "already initialized" in msg
         )
         if not benign:
             raise

@@ -12,7 +12,6 @@ import jax.numpy as jnp
 
 from photon_tpu.data.batch import SparseFeatures
 from photon_tpu.ops.pallas_sparse import (
-    PallasSparseAux,
     build_pallas_aux,
     matvec_pallas,
     rmatvec_pallas,
@@ -125,15 +124,21 @@ def test_dispatch_falls_back_off_tpu(monkeypatch):
     assert not sf._use_pallas(jnp.float64)
 
 
-def test_oversize_gracefully_skips(monkeypatch):
-    """An oversize dataset attaches NO Pallas tables (XLA fast path only),
-    and matvec still works; re-attach on an attached one is a no-op."""
-    import photon_tpu.ops.pallas_sparse as ps
-
-    assert not PallasSparseAux.supports(n_rows=4096 * 128 + 1, dim=10)
+def test_over_budget_gracefully_skips(monkeypatch):
+    """A dataset whose packed tables exceed the memory budget attaches NO
+    Pallas tables (XLA fast path only), and matvec still works; re-attach on
+    an attached one is a no-op."""
     rng = np.random.default_rng(7)
     idx, val = _random_ell(rng, 64, 10, 2)
-    monkeypatch.setitem(ps.TABLE_SUBLANES, "rmatvec", 0)  # force "oversize"
+    with pytest.raises(ValueError, match="budget"):
+        build_pallas_aux(idx, val, 10, max_table_bytes=64)
+    import photon_tpu.ops.pallas_sparse as ps
+
+    real_build = ps.build_pallas_aux
+    monkeypatch.setattr(
+        ps, "build_pallas_aux",
+        lambda *a, **kw: real_build(*a, max_table_bytes=64, **kw),
+    )
     sf = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), 10).with_pallas_path()
     assert sf.pallas is None and sf.fast is not None
     w = jnp.ones(10, jnp.float32)
@@ -141,10 +146,94 @@ def test_oversize_gracefully_skips(monkeypatch):
         np.asarray(sf.matvec(w)),
         _dense(idx, val, 10) @ np.ones(10), atol=5e-5,
     )
-    monkeypatch.setitem(ps.TABLE_SUBLANES, "rmatvec", 4096)
+    monkeypatch.setattr(ps, "build_pallas_aux", real_build)
     attached = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), 10).with_pallas_path()
     assert attached.pallas is not None
     assert attached.with_pallas_path() is attached  # no-op re-attach
+
+
+def _shrunk_chunks(monkeypatch, sublanes=8):
+    """Shrink both lookup tables to ``sublanes`` x 128 so small test data
+    spans several chunks (1024 rows / 1024 features per chunk at 8)."""
+    import photon_tpu.ops.pallas_sparse as ps
+
+    monkeypatch.setitem(ps.TABLE_SUBLANES, "rmatvec", sublanes)
+    monkeypatch.setitem(ps.TABLE_SUBLANES, "matvec", sublanes)
+
+
+def test_chunked_kernels_match_dense(monkeypatch):
+    """Datasets beyond one lookup-table chunk split into per-chunk tables
+    whose partials sum to the exact single-chunk result (caps shrunk so a
+    small dataset spans 3 row chunks x 2 column chunks)."""
+    _shrunk_chunks(monkeypatch)
+    rng = np.random.default_rng(11)
+    n, d, k = 2500, 1500, 4
+    idx, val = _random_ell(rng, n, d, k)
+    aux = build_pallas_aux(idx, val, d)
+    assert len(aux.rmat) == 3 and aux.rmat_chunks == (0, 1, 2)
+    assert len(aux.mat) == 2 and aux.mat_chunks == (0, 1)
+    a = _dense(idx, val, d)
+    w = rng.normal(size=d).astype(np.float32)
+    dz = rng.normal(size=n).astype(np.float32)
+    np.testing.assert_allclose(
+        matvec_pallas(aux, jnp.asarray(w), interpret=True), a @ w,
+        rtol=0, atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        rmatvec_pallas(aux, jnp.asarray(dz), interpret=True), a.T @ dz,
+        rtol=0, atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        rmatvec_pallas(aux, jnp.asarray(dz), square_vals=True, interpret=True),
+        _dense(idx, val, d, square=True).T @ dz, rtol=0, atol=2e-4,
+    )
+
+
+def test_chunked_with_empty_middle_chunk(monkeypatch):
+    """A row chunk with no real entries packs no table (and contributes
+    nothing), so skewed row distributions don't pay for empty chunks."""
+    _shrunk_chunks(monkeypatch)
+    rng = np.random.default_rng(12)
+    n, d, k = 3 * 1024, 600, 3
+    idx, val = _random_ell(rng, n, d, k, ghost_frac=0.0)
+    idx[1024:2048] = d        # middle chunk: all ghost
+    val[1024:2048] = 0.0
+    aux = build_pallas_aux(idx, val, d)
+    assert aux.rmat_chunks == (0, 2)
+    a = _dense(idx, val, d)
+    w = rng.normal(size=d).astype(np.float32)
+    dz = rng.normal(size=n).astype(np.float32)
+    np.testing.assert_allclose(
+        matvec_pallas(aux, jnp.asarray(w), interpret=True), a @ w,
+        rtol=0, atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        rmatvec_pallas(aux, jnp.asarray(dz), interpret=True), a.T @ dz,
+        rtol=0, atol=2e-4,
+    )
+
+
+def test_chunked_dispatch_through_sparse_features(monkeypatch):
+    """SparseFeatures routes a multi-chunk dataset through the kernels and
+    matches the plain XLA path."""
+    _shrunk_chunks(monkeypatch)
+    monkeypatch.setenv("PHOTON_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(13)
+    n, d, k = 2100, 1300, 3
+    idx, val = _random_ell(rng, n, d, k)
+    plain = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)
+    fast = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d).with_pallas_path()
+    assert fast.pallas is not None and len(fast.pallas.rmat) > 1
+    w = jnp.asarray(rng.normal(size=d).astype(np.float32))
+    dz = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    np.testing.assert_allclose(
+        np.asarray(fast.matvec(w)), np.asarray(plain.matvec(w)),
+        rtol=0, atol=2e-4,
+    )
+    np.testing.assert_allclose(
+        np.asarray(fast.rmatvec(dz)), np.asarray(plain.rmatvec(dz)),
+        rtol=0, atol=2e-4,
+    )
 
 
 def test_lbfgs_solve_through_pallas_path(monkeypatch):
