@@ -1,0 +1,190 @@
+"""Vector/matrix representation conversions and numeric guards.
+
+Parity: reference ⟦photon-lib/.../util/VectorUtils.scala⟧ /
+⟦MathUtils.scala⟧ / ⟦DoubleRange.scala⟧ (SURVEY.md §2.1 Math/util):
+conversions between sparse and dense vector forms, active-index iteration,
+and the numeric tolerance helpers shared by optimizers and validators.
+
+TPU-first shapes: the interchange formats are the padded-ELL arrays of
+``SparseFeatures`` (``idx[N, K]`` / ``val[N, K]``, ghost column == ``dim``)
+and CSR triples — both static-shape-friendly — rather than per-row pointer
+objects. Everything here is host-side NumPy (construction-time utilities;
+the device hot path lives in ``ops/``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Sequence
+
+import numpy as np
+
+__all__ = [
+    "EPSILON",
+    "is_almost_zero",
+    "all_finite",
+    "DoubleRange",
+    "ell_to_dense",
+    "dense_to_ell",
+    "ell_to_csr",
+    "csr_to_ell",
+    "active_indices",
+    "iter_active",
+]
+
+# The "numerically zero" tolerance for HOST-side double-precision logic
+# (config comparisons, convergence bookkeeping — the reference's MathUtils
+# epsilon role; Breeze is f64). It is far below f32 machine-eps on purpose:
+# device-side f32 round-off tolerances are per-test/per-check, not a global.
+EPSILON = 1e-12
+
+
+def is_almost_zero(x: float, eps: float = EPSILON) -> bool:
+    return abs(float(x)) < eps
+
+
+def all_finite(a) -> bool:
+    """True iff every element is finite (the validators' inner check)."""
+    return bool(np.isfinite(np.asarray(a)).all())
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleRange:
+    """Closed numeric range with validation — the reference's hyperparameter
+    /config range type (⟦DoubleRange.scala⟧)."""
+
+    start: float
+    end: float
+
+    def __post_init__(self):
+        if not (np.isfinite(self.start) and np.isfinite(self.end)):
+            raise ValueError(f"range bounds must be finite: {self}")
+        if self.start > self.end:
+            raise ValueError(f"range start > end: {self}")
+
+    def __contains__(self, x: float) -> bool:
+        return self.start <= x <= self.end
+
+    def clamp(self, x: float) -> float:
+        return min(max(x, self.start), self.end)
+
+    def transform(self, fn) -> "DoubleRange":
+        """Monotone transform of both bounds (e.g. log10 for reg-weight
+        search spaces); a decreasing ``fn`` (e.g. 1/x) swaps them so the
+        result is still a valid range."""
+        a, b = float(fn(self.start)), float(fn(self.end))
+        return DoubleRange(min(a, b), max(a, b))
+
+
+# ---------------------------------------------------------------------------
+# ELL <-> dense <-> CSR
+
+
+def ell_to_dense(idx: np.ndarray, val: np.ndarray, dim: int) -> np.ndarray:
+    """Padded-ELL arrays -> dense ``[N, dim]`` (duplicates accumulate,
+    ghost entries drop). Small-data/debug utility."""
+    idx = np.asarray(idx)
+    val = np.asarray(val)
+    n, k = idx.shape
+    out = np.zeros((n, dim), dtype=val.dtype)
+    rows = np.repeat(np.arange(n), k)
+    flat_i, flat_v = idx.ravel(), val.ravel()
+    keep = flat_i < dim
+    np.add.at(out, (rows[keep], flat_i[keep]), flat_v[keep])
+    return out
+
+
+def _pack_ell(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    n: int,
+    dim: int,
+    counts: np.ndarray,
+    max_nnz: int | None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Shared slot packer: row-major-sorted COO entries -> padded ELL.
+
+    (Same rank-within-row trick as ``io/streaming.py ell_from_triples`` /
+    ``data/batch.py ell_from_rows`` — those serve different contracts:
+    device-array SparseFeatures with intercept insertion, and per-row Python
+    lists. This is the host-NumPy interchange form.)"""
+    k = int(counts.max(initial=0)) if max_nnz is None else max_nnz
+    k = max(k, 1)
+    if counts.max(initial=0) > k:
+        raise ValueError(
+            f"row has {int(counts.max(initial=0))} nonzeros > max_nnz={k}"
+        )
+    idx = np.full((n, k), dim, dtype=np.int32)
+    val = np.zeros((n, k), dtype=np.asarray(vals).dtype)
+    starts = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    slot = np.arange(len(rows)) - starts[:-1][rows]
+    idx[rows, slot] = cols
+    val[rows, slot] = vals
+    return idx, val
+
+
+def dense_to_ell(
+    x: np.ndarray, max_nnz: int | None = None, tol: float = 0.0
+) -> tuple[np.ndarray, np.ndarray, int]:
+    """Dense ``[N, D]`` -> padded ELL ``(idx, val, dim)``; entries with
+    ``|x| <= tol`` are treated as structural zeros. K = max row nnz (or
+    ``max_nnz``; raises if any row exceeds it — silent truncation would
+    corrupt features)."""
+    x = np.asarray(x)
+    n, d = x.shape
+    mask = np.abs(x) > tol
+    rows, cols = np.nonzero(mask)  # row-major sorted
+    idx, val = _pack_ell(
+        rows, cols, x[rows, cols], n, d, mask.sum(axis=1), max_nnz
+    )
+    return idx, val, d
+
+
+def ell_to_csr(
+    idx: np.ndarray, val: np.ndarray, dim: int
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Padded ELL -> CSR ``(indptr[N+1], indices, values)`` with ghost
+    entries dropped (the interchange format for scipy/host tooling)."""
+    idx = np.asarray(idx)
+    val = np.asarray(val)
+    n, k = idx.shape
+    keep = idx < dim
+    counts = keep.sum(axis=1)
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(counts, out=indptr[1:])
+    return indptr, idx[keep].astype(np.int32), val[keep]
+
+
+def csr_to_ell(
+    indptr: np.ndarray,
+    indices: np.ndarray,
+    values: np.ndarray,
+    dim: int,
+    max_nnz: int | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """CSR -> padded ELL ``(idx, val)`` (K = max row nnz or ``max_nnz``)."""
+    indptr = np.asarray(indptr)
+    n = len(indptr) - 1
+    counts = np.diff(indptr)
+    rows = np.repeat(np.arange(n), counts)
+    return _pack_ell(
+        rows, np.asarray(indices), np.asarray(values), n, dim, counts, max_nnz
+    )
+
+
+def active_indices(idx: np.ndarray, dim: int) -> np.ndarray:
+    """Sorted unique feature ids present in the data (the reference's
+    active-index iteration; feeds subspace projection)."""
+    flat = np.asarray(idx).ravel()
+    return np.unique(flat[flat < dim]).astype(np.int32)
+
+
+def iter_active(
+    idx_row: Sequence[int], val_row: Sequence[float], dim: int
+) -> Iterator[tuple[int, float]]:
+    """Iterate one ELL row's real ``(index, value)`` pairs, skipping ghost
+    padding — per-row debug/export convenience."""
+    for i, v in zip(idx_row, val_row):
+        if i < dim:
+            yield int(i), float(v)

@@ -1,0 +1,98 @@
+"""Vector conversion / numeric-guard utilities (photon_tpu/utils/vectors.py
+— reference VectorUtils/MathUtils/DoubleRange parity, SURVEY.md §2.1)."""
+import numpy as np
+import pytest
+
+from photon_tpu.utils.vectors import (
+    DoubleRange,
+    active_indices,
+    all_finite,
+    csr_to_ell,
+    dense_to_ell,
+    ell_to_csr,
+    ell_to_dense,
+    is_almost_zero,
+    iter_active,
+)
+
+
+def _random_ell(rng, n, d, k, ghost_frac=0.25):
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    ghost = rng.random((n, k)) < ghost_frac
+    idx = np.where(ghost, d, idx)
+    val = np.where(idx < d, rng.normal(size=(n, k)), 0.0).astype(np.float32)
+    return idx, val
+
+
+def test_guards():
+    assert is_almost_zero(0.0) and is_almost_zero(1e-13)
+    assert not is_almost_zero(1e-6)
+    assert all_finite([1.0, 2.0]) and not all_finite([1.0, np.nan])
+    assert not all_finite([np.inf])
+
+
+def test_double_range():
+    r = DoubleRange(0.01, 100.0)
+    assert 1.0 in r and 0.001 not in r
+    assert r.clamp(1e5) == 100.0 and r.clamp(1.0) == 1.0
+    lr = r.transform(np.log10)
+    assert lr.start == pytest.approx(-2) and lr.end == pytest.approx(2)
+    # decreasing transforms swap bounds instead of raising
+    inv = r.transform(lambda v: 1 / v)
+    assert inv.start == pytest.approx(0.01) and inv.end == pytest.approx(100.0)
+    with pytest.raises(ValueError):
+        DoubleRange(2.0, 1.0)
+    with pytest.raises(ValueError):
+        DoubleRange(0.0, np.nan)
+
+
+def test_empty_inputs():
+    idx, val, d = dense_to_ell(np.zeros((0, 5)))
+    assert idx.shape == (0, 1) and d == 5
+    idx, val = csr_to_ell(
+        np.zeros(1, np.int64), np.array([], np.int32), np.array([]), 4
+    )
+    assert idx.shape == (0, 1)
+
+
+def test_ell_dense_roundtrip():
+    rng = np.random.default_rng(0)
+    n, d, k = 40, 25, 6
+    idx, val = _random_ell(rng, n, d, k)
+    dense = ell_to_dense(idx, val, d)
+    idx2, val2, d2 = dense_to_ell(dense)
+    assert d2 == d
+    np.testing.assert_allclose(ell_to_dense(idx2, val2, d), dense)
+
+
+def test_dense_to_ell_respects_max_nnz_and_tol():
+    x = np.array([[1.0, 0.0, 1e-9], [2.0, 3.0, 4.0]])
+    with pytest.raises(ValueError, match="nonzeros"):
+        dense_to_ell(x, max_nnz=2)
+    idx, val, d = dense_to_ell(x, tol=1e-6, max_nnz=3)
+    assert d == 3
+    # tiny entry dropped as structural zero
+    assert (idx[0] == np.array([0, 3, 3])).all()
+
+
+def test_ell_csr_roundtrip():
+    rng = np.random.default_rng(1)
+    n, d, k = 30, 20, 5
+    idx, val = _random_ell(rng, n, d, k)
+    indptr, indices, values = ell_to_csr(idx, val, d)
+    assert indptr[-1] == (idx < d).sum()
+    # scipy agreement on the dense picture
+    import scipy.sparse as sp
+
+    a = sp.csr_matrix((values, indices, indptr), shape=(n, d)).toarray()
+    np.testing.assert_allclose(a, ell_to_dense(idx, val, d), atol=1e-6)
+    idx2, val2 = csr_to_ell(indptr, indices, values, d)
+    np.testing.assert_allclose(ell_to_dense(idx2, val2, d), a, atol=1e-6)
+
+
+def test_active_indices_and_iter():
+    idx = np.array([[0, 5, 7], [5, 7, 7]], np.int32)
+    val = np.array([[1.0, 2.0, 0.0], [3.0, 4.0, 5.0]], np.float32)
+    np.testing.assert_array_equal(active_indices(idx, 7), [0, 5])
+    pairs = list(iter_active(idx[0], val[0], 7))
+    assert pairs == [(0, 1.0), (5, 2.0)]
