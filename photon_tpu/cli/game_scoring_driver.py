@@ -53,6 +53,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--uid-column", default="uid")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"],
                    help="scoring precision (float64 enables jax x64)")
+    p.add_argument("--chunk-rows", type=int, default=0,
+                   help="stream the data in chunks of about this many rows: "
+                        "features never fully materialize in host or device "
+                        "memory and scores append to the output as computed "
+                        "(billion-row serve path; 0 = whole-dataset). "
+                        "Evaluators still work - scores/labels/groups are "
+                        "O(rows) scalars and accumulate")
     return p
 
 
@@ -128,12 +135,6 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             ),
             id_tag_columns=sorted(id_tags),
         )
-        with Timed("read data", logger):
-            # Labels are only required when evaluators were requested.
-            bundle = reader.read(args.data, require_labels=suite is not None,
-                                 dtype=_dt)
-        logger.info("scoring %d rows", bundle.n_rows)
-
         transformer = GameTransformer(
             model,
             data_configs,
@@ -141,30 +142,146 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 s: im.intercept_index for s, im in index_maps.items()
             },
         )
+        scores_path = os.path.join(args.output_dir, "scores.avro")
         evaluation = None
-        with Timed("score", logger):
-            if suite:
-                scores, evaluation = transformer.transform_and_evaluate(
-                    bundle, suite
-                )
-            else:
-                scores = transformer.transform(bundle)
-
-        with Timed("save scores", logger):
-            save_scores(
-                os.path.join(args.output_dir, "scores.avro"),
-                np.asarray(scores),
-                uids=bundle.uids,
-                labels=bundle.labels,
+        if args.chunk_rows > 0:
+            n_rows, evaluation = _score_chunked(
+                args, reader, transformer, suite, scores_path, logger, _dt
             )
+        else:
+            with Timed("read data", logger):
+                # Labels are only required when evaluators were requested.
+                bundle = reader.read(
+                    args.data, require_labels=suite is not None, dtype=_dt
+                )
+            logger.info("scoring %d rows", bundle.n_rows)
+            with Timed("score", logger):
+                if suite:
+                    scores, evaluation = transformer.transform_and_evaluate(
+                        bundle, suite
+                    )
+                else:
+                    scores = transformer.transform(bundle)
+            with Timed("save scores", logger):
+                save_scores(
+                    scores_path,
+                    np.asarray(scores),
+                    uids=bundle.uids,
+                    labels=bundle.labels,
+                )
+            n_rows = bundle.n_rows
         summary = {
-            "n_rows": int(bundle.n_rows),
+            "n_rows": int(n_rows),
             "evaluation": dict(evaluation.values) if evaluation else None,
         }
         with open(os.path.join(args.output_dir, "scoring-summary.json"), "w") as f:
             json.dump(summary, f, indent=2)
         logger.info("done: %s", summary)
         return summary
+
+
+def _score_chunked(args, reader, transformer, suite, scores_path, logger, _dt):
+    """Stream → score → append, chunk by chunk (SURVEY.md §3.6 at the
+    billion-row scale the reference serves via executor partitions).
+
+    Features live only for the chunk being scored; rows and per-shard nnz
+    widths are padded to stable shapes so XLA compiles the scoring program
+    once, not per chunk. Falls back to the whole-dataset path when the
+    schema is outside the streaming engine's dialect.
+    """
+    from photon_tpu.io.model_io import ScoresWriter
+    from photon_tpu.io.streaming import StreamingAvroReader, Unsupported
+
+    sr = StreamingAvroReader(
+        reader.index_maps,
+        reader.shard_configs,
+        reader.columns,
+        reader.id_tag_columns,
+        chunk_rows=args.chunk_rows,
+    )
+    n_rows = 0
+    k_targets: dict = {}
+    acc_scores, acc_labels, acc_weights = [], [], []
+    acc_tags: dict = {}
+    with Timed("score (chunked)", logger), ScoresWriter(scores_path) as writer:
+        try:
+            chunks = sr.iter_chunks(
+                args.data, dtype=_dt, require_labels=suite is not None
+            )
+            for chunk in chunks:
+                for s, sf in chunk.features.items():
+                    k_targets[s] = max(k_targets.get(s, 0), sf.idx.shape[1])
+                # Chunks round UP to Avro block boundaries, so pad rows to
+                # the next chunk_rows multiple — a handful of stable shape
+                # buckets instead of one XLA recompile per distinct chunk.
+                n_pad = -(-chunk.n_rows // args.chunk_rows) * args.chunk_rows
+                bundle = chunk.to_bundle(
+                    pad_rows_to=n_pad, pad_nnz_to=k_targets
+                )
+                scores = np.asarray(transformer.transform(bundle))
+                scores = scores[: chunk.n_rows]
+                # bundle.uids/id_tags are already materialized by to_bundle;
+                # slice them instead of re-gathering the dictionaries.
+                writer.append(
+                    scores,
+                    uids=bundle.uids[: chunk.n_rows],
+                    labels=chunk.labels,
+                )
+                if suite:
+                    acc_scores.append(scores)
+                    acc_labels.append(chunk.labels)
+                    acc_weights.append(chunk.weights)
+                    for col in {
+                        ev.group_column
+                        for ev in suite.evaluators
+                        if ev.group_column
+                    }:
+                        acc_tags.setdefault(col, []).append(
+                            bundle.id_tags[col][: chunk.n_rows]
+                        )
+                n_rows += chunk.n_rows
+                logger.info("scored %d rows", n_rows)
+        except Unsupported as e:
+            if n_rows:
+                # A schema dialect change mid-stream after chunks were
+                # already written: restarting per-record would duplicate
+                # scored rows. Fail loud instead.
+                raise
+            logger.info("streaming unsupported (%s); whole-dataset path", e)
+            bundle = reader.read_per_record(
+                args.data, dtype=_dt, require_labels=suite is not None
+            )
+            evaluation = None
+            if suite:
+                scores, evaluation = transformer.transform_and_evaluate(
+                    bundle, suite
+                )
+            else:
+                scores = transformer.transform(bundle)
+            writer.append(
+                np.asarray(scores), uids=bundle.uids, labels=bundle.labels
+            )
+            return bundle.n_rows, evaluation
+
+    evaluation = None
+    if suite and n_rows:
+        import jax.numpy as jnp
+
+        from photon_tpu.estimators.game_estimator import _factorize_group_ids
+
+        gids, ngroups = {}, {}
+        for col, parts in acc_tags.items():
+            gids[col], ngroups[col] = _factorize_group_ids(
+                np.concatenate(parts)
+            )
+        evaluation = suite.evaluate(
+            jnp.asarray(np.concatenate(acc_scores), jnp.float32),
+            jnp.asarray(np.concatenate(acc_labels), jnp.float32),
+            jnp.asarray(np.concatenate(acc_weights), jnp.float32),
+            gids or None,
+            ngroups or None,
+        )
+    return n_rows, evaluation
 
 
 def main() -> None:  # pragma: no cover - console entry

@@ -301,6 +301,90 @@ class Decoder:
 # object container files
 
 
+class ContainerWriter:
+    """Incremental Avro object-container writer: header on open, records
+    appended across calls in sync-marked blocks — the streaming form of
+    :func:`write_container` (chunked scoring writes scores as they are
+    computed instead of materializing every record first)."""
+
+    def __init__(
+        self,
+        path: str,
+        schema: Union[str, Schema],
+        codec: str = "null",
+        block_records: int = 4096,
+        sync: Optional[bytes] = None,
+    ):
+        if codec not in ("null", "deflate"):
+            raise SchemaError(f"unsupported codec {codec!r}")
+        self.schema = parse_schema(schema)
+        self._enc = Encoder(self.schema)
+        self._sync = sync or os.urandom(SYNC_SIZE)
+        self._codec = codec
+        self._block_records = block_records
+        self._block = io.BytesIO()
+        self._count = 0
+        self.n_written = 0
+        self._f = open(path, "wb")
+        self._f.write(MAGIC)
+        meta = {
+            "avro.schema": json.dumps(self.schema).encode(),
+            "avro.codec": codec.encode(),
+        }
+        menc = Encoder({"type": "map", "values": "bytes"})
+        self._f.write(menc.encode(meta))
+        self._f.write(self._sync)
+
+    def _flush_block(self) -> None:
+        if self._count == 0:
+            return
+        payload = self._block.getvalue()
+        if self._codec == "deflate":
+            payload = zlib.compress(payload)[2:-4]  # raw deflate, no hdr/cksum
+        hdr = io.BytesIO()
+        _write_long(hdr, self._count)
+        _write_long(hdr, len(payload))
+        self._f.write(hdr.getvalue())
+        self._f.write(payload)
+        self._f.write(self._sync)
+        self._block.seek(0)
+        self._block.truncate()
+        self._count = 0
+
+    def write(self, rec: Any) -> None:
+        # Roll back on mid-record encode failure (e.g. a union mismatch in a
+        # later field): partial bytes would otherwise poison the block and
+        # corrupt every subsequent record when flushed.
+        start = self._block.tell()
+        try:
+            self._enc.encode(rec, out=self._block)
+        except Exception:
+            self._block.seek(start)
+            self._block.truncate()
+            raise
+        self._count += 1
+        self.n_written += 1
+        if self._count >= self._block_records:
+            self._flush_block()
+
+    def write_many(self, records: Iterable[Any]) -> int:
+        for rec in records:
+            self.write(rec)
+        return self.n_written
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._flush_block()
+            self._f.close()
+            self._f = None
+
+    def __enter__(self) -> "ContainerWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 def write_container(
     path: str,
     schema: Union[str, Schema],
@@ -310,50 +394,8 @@ def write_container(
     sync: Optional[bytes] = None,
 ) -> int:
     """Write an Avro object container file; returns the record count."""
-    schema = parse_schema(schema)
-    enc = Encoder(schema)
-    sync = sync or os.urandom(SYNC_SIZE)
-    if codec not in ("null", "deflate"):
-        raise SchemaError(f"unsupported codec {codec!r}")
-    n_written = 0
-    with open(path, "wb") as f:
-        f.write(MAGIC)
-        meta = {
-            "avro.schema": json.dumps(schema).encode(),
-            "avro.codec": codec.encode(),
-        }
-        menc = Encoder({"type": "map", "values": "bytes"})
-        f.write(menc.encode(meta))
-        f.write(sync)
-
-        block = io.BytesIO()
-        count = 0
-
-        def flush():
-            nonlocal count
-            if count == 0:
-                return
-            payload = block.getvalue()
-            if codec == "deflate":
-                payload = zlib.compress(payload)[2:-4]  # raw deflate, no hdr/cksum
-            hdr = io.BytesIO()
-            _write_long(hdr, count)
-            _write_long(hdr, len(payload))
-            f.write(hdr.getvalue())
-            f.write(payload)
-            f.write(sync)
-            block.seek(0)
-            block.truncate()
-            count = 0
-
-        for rec in records:
-            enc.encode(rec, out=block)
-            count += 1
-            n_written += 1
-            if count >= block_records:
-                flush()
-        flush()
-    return n_written
+    with ContainerWriter(path, schema, codec, block_records, sync) as w:
+        return w.write_many(records)
 
 
 def _stream_varint(f, first: bytes) -> int:

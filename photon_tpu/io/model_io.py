@@ -328,6 +328,56 @@ def _synthetic_random_effect_model(
     )
 
 
+class ScoresWriter:
+    """Streaming ScoringResultAvro writer: append per-chunk score arrays as
+    they are computed (chunked scoring never materializes all rows).
+    ``save_scores`` is the one-shot form."""
+
+    def __init__(self, path: str):
+        from photon_tpu.io.avro import ContainerWriter
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._w = ContainerWriter(path, SCORING_RESULT_AVRO)
+
+    @property
+    def n_written(self) -> int:
+        return self._w.n_written
+
+    def append(self, scores, uids=None, labels=None) -> None:
+        scores = np.asarray(scores, np.float64)
+        n = len(scores)
+        uids = (
+            [None] * n
+            if uids is None
+            else [None if u is None else str(u) for u in uids]
+        )
+        labels = (
+            [None] * n
+            if labels is None
+            else [
+                None if l is None or l != l  # NaN of any float-like type
+                else float(l)
+                for l in labels
+            ]
+        )
+        for i in range(n):
+            self._w.write({
+                "uid": uids[i],
+                "predictionScore": float(scores[i]),
+                "label": labels[i],
+                "metadataMap": None,
+            })
+
+    def close(self) -> None:
+        self._w.close()
+
+    def __enter__(self) -> "ScoresWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 def save_scores(
     path: str,
     scores,
@@ -336,30 +386,8 @@ def save_scores(
 ) -> None:
     """Write per-row scores as ScoringResultAvro — reference
     ⟦ScoreProcessingUtils.saveScoresToHDFS⟧."""
-    scores = np.asarray(scores, np.float64)
-    n = len(scores)
-    uids = [None] * n if uids is None else [None if u is None else str(u) for u in uids]
-    labels = (
-        [None] * n
-        if labels is None
-        else [
-            None if l is None or l != l  # NaN of any float-like type
-            else float(l)
-            for l in labels
-        ]
-    )
-
-    def recs():
-        for i in range(n):
-            yield {
-                "uid": uids[i],
-                "predictionScore": float(scores[i]),
-                "label": labels[i],
-                "metadataMap": None,
-            }
-
-    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    write_container(path, SCORING_RESULT_AVRO, recs())
+    with ScoresWriter(path) as w:
+        w.append(scores, uids=uids, labels=labels)
 
 
 def save_feature_summary(path: str, imap: IndexMap, stats) -> None:

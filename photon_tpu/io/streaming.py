@@ -402,6 +402,63 @@ class GameDataChunk:
     def n_rows(self) -> int:
         return len(self.labels)
 
+    def to_bundle(
+        self,
+        pad_rows_to: int = 0,
+        pad_nnz_to: Optional[Mapping[str, int]] = None,
+    ):
+        """This chunk as a ``GameDataBundle`` (device features, materialized
+        string columns) — the unit of chunked scoring.
+
+        ``pad_rows_to`` / ``pad_nnz_to`` stabilize the jit shapes across
+        chunks (each distinct (rows, K) pair costs one XLA compile): padded
+        rows carry weight 0, ghost features, empty uid/tags; callers slice
+        outputs back to ``n_rows``.
+        """
+        from photon_tpu.io.data_reader import GameDataBundle
+
+        n = self.n_rows
+        n_pad = max(pad_rows_to, n)
+
+        def pad1(a, fill=0.0):
+            return np.pad(a, (0, n_pad - n), constant_values=fill) \
+                if n_pad > n else a
+
+        features = {}
+        for s, sf in self.features.items():
+            k_pad = max((pad_nnz_to or {}).get(s, 0), sf.idx.shape[1])
+            iarr, varr = sf.idx, sf.val
+            if n_pad > n or k_pad > iarr.shape[1]:
+                grown_i = np.full((n_pad, k_pad), sf.dim, np.int32)
+                grown_v = np.zeros((n_pad, k_pad), varr.dtype)
+                grown_i[:n, : iarr.shape[1]] = iarr
+                grown_v[:n, : varr.shape[1]] = varr
+                iarr, varr = grown_i, grown_v
+            import jax.numpy as jnp
+
+            features[s] = SparseFeatures(
+                idx=jnp.asarray(iarr), val=jnp.asarray(varr), dim=sf.dim
+            )
+        weights = self.weights
+        if n_pad > n:
+            weights = np.pad(weights, (0, n_pad - n))  # padded rows weight 0
+        return GameDataBundle(
+            features=features,
+            labels=pad1(self.labels, np.nan),
+            offsets=pad1(self.offsets),
+            weights=weights,
+            uids=np.concatenate([
+                self.uids.materialize(""),
+                np.full(n_pad - n, "", object),
+            ]) if n_pad > n else self.uids.materialize("").astype(object),
+            id_tags={
+                t: np.concatenate([
+                    c.materialize(), np.full(n_pad - n, "", object)
+                ]) if n_pad > n else c.materialize().astype(object)
+                for t, c in self.id_tags.items()
+            },
+        )
+
     def split(self, n_parts: int) -> list["GameDataChunk"]:
         """Contiguous row split for per-device host pre-sharding (the
         reference pre-shards input files across executors; SURVEY.md §2.6)."""
