@@ -265,21 +265,16 @@ def _score_chunked(args, reader, transformer, suite, scores_path, logger, _dt):
 
     evaluation = None
     if suite and n_rows:
-        import jax.numpy as jnp
+        from photon_tpu.estimators.game_transformer import (
+            evaluate_scored_arrays,
+        )
 
-        from photon_tpu.estimators.game_estimator import _factorize_group_ids
-
-        gids, ngroups = {}, {}
-        for col, parts in acc_tags.items():
-            gids[col], ngroups[col] = _factorize_group_ids(
-                np.concatenate(parts)
-            )
-        evaluation = suite.evaluate(
-            jnp.asarray(np.concatenate(acc_scores), jnp.float32),
-            jnp.asarray(np.concatenate(acc_labels), jnp.float32),
-            jnp.asarray(np.concatenate(acc_weights), jnp.float32),
-            gids or None,
-            ngroups or None,
+        evaluation = evaluate_scored_arrays(
+            suite,
+            np.concatenate(acc_scores),
+            np.concatenate(acc_labels),
+            np.concatenate(acc_weights),
+            {col: np.concatenate(parts) for col, parts in acc_tags.items()},
         )
     return n_rows, evaluation
 

@@ -85,19 +85,29 @@ class GameTransformer:
         """Score + evaluate (reference: GameScoringDriver's optional
         evaluator list over the scored data)."""
         scores = self.transform(data)
-        group_cols = {
-            ev.group_column for ev in suite.evaluators if ev.group_column
-        }
-        gids, ngroups = {}, {}
-        for col in group_cols:
-            if col not in data.id_tags:
-                raise ValueError(f"grouped evaluator needs id column {col!r}")
-            gids[col], ngroups[col] = _factorize_group_ids(data.id_tags[col])
-        results = suite.evaluate(
-            scores,
-            jnp.asarray(data.labels, jnp.float32),
-            jnp.asarray(data.weights, jnp.float32),
-            gids or None,
-            ngroups or None,
+        results = evaluate_scored_arrays(
+            suite, scores, data.labels, data.weights, data.id_tags
         )
         return scores, results
+
+
+def evaluate_scored_arrays(
+    suite: EvaluationSuite, scores, labels, weights, id_tags: Mapping
+) -> EvaluationResults:
+    """Evaluate precomputed scores: factorize each grouped evaluator's id
+    column, cast to f32, run the suite. Shared by whole-dataset scoring
+    (above) and the chunked scoring driver (which accumulates these arrays
+    across streamed chunks)."""
+    group_cols = {ev.group_column for ev in suite.evaluators if ev.group_column}
+    gids, ngroups = {}, {}
+    for col in group_cols:
+        if col not in id_tags:
+            raise ValueError(f"grouped evaluator needs id column {col!r}")
+        gids[col], ngroups[col] = _factorize_group_ids(id_tags[col])
+    return suite.evaluate(
+        jnp.asarray(scores, jnp.float32),
+        jnp.asarray(labels, jnp.float32),
+        jnp.asarray(weights, jnp.float32),
+        gids or None,
+        ngroups or None,
+    )
