@@ -139,6 +139,32 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return p
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def _checkpointing(directory: Optional[str]):
+    """Optional CheckpointManager lifecycle: close on success; on failure
+    drain without masking the original error (a leaked writer thread would
+    race a retrying supervisor's fresh manager on the same directory)."""
+    if not directory:
+        yield None
+        return
+    from photon_tpu.checkpoint import CheckpointManager
+
+    mgr = CheckpointManager(directory)
+    try:
+        yield mgr
+    except BaseException:
+        try:
+            mgr.close()
+        except Exception:
+            pass
+        raise
+    else:
+        mgr.close()
+
+
 def _load_or_build_indexes(args, shard_specs, logger):
     shard_cfgs = {
         s.shard: FeatureShardConfig(
@@ -379,11 +405,6 @@ def _run_inner(args, task) -> dict:
         )
 
         if args.tuning:
-            if args.checkpoint_dir:
-                raise ValueError(
-                    "--checkpoint-dir is not supported with --tuning (the GP "
-                    "search loop has no step-level checkpoint path yet)"
-                )
             if not (args.evaluators and validation is not None):
                 raise ValueError("--tuning needs --evaluators and --validation-data")
             if not args.tuning_range:
@@ -403,12 +424,14 @@ def _run_inner(args, task) -> dict:
             for spec in args.tuning_range:
                 cid, lo, hi = spec.split(":")
                 ranges[cid] = (float(lo), float(hi))
-            with Timed("hyperparameter tuning", logger) as fit_timer:
+            with _checkpointing(args.checkpoint_dir) as tuning_ckpt, \
+                    Timed("hyperparameter tuning", logger) as fit_timer:
                 tuning = tune_regularization(
                     estimator, train, validation, configs[0], ranges,
                     n_iterations=args.tuning_iterations,
                     strategy=args.tuning, seed=0,
                     initial_model=initial_model,
+                    checkpoint_manager=tuning_ckpt,
                 )
             logger.info(
                 "tuning best params %s -> %.6g",
@@ -418,35 +441,15 @@ def _run_inner(args, task) -> dict:
             # The best config's model was already trained during the search.
             results = [tuning.best_result]
         else:
-            ckpt = None
-            if args.checkpoint_dir:
-                from photon_tpu.checkpoint import CheckpointManager
-
-                ckpt = CheckpointManager(args.checkpoint_dir)
-            try:
-                with Timed("fit", logger) as fit_timer:
-                    results = estimator.fit(
-                        train,
-                        validation if args.evaluators else None,
-                        configs,
-                        initial_model=initial_model,
-                        checkpoint_manager=ckpt,
-                    )
-                if ckpt is not None:
-                    ckpt.close()
-            except BaseException:
-                # Drain on the failure path too: a retrying supervisor
-                # (--max-restarts) re-enters with a fresh manager on the same
-                # directory; a leaked writer thread would race its GC and the
-                # enqueued last snapshot could land after the retry's
-                # load_latest. Secondary writer errors must not mask the
-                # original failure.
-                if ckpt is not None:
-                    try:
-                        ckpt.close()
-                    except Exception:
-                        pass
-                raise
+            with _checkpointing(args.checkpoint_dir) as ckpt, \
+                    Timed("fit", logger) as fit_timer:
+                results = estimator.fit(
+                    train,
+                    validation if args.evaluators else None,
+                    configs,
+                    initial_model=initial_model,
+                    checkpoint_manager=ckpt,
+                )
 
         suite = (
             EvaluationSuite.parse(args.evaluators) if args.evaluators else None

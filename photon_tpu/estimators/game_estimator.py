@@ -160,6 +160,19 @@ class GameEstimator:
                     f"update sequence names unknown coordinate {cid!r}"
                 )
 
+    def fingerprint_parts(self) -> tuple:
+        """The estimator's training-semantics identity, for checkpoint
+        fingerprints (tuning resume refuses a changed configuration)."""
+        return (
+            self.task,
+            tuple(self.update_sequence),
+            self.n_sweeps,
+            tuple(self.evaluator_specs),
+            self.normalization,
+            sorted((cid, repr(c))
+                   for cid, c in self.coordinate_data_configs.items()),
+        )
+
     # ------------------------------------------------------------------ fit
 
     def fit(
@@ -210,11 +223,12 @@ class GameEstimator:
         if checkpoint_manager is not None:
             import hashlib
 
+            # One identity definition (fingerprint_parts — includes
+            # normalization and data configs) plus the per-call specifics;
+            # the tuning path shares the same parts, so both resume checks
+            # refuse the same configuration changes.
             fingerprint = hashlib.sha256(repr((
-                self.task,
-                tuple(self.update_sequence),
-                self.n_sweeps,
-                tuple(self.evaluator_specs),
+                self.fingerprint_parts(),
                 [sorted((cid, repr(c)) for cid, c in cfg.items())
                  for cfg in configs],
                 data.n_rows,

@@ -50,6 +50,26 @@ class SearchResult:
         return float(self.values[self.best_index])
 
 
+# Trial-level search state for checkpoint/resume: everything the loop needs
+# to continue exactly where it stopped — evaluated trials, the PRNG state,
+# and proposals already drawn but not yet evaluated (so a resumed run
+# evaluates the very same next point the uninterrupted run would have).
+def _trial_state(pts, vals, rng, queue) -> dict:
+    return {
+        "points": [np.asarray(p) for p in pts],
+        "values": [float(v) for v in vals],
+        "rng_state": rng.bit_generator.state,
+        "queue": [np.asarray(q) for q in queue],
+    }
+
+
+def _restore(state, rng, pts, vals, queue) -> None:
+    pts.extend(np.asarray(p) for p in state["points"])
+    vals.extend(float(v) for v in state["values"])
+    queue.extend(np.asarray(q) for q in state["queue"])
+    rng.bit_generator.state = state["rng_state"]
+
+
 @dataclasses.dataclass
 class RandomSearch:
     """Uniform search in the (scaled) range cube — reference ⟦RandomSearch⟧."""
@@ -57,11 +77,28 @@ class RandomSearch:
     rescaling: VectorRescaling
     seed: int = 0
 
-    def search(self, evaluate: EvaluationFunction, n: int) -> SearchResult:
+    def search(
+        self,
+        evaluate: EvaluationFunction,
+        n: int,
+        state: Optional[dict] = None,
+        on_trial=None,
+    ) -> SearchResult:
         rng = np.random.default_rng(self.seed)
-        pts = self.rescaling.sample(rng, n)
-        vals = np.asarray([evaluate(p) for p in pts], float)
-        return SearchResult(pts, vals)
+        pts: list[np.ndarray] = []
+        vals: list[float] = []
+        queue: list[np.ndarray] = []
+        if state is not None:
+            _restore(state, rng, pts, vals, queue)
+        else:
+            queue.extend(self.rescaling.sample(rng, n))
+        while len(pts) < n and queue:
+            p = queue.pop(0)
+            vals.append(float(evaluate(p)))
+            pts.append(p)
+            if on_trial is not None:
+                on_trial(_trial_state(pts, vals, rng, queue), len(pts))
+        return SearchResult(np.stack(pts), np.asarray(vals, float))
 
 
 @dataclasses.dataclass
@@ -90,10 +127,27 @@ class GaussianProcessSearch:
         self._obs_u.append(self.rescaling.to_unit(point_native)[0])
         self._obs_y.append(float(value))
 
-    def search(self, evaluate: EvaluationFunction, n: int) -> SearchResult:
+    def search(
+        self,
+        evaluate: EvaluationFunction,
+        n: int,
+        state: Optional[dict] = None,
+        on_trial=None,
+    ) -> SearchResult:
+        """``state``/``on_trial`` give trial-level checkpoint/resume: every
+        completed trial calls ``on_trial(search_state, trial_index)``; a run
+        restarted with the last saved state replays the history into the GP,
+        restores the PRNG, and evaluates exactly the trials the
+        uninterrupted run would have (bit-identical result — tested)."""
         rng = np.random.default_rng(self.seed)
         pts: list[np.ndarray] = []
         vals: list[float] = []
+        queue: list[np.ndarray] = []
+
+        if state is not None:
+            _restore(state, rng, pts, vals, queue)
+            for p, v in zip(pts, vals):
+                self.observe(p, v)
 
         def run(native: np.ndarray) -> None:
             v = float(evaluate(native))
@@ -104,14 +158,20 @@ class GaussianProcessSearch:
                 "hyperparameter eval %d: %s -> %.6g",
                 len(pts), np.array2string(native, precision=4), v,
             )
+            if on_trial is not None:
+                on_trial(_trial_state(pts, vals, rng, queue), len(pts))
 
-        n_seed = min(self.n_seed, n) if not self._obs_y else min(
-            max(0, self.n_seed - len(self._obs_y)), n
-        )
-        for p in self.rescaling.sample(rng, n_seed):
-            run(p)
+        if state is None:
+            n_seed = min(self.n_seed, n) if not self._obs_y else min(
+                max(0, self.n_seed - len(self._obs_y)), n
+            )
+            queue.extend(self.rescaling.sample(rng, n_seed))
 
         while len(pts) < n:
+            while queue and len(pts) < n:
+                run(queue.pop(0))
+            if len(pts) >= n:
+                break
             u = np.asarray(self._obs_u, float)
             y = np.asarray(self._obs_y, float)
             # Standardize observations for the GP (zero mean unit variance).
@@ -125,6 +185,8 @@ class GaussianProcessSearch:
             cand = rng.random((self.n_candidates, self.rescaling.dim))
             mu, var = predict_mean_var(models, cand)
             ei = expected_improvement(mu, var, best=float(y_n.min()))
-            run(self.rescaling.from_unit(cand[int(np.argmax(ei))][None, :])[0])
+            queue.append(
+                self.rescaling.from_unit(cand[int(np.argmax(ei))][None, :])[0]
+            )
 
         return SearchResult(np.stack(pts), np.asarray(vals, float))

@@ -55,12 +55,22 @@ def tune_regularization(
     strategy: str = "gp",
     seed: int = 0,
     initial_model=None,
+    checkpoint_manager=None,
 ) -> TuningResult:
     """Search per-coordinate reg weights; returns history + best config.
 
     ``reg_ranges``: coordinate id → (min, max) reg weight, searched on log
     scale. The objective is the estimator's primary evaluator on validation
     (negated internally when bigger is better — searches minimize).
+
+    ``checkpoint_manager`` (photon_tpu.checkpoint.CheckpointManager) enables
+    TRIAL-level checkpoint/resume: the search state (evaluated trials, PRNG
+    state, pending proposals) snapshots after every trial, and a restarted
+    call with the same arguments fast-forwards past completed trials and
+    continues with exactly the trials the uninterrupted run would have
+    evaluated (bit-identical history; a mismatched configuration is
+    refused). The per-trial model refits only if the best trial predates
+    the resume point.
     """
     if not estimator.evaluator_specs:
         raise ValueError("estimator needs evaluator_specs for tuning")
@@ -100,7 +110,43 @@ def tune_regularization(
         search = RandomSearch(rescaling, seed=seed)
     else:
         raise ValueError(f"strategy must be 'gp' or 'random', got {strategy!r}")
-    history = search.search(evaluate, n_iterations)
+
+    resume_state, on_trial = None, None
+    if checkpoint_manager is not None:
+        import hashlib
+
+        fingerprint = hashlib.sha256(repr((
+            "tuning", sorted(reg_ranges.items()), n_iterations, strategy,
+            seed, repr(base_config), estimator.fingerprint_parts(),
+        )).encode()).hexdigest()
+        payload = checkpoint_manager.load_latest()
+        if payload is not None:
+            meta = payload.get("meta", {})
+            if (meta.get("kind") != "tuning"
+                    or meta.get("fingerprint") != fingerprint):
+                raise ValueError(
+                    "checkpoint directory holds snapshots from a run with a "
+                    "different configuration; use a fresh --checkpoint-dir"
+                )
+            resume_state = payload["state"]
+
+        def on_trial(state, trial_index):
+            checkpoint_manager.save(
+                trial_index, state,
+                {"kind": "tuning", "fingerprint": fingerprint},
+            )
+
+    history = search.search(
+        evaluate, n_iterations, state=resume_state, on_trial=on_trial
+    )
+    if best["result"] is None or sign * best["result"].evaluation.primary \
+            > history.best_value:
+        # The best trial predates the resume point; one deterministic refit
+        # reproduces its model.
+        best["result"] = estimator.fit(
+            train, validation, [config_for(history.best_point)],
+            initial_model=initial_model,
+        )[0]
     return TuningResult(
         search=history,
         best_config=config_for(history.best_point),

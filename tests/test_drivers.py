@@ -481,3 +481,25 @@ def test_scoring_driver_chunked_matches_whole(game_data, tmp_path):
     log = (tmp_path / "s_chunk" / "photon.log").read_text()
     assert "score (chunked)" in log
     assert log.count("scored ") >= 3
+
+
+def test_tuning_driver_with_checkpoint_dir(game_data, tmp_path):
+    """--tuning now composes with --checkpoint-dir (trial-level snapshots)."""
+    d, _, _ = game_data
+    out = tmp_path / "out"
+    summary = game_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--validation-data", str(d / "val.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate",
+        "fixed:type=fixed,shard=global,reg=L2,max_iter=10,reg_weights=1",
+        "--evaluators", "AUC",
+        "--tuning", "random", "--tuning-iterations", "2",
+        "--tuning-range", "fixed:0.01:10",
+        "--checkpoint-dir", str(tmp_path / "ck"),
+        "--devices", "1",
+    ])
+    assert summary["n_configs"] == 1
+    assert any(n.startswith("step-") for n in os.listdir(tmp_path / "ck"))

@@ -214,3 +214,103 @@ def test_tune_game_regularization(rng):
         train, val, [{**BASE, "fixed": BASE["fixed"].with_reg_weight(1e3)}]
     )[0].evaluation.primary
     assert -result.search.best_value >= heavy - 1e-9
+
+
+class TestSearchCheckpointResume:
+    """Trial-level checkpoint/resume: a search resumed from any saved trial
+    state reproduces the uninterrupted history bit-identically."""
+
+    def _rescaling(self):
+        from photon_tpu.hyperparameter.rescaling import ParamRange, VectorRescaling
+
+        return VectorRescaling([
+            ParamRange("a", 0.01, 100.0, scale="log"),
+            ParamRange("b", -2.0, 2.0, scale="linear"),
+        ])
+
+    @staticmethod
+    def _objective(p):
+        return float((np.log10(p[0]) - 0.3) ** 2 + (p[1] - 0.5) ** 2)
+
+    @pytest.mark.parametrize("strategy", ["gp", "random"])
+    @pytest.mark.parametrize("crash_after", [1, 3, 5])
+    def test_resume_bit_identical(self, strategy, crash_after):
+        from photon_tpu.hyperparameter.search import (
+            GaussianProcessSearch,
+            RandomSearch,
+        )
+
+        cls = GaussianProcessSearch if strategy == "gp" else RandomSearch
+        n = 6
+        ref = cls(self._rescaling(), seed=7).search(self._objective, n)
+
+        states = {}
+        cls(self._rescaling(), seed=7).search(
+            self._objective, n,
+            on_trial=lambda s, i: states.__setitem__(i, s),
+        )
+        resumed = cls(self._rescaling(), seed=7).search(
+            self._objective, n, state=states[crash_after]
+        )
+        np.testing.assert_array_equal(resumed.points, ref.points)
+        np.testing.assert_array_equal(resumed.values, ref.values)
+
+
+def test_tuner_checkpoint_resume(tmp_path):
+    """tune_regularization with a CheckpointManager: crash after trial 2,
+    resume, identical search history; best model present even when the best
+    trial predates the resume."""
+    from photon_tpu.checkpoint import CheckpointManager
+    from photon_tpu.hyperparameter.tuner import tune_regularization
+    from tests.test_checkpoint import _bundle, _configs, _estimator
+
+    bundle, val = _bundle(), _bundle(seed=1)
+    est = _estimator()
+    base = _configs()[0]
+    ranges = {"fixed": (0.01, 10.0), "perUser": (0.01, 10.0)}
+
+    ref = tune_regularization(est, bundle, val, base, ranges,
+                              n_iterations=4, strategy="gp", seed=3)
+
+    class Preempt(RuntimeError):
+        pass
+
+    ckdir = str(tmp_path / "ck")
+
+    class CrashingManager(CheckpointManager):
+        crash_at = None
+
+        def save(self, step, state, meta=None):
+            super().save(step, state, meta)
+            self.wait()
+            if self.crash_at is not None and step >= self.crash_at:
+                raise Preempt(f"simulated preemption at trial {step}")
+
+    mgr = CrashingManager(ckdir)
+    mgr.crash_at = 2
+    with pytest.raises(Preempt):
+        tune_regularization(_estimator(), bundle, val, base, ranges,
+                            n_iterations=4, strategy="gp", seed=3,
+                            checkpoint_manager=mgr)
+    mgr._queue.put(None)
+
+    mgr2 = CheckpointManager(ckdir)
+    resumed = tune_regularization(_estimator(), bundle, val, base, ranges,
+                                  n_iterations=4, strategy="gp", seed=3,
+                                  checkpoint_manager=mgr2)
+    mgr2.close()
+    np.testing.assert_array_equal(resumed.search.points, ref.search.points)
+    np.testing.assert_array_equal(resumed.search.values, ref.search.values)
+    assert resumed.best_result is not None
+    assert resumed.search.best_value == pytest.approx(ref.search.best_value)
+    rb = np.asarray(resumed.best_result.model["fixed"].model.coefficients.means)
+    eb = np.asarray(ref.best_result.model["fixed"].model.coefficients.means)
+    np.testing.assert_array_equal(rb, eb)
+
+    # A changed configuration must be refused, not silently resumed.
+    mgr3 = CheckpointManager(ckdir)
+    with pytest.raises(ValueError, match="different configuration"):
+        tune_regularization(_estimator(), bundle, val, base, ranges,
+                            n_iterations=9, strategy="gp", seed=3,
+                            checkpoint_manager=mgr3)
+    mgr3.close()
