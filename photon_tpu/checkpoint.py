@@ -41,6 +41,14 @@ import numpy as np
 _STEP_RE = re.compile(r"^step-(\d+)$")
 
 
+def run_fingerprint(parts: Any, length: int = 16) -> str:
+    """Stable digest of a run's configuration identity (``repr``-hashed).
+    Shared by every resume surface so refusal semantics cannot drift."""
+    import hashlib
+
+    return hashlib.sha256(repr(parts).encode()).hexdigest()[:length]
+
+
 def _to_host(tree):
     return jax.tree.map(
         lambda x: np.asarray(jax.device_get(x)) if isinstance(x, jax.Array) else x,
@@ -143,3 +151,21 @@ class CheckpointManager:
             except Exception:
                 continue
         return None
+
+    def load_checked(self, kind: str, fingerprint: str) -> Optional[dict]:
+        """``load_latest`` guarded by run identity: a snapshot of a different
+        kind or fingerprint raises instead of silently resuming incompatible
+        state. Pair with ``save(..., meta={'kind': kind,
+        'fingerprint': fingerprint, ...})``."""
+        payload = self.load_latest()
+        if payload is None:
+            return None
+        meta = payload.get("meta", {})
+        if meta.get("kind") != kind or meta.get("fingerprint") != fingerprint:
+            raise ValueError(
+                "checkpoint directory holds snapshots from a run with a "
+                f"different configuration (kind={meta.get('kind')!r}) — "
+                "resuming would silently mix incompatible state; use a "
+                "fresh --checkpoint-dir"
+            )
+        return payload

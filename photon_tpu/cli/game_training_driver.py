@@ -27,6 +27,7 @@ import argparse
 import dataclasses
 import json
 import os
+from contextlib import contextmanager
 from typing import Optional, Sequence
 
 import numpy as np
@@ -136,10 +137,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "each process writes a heartbeat file and restart "
                         "attempts fail fast with the dead-host list instead "
                         "of hanging in a collective (SURVEY.md §5.3)")
+    p.add_argument("--feature-summary", action="store_true",
+                   help="write per-feature summary statistics (mean/var/min/"
+                        "max/nnz) for every shard to <output-dir>/summary/"
+                        "<shard>.avro (reference FeatureSummarizationResultAvro "
+                        "output, SURVEY.md §3.1 feature-summarization stage)")
     return p
-
-
-from contextlib import contextmanager
 
 
 @contextmanager
@@ -373,6 +376,21 @@ def _run_inner(args, task) -> dict:
         with Timed("data validation", logger):
             for shard in needed:
                 sanity_check_data(train.batch(shard), task, vtype)
+
+        if args.feature_summary:
+            from photon_tpu.data.statistics import compute_feature_statistics
+            from photon_tpu.io.model_io import save_feature_summary
+
+            with Timed("feature summarization", logger):
+                for shard in sorted(needed):
+                    stats = compute_feature_statistics(train.batch(shard))
+                    save_feature_summary(
+                        os.path.join(args.output_dir, "summary",
+                                     f"{shard}.avro"),
+                        index_maps[shard], stats,
+                    )
+                    logger.info("feature summary[%s]: %d features", shard,
+                                stats.dim)
 
         initial_model = None
         if args.model_input_dir:

@@ -221,28 +221,21 @@ class GameEstimator:
         results: list[GameFitResult] = []
         start_config, descent_resume, fingerprint = 0, None, None
         if checkpoint_manager is not None:
-            import hashlib
+            from photon_tpu.checkpoint import run_fingerprint
 
             # One identity definition (fingerprint_parts — includes
             # normalization and data configs) plus the per-call specifics;
             # the tuning path shares the same parts, so both resume checks
             # refuse the same configuration changes.
-            fingerprint = hashlib.sha256(repr((
+            fingerprint = run_fingerprint((
                 self.fingerprint_parts(),
                 [sorted((cid, repr(c)) for cid, c in cfg.items())
                  for cfg in configs],
                 data.n_rows,
-            )).encode()).hexdigest()[:16]
-            payload = checkpoint_manager.load_latest()
+            ))
+            payload = checkpoint_manager.load_checked("game_fit", fingerprint)
             if payload is not None:
                 meta = payload["meta"]
-                if meta.get("run_fingerprint") != fingerprint:
-                    raise ValueError(
-                        "checkpoint directory holds snapshots from a run with "
-                        "different configuration (task/coordinates/sweeps/"
-                        "configs/data changed) — resuming would silently mix "
-                        "incompatible state; use a fresh --checkpoint-dir"
-                    )
                 results = list(payload["state"].get("completed_results", []))
                 if meta.get("phase") == "config_done":
                     start_config = meta["config_index"] + 1
@@ -277,8 +270,8 @@ class GameEstimator:
                 checkpointer=checkpoint_manager,
                 resume=descent_resume if i == start_config else None,
                 step_base=i * (steps_per_config + 1),
-                checkpoint_meta={"config_index": i,
-                                 "run_fingerprint": fingerprint},
+                checkpoint_meta={"config_index": i, "kind": "game_fit",
+                                 "fingerprint": fingerprint},
                 extra_state={"completed_results": results},
             )
             descent_resume = None
@@ -293,7 +286,7 @@ class GameEstimator:
                     i * (steps_per_config + 1) + steps_per_config,
                     state={"completed_results": results},
                     meta={"phase": "config_done", "config_index": i,
-                          "run_fingerprint": fingerprint},
+                          "kind": "game_fit", "fingerprint": fingerprint},
                 )
         if checkpoint_manager is not None:
             checkpoint_manager.wait()

@@ -90,8 +90,12 @@ class RandomSearch:
         queue: list[np.ndarray] = []
         if state is not None:
             _restore(state, rng, pts, vals, queue)
-        else:
-            queue.extend(self.rescaling.sample(rng, n))
+        deficit = n - len(pts) - len(queue)
+        if deficit > 0:
+            # Fresh start, or a resume asked for MORE trials than the saved
+            # run: draw the shortfall from the restored generator (the
+            # stream continues deterministically either way).
+            queue.extend(self.rescaling.sample(rng, deficit))
         while len(pts) < n and queue:
             p = queue.pop(0)
             vals.append(float(evaluate(p)))
@@ -146,8 +150,17 @@ class GaussianProcessSearch:
 
         if state is not None:
             _restore(state, rng, pts, vals, queue)
+            # Warm-start observations injected via observe() before the
+            # crashed run are part of the GP posterior; restore them BEFORE
+            # replaying trial observations or the resumed proposals diverge.
+            self._obs_u = [np.asarray(u) for u in state.get("pre_obs_u", [])]
+            self._obs_y = [float(y) for y in state.get("pre_obs_y", [])]
             for p, v in zip(pts, vals):
                 self.observe(p, v)
+        pre_obs_u = [np.asarray(u) for u in self._obs_u[: len(self._obs_u)
+                                                        - len(pts)]]
+        pre_obs_y = [float(y) for y in self._obs_y[: len(self._obs_y)
+                                                   - len(pts)]]
 
         def run(native: np.ndarray) -> None:
             v = float(evaluate(native))
@@ -159,7 +172,10 @@ class GaussianProcessSearch:
                 len(pts), np.array2string(native, precision=4), v,
             )
             if on_trial is not None:
-                on_trial(_trial_state(pts, vals, rng, queue), len(pts))
+                s = _trial_state(pts, vals, rng, queue)
+                s["pre_obs_u"] = pre_obs_u
+                s["pre_obs_y"] = pre_obs_y
+                on_trial(s, len(pts))
 
         if state is None:
             n_seed = min(self.n_seed, n) if not self._obs_y else min(

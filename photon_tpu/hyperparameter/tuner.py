@@ -36,8 +36,9 @@ from photon_tpu.io.data_reader import GameDataBundle
 class TuningResult:
     search: SearchResult
     best_config: GameOptimizationConfiguration
-    # The fully trained result for the best configuration — already fitted
-    # during the search; no refit needed.
+    # The fully trained result for the best configuration. Usually the model
+    # fitted during the search; when the best trial predates a checkpoint
+    # resume, tune_regularization refits it once (deterministically).
     best_result: Optional[GameFitResult] = None
 
     @property
@@ -113,21 +114,14 @@ def tune_regularization(
 
     resume_state, on_trial = None, None
     if checkpoint_manager is not None:
-        import hashlib
+        from photon_tpu.checkpoint import run_fingerprint
 
-        fingerprint = hashlib.sha256(repr((
+        fingerprint = run_fingerprint((
             "tuning", sorted(reg_ranges.items()), n_iterations, strategy,
             seed, repr(base_config), estimator.fingerprint_parts(),
-        )).encode()).hexdigest()
-        payload = checkpoint_manager.load_latest()
+        ))
+        payload = checkpoint_manager.load_checked("tuning", fingerprint)
         if payload is not None:
-            meta = payload.get("meta", {})
-            if (meta.get("kind") != "tuning"
-                    or meta.get("fingerprint") != fingerprint):
-                raise ValueError(
-                    "checkpoint directory holds snapshots from a run with a "
-                    "different configuration; use a fresh --checkpoint-dir"
-                )
             resume_state = payload["state"]
 
         def on_trial(state, trial_index):

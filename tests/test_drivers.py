@@ -503,3 +503,27 @@ def test_tuning_driver_with_checkpoint_dir(game_data, tmp_path):
     ])
     assert summary["n_configs"] == 1
     assert any(n.startswith("step-") for n in os.listdir(tmp_path / "ck"))
+
+
+def test_feature_summary_flag(game_data, tmp_path):
+    """--feature-summary writes per-shard FeatureSummarizationResultAvro."""
+    d, n_train, _ = game_data
+    out = tmp_path / "out"
+    game_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate",
+        "fixed:type=fixed,shard=global,reg=L2,max_iter=5,reg_weights=1",
+        "--feature-summary",
+        "--devices", "1",
+    ])
+    recs = read_records(str(out / "summary" / "global.avro"))
+    assert len(recs) == 5 + 24 + 1  # global + user features + intercept
+    by_name = {(r["featureName"], r["featureTerm"]): r for r in recs}
+    # The intercept column is 1.0 in every row.
+    from photon_tpu.index.index_map import INTERCEPT_NAME, INTERCEPT_TERM
+    icpt = by_name[(INTERCEPT_NAME, INTERCEPT_TERM)]["metrics"]
+    assert icpt["mean"] == pytest.approx(1.0)
+    assert icpt["max"] == pytest.approx(1.0)

@@ -314,3 +314,46 @@ def test_tuner_checkpoint_resume(tmp_path):
                             n_iterations=9, strategy="gp", seed=3,
                             checkpoint_manager=mgr3)
     mgr3.close()
+
+
+def test_gp_resume_preserves_warm_start_observations():
+    """Warm-start observations injected via observe() before the crashed run
+    are part of the resumed GP posterior (bit-identical proposals)."""
+    from photon_tpu.hyperparameter.rescaling import ParamRange, VectorRescaling
+    from photon_tpu.hyperparameter.search import GaussianProcessSearch
+
+    resc = VectorRescaling([ParamRange("a", 0.01, 100.0, scale="log")])
+    obj = lambda p: float((np.log10(p[0]) - 0.5) ** 2)
+
+    def fresh():
+        s = GaussianProcessSearch(resc, seed=11)
+        s.observe(np.array([2.0]), obj(np.array([2.0])))
+        s.observe(np.array([30.0]), obj(np.array([30.0])))
+        return s
+
+    ref = fresh().search(obj, 5)
+    states = {}
+    fresh().search(obj, 5, on_trial=lambda s, i: states.__setitem__(i, s))
+    # Resume from trial 2 on a FRESH object with NO re-injected warm start:
+    # the state itself must carry the pre-observations.
+    resumed = GaussianProcessSearch(resc, seed=11).search(
+        obj, 5, state=states[2]
+    )
+    np.testing.assert_array_equal(resumed.points, ref.points)
+    np.testing.assert_array_equal(resumed.values, ref.values)
+
+
+def test_random_search_resume_with_larger_n():
+    """Resuming with a larger n samples the shortfall instead of silently
+    truncating."""
+    from photon_tpu.hyperparameter.rescaling import ParamRange, VectorRescaling
+    from photon_tpu.hyperparameter.search import RandomSearch
+
+    resc = VectorRescaling([ParamRange("a", 0.0, 1.0, scale="linear")])
+    obj = lambda p: float(p[0])
+    states = {}
+    RandomSearch(resc, seed=5).search(
+        obj, 4, on_trial=lambda s, i: states.__setitem__(i, s)
+    )
+    grown = RandomSearch(resc, seed=5).search(obj, 7, state=states[4])
+    assert len(grown.points) == 7
