@@ -93,7 +93,8 @@ class RandomEffectCoordinate:
     dataset: RandomEffectDataset
     problem: GLMOptimizationProblem
     mesh: Optional[object] = None
-    entity_axis: str = "data"
+    # One mesh axis or a tuple (mesh.AxisSpec; e.g. ("dcn", "data")).
+    entity_axis: "str | tuple" = "data"
     global_reg_mask: Optional[Array] = None
     normalization: Optional[object] = None   # shard-level NormalizationContext
     # Per-bucket PriorDistribution pytrees for incremental training

@@ -21,10 +21,9 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
-
 from photon_tpu.data.random_effect import EntityBucket, RandomEffectDataset
 from photon_tpu.functions.problem import GLMOptimizationProblem
+from photon_tpu.parallel.mesh import axes_size, batch_sharding
 from photon_tpu.optim.base import OptimizerResult
 from photon_tpu.types import TaskType
 
@@ -247,7 +246,8 @@ def train_random_effects(
     dataset: RandomEffectDataset,
     offsets: Array,
     mesh=None,
-    entity_axis: str = "data",
+    entity_axis="data",  # one mesh axis or a tuple (mesh.AxisSpec),
+                         # e.g. ("dcn", "data") on a multi-slice mesh
     global_reg_mask: Optional[Array] = None,
     init_coefs: Optional[Sequence[Array]] = None,
     normalization=None,
@@ -272,7 +272,7 @@ def train_random_effects(
     for b_i, bucket in enumerate(dataset.buckets):
         orig_e = bucket.n_entities
         if mesh is not None:
-            axis_size = mesh.shape[entity_axis]
+            axis_size = axes_size(mesh, entity_axis)
             bucket = _pad_bucket(bucket, axis_size, dataset.n_rows, dataset.global_dim)
 
         p = bucket.local_dim
@@ -309,9 +309,8 @@ def train_random_effects(
             )
 
         if mesh is not None:
-            shard = lambda leaf: jax.device_put(
-                leaf, NamedSharding(mesh, P(entity_axis, *([None] * (leaf.ndim - 1))))
-            )
+            sharding = batch_sharding(mesh, entity_axis)
+            shard = lambda leaf: jax.device_put(leaf, sharding)
             batches = jax.tree.map(shard, batches)
             w0 = shard(w0)
             local_mask = shard(local_mask)

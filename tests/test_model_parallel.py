@@ -285,3 +285,29 @@ def test_estimator_with_model_axis(rng):
     auc2d = est2d.fit(train, val, [BASE])[0].evaluation.values["AUC"]
     auc1d = est1d.fit(train, val, [BASE])[0].evaluation.values["AUC"]
     assert auc2d == pytest.approx(auc1d, abs=5e-3)
+
+
+class TestMultiSliceModelParallel:
+    """3-axis (dcn x data x model) mesh: the at-scale multi-slice deployment
+    shape must match the single-device solve exactly (hierarchical psum over
+    (dcn, data) + sharded optimizer state over model; SURVEY.md §2.6 P1+P3,
+    §5.8)."""
+
+    def test_dcn_data_model_matches_single_device(self, rng, problem):
+        from photon_tpu.parallel.mesh import make_multislice_mesh
+
+        batch = _sparse_problem(rng)
+        m_ref, r_ref = problem.fit(batch, jnp.zeros(batch.dim, jnp.float64))
+        mesh = make_multislice_mesh(
+            n_slices=2, axis_sizes={"data": 2, "model": 2}
+        )
+        m_ms, r_ms = fit_model_parallel(
+            problem, batch, jnp.zeros(batch.dim, jnp.float64), mesh,
+            data_axis=("dcn", "data"),
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_ms.coefficients.means),
+            np.asarray(m_ref.coefficients.means),
+            rtol=0, atol=1e-6,
+        )
+        assert int(r_ms.iterations) == int(r_ref.iterations)

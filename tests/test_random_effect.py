@@ -412,3 +412,21 @@ class TestVectorizedBuilderEquivalence:
                       "row_ids", "proj", "entity_ids"):
                 np.testing.assert_array_equal(
                     np.asarray(getattr(ba, f)), np.asarray(getattr(bb, f)), err_msg=f)
+
+
+def test_multislice_entity_sharding_matches_single_device(rng, problem):
+    """Entities spread over a 2-level (dcn x data) mesh — expert-style
+    sharding across slices x chips — must reproduce the single-device
+    per-entity solves exactly (SURVEY.md §2.6 P2/P6 at multi-slice scale)."""
+    from photon_tpu.parallel.mesh import make_multislice_mesh
+
+    idx, val, labels, keys = _make_entity_data(rng, n_entities=13)
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
+    offsets = jnp.zeros(ds.n_rows)
+    m_single, _ = train_random_effects(problem, ds, offsets)
+    mesh = make_multislice_mesh(n_slices=2, axis_sizes={"data": 4})
+    m_ms, _ = train_random_effects(
+        problem, ds, offsets, mesh=mesh, entity_axis=("dcn", "data"))
+    for a, b in zip(m_single.bucket_coefs, m_ms.bucket_coefs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-8)
