@@ -66,6 +66,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ph_dict_heap_bytes_from.argtypes = [ctypes.c_void_p, i32, i64]
     lib.ph_dict_heap_bytes_from.restype = i64
     lib.ph_get_dict_range.argtypes = [ctypes.c_void_p, i32, i64, P(u8), P(i64)]
+    lib.ph_shard_dict_size.argtypes = [ctypes.c_void_p, i32]
+    lib.ph_shard_dict_size.restype = i64
+    lib.ph_shard_dict_heap_bytes_from.argtypes = [ctypes.c_void_p, i32, i64]
+    lib.ph_shard_dict_heap_bytes_from.restype = i64
+    lib.ph_shard_dict_range.argtypes = [ctypes.c_void_p, i32, i64, P(u8), P(i64)]
     lib.ph_reset_chunk.argtypes = [ctypes.c_void_p]
     return lib
 

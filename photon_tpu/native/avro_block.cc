@@ -209,6 +209,10 @@ struct ShardOut {
   std::vector<int32_t> rows;
   std::vector<int32_t> idx;
   std::vector<double> val;
+  // Index-build ("collect") mode: no table; every decoded feature key
+  // (name\x01term) interns here in first-seen order, no triples emitted.
+  bool collect = false;
+  StrDict keys;
 };
 
 // Scratch for the bag paths: parsed features awaiting probe.
@@ -234,8 +238,30 @@ struct State {
   std::vector<double> cur_num;
   std::vector<int32_t> cur_str;
   std::vector<PendingFeat> pending;
+  std::vector<uint8_t> keybuf;       // scratch for collect-mode key assembly
   char fmtbuf[64];
 };
+
+// Assemble name\x01term into st.keybuf; returns its length.
+int64_t build_feature_key(State& st, const uint8_t* name, int64_t nlen,
+                          const uint8_t* term, int64_t tlen) {
+  st.keybuf.resize((size_t)(nlen + 1 + tlen));
+  std::memcpy(st.keybuf.data(), name, (size_t)nlen);
+  st.keybuf[nlen] = KEY_DELIM;
+  if (tlen) std::memcpy(st.keybuf.data() + nlen + 1, term, (size_t)tlen);
+  return nlen + 1 + tlen;
+}
+
+void collect_feature(State& st, const int32_t* op, int32_t n_sh,
+                     const uint8_t* name, int64_t nlen,
+                     const uint8_t* term, int64_t tlen) {
+  int64_t klen = build_feature_key(st, name, nlen, term, tlen);
+  for (int32_t si = 0; si < n_sh; si++) {
+    ShardOut& sh = st.shards[op[7 + si]];
+    if (sh.collect)
+      sh.keys.intern((const char*)st.keybuf.data(), klen);
+  }
+}
 
 // ---- generic skip driven by the type tree ----
 bool skip_value(const State& st, Reader& r, int32_t o, int depth) {
@@ -424,6 +450,11 @@ bool decode_record(State& st, Reader& r) {
         int32_t nf = t[rec_o + 1];
         bool fast = op[5];
         int32_t n_sh = op[6];
+        bool any_coll = false, any_probe = false;
+        for (int32_t si = 0; si < n_sh; si++) {
+          if (st.shards[op[7 + si]].collect) any_coll = true;
+          else any_probe = true;
+        }
         st.pending.clear();
         while (true) {
           int64_t cnt = r.varint();
@@ -446,13 +477,17 @@ bool decode_record(State& st, Reader& r) {
               } else if (br != 0) { r.fail = true; r.err = E_BADUNION; return false; }
               double v = r.f64();
               if (r.fail) return false;
-              uint64_t h = hash_feature_key(np_, nlen, tp, tlen);
-              for (int32_t si = 0; si < n_sh; si++) {
-                const ShardOut& sh = st.shards[op[7 + si]];
-                if (sh.mask)
-                  __builtin_prefetch(&sh.table[h & sh.mask], 0, 1);
+              if (any_coll)
+                collect_feature(st, op, n_sh, np_, nlen, tp, tlen);
+              if (any_probe) {  // pure-collect ops skip hash/probe entirely
+                uint64_t h = hash_feature_key(np_, nlen, tp, tlen);
+                for (int32_t si = 0; si < n_sh; si++) {
+                  const ShardOut& sh = st.shards[op[7 + si]];
+                  if (sh.mask)
+                    __builtin_prefetch(&sh.table[h & sh.mask], 0, 1);
+                }
+                st.pending.push_back(PendingFeat{h, v});
               }
-              st.pending.push_back(PendingFeat{h, v});
             }
           } else {
             for (int64_t item = 0; item < cnt; item++) {
@@ -480,7 +515,15 @@ bool decode_record(State& st, Reader& r) {
                   if (!skip_value(st, r, fo, 0)) return false;
                 }
               }
-              if (name == nullptr || !have_val) continue;
+              if (name == nullptr) continue;
+              // Index build sees every named feature — including ones with
+              // a null value, which emit no triple but ARE indexed (parity
+              // with the per-record scan).
+              if (any_coll)
+                collect_feature(st, op, n_sh, (const uint8_t*)name, name_len,
+                                (const uint8_t*)(term != nullptr ? term : ""),
+                                term != nullptr ? term_len : 0);
+              if (!have_val || !any_probe) continue;
               uint64_t h = hash_feature_key(
                   (const uint8_t*)name, name_len,
                   (const uint8_t*)(term != nullptr ? term : ""),
@@ -491,6 +534,7 @@ bool decode_record(State& st, Reader& r) {
         }
         for (int32_t si = 0; si < n_sh; si++) {
           ShardOut& sh = st.shards[op[7 + si]];
+          if (sh.collect) continue;  // index build: keys only, no triples
           for (const PendingFeat& pf : st.pending) {
             int32_t col = probe(sh, pf.h);
             if (col >= 0) {
@@ -585,6 +629,10 @@ void* ph_create(
   st->shards.resize(n_shards);
   for (int32_t s = 0; s < n_shards; s++) {
     ShardOut& sh = st->shards[s];
+    if (table_sizes[s] < 0) {  // collect (index-build) mode: no table
+      sh.collect = true;
+      continue;
+    }
     sh.table.resize(table_sizes[s]);
     for (int64_t i = 0; i < table_sizes[s]; i++)
       sh.table[i] = ShardOut::Slot{table_hashes[s][i], table_vals[s][i], 0};
@@ -648,6 +696,25 @@ int64_t ph_dict_heap_bytes_from(void* p, int32_t col, int64_t start) {
 void ph_get_dict_range(void* p, int32_t col, int64_t start, uint8_t* heap,
                        int64_t* offsets) {
   StrDict& d = ((State*)p)->dicts[col];
+  int64_t base = d.offsets[start];
+  int64_t n = (int64_t)d.offsets.size() - 1 - start;
+  std::memcpy(heap, d.heap.data() + base, d.heap.size() - base);
+  for (int64_t i = 0; i <= n; i++) offsets[i] = d.offsets[start + i] - base;
+}
+
+// Collected feature-key dictionaries for collect-mode shards (same range
+// protocol as the string-column dicts; keys are name\x01term bytes in
+// first-seen order, persisting across chunk resets).
+int64_t ph_shard_dict_size(void* p, int32_t shard) {
+  return (int64_t)((State*)p)->shards[shard].keys.offsets.size() - 1;
+}
+int64_t ph_shard_dict_heap_bytes_from(void* p, int32_t shard, int64_t start) {
+  StrDict& d = ((State*)p)->shards[shard].keys;
+  return (int64_t)d.heap.size() - d.offsets[start];
+}
+void ph_shard_dict_range(void* p, int32_t shard, int64_t start, uint8_t* heap,
+                         int64_t* offsets) {
+  StrDict& d = ((State*)p)->shards[shard].keys;
   int64_t base = d.offsets[start];
   int64_t n = (int64_t)d.offsets.size() - 1 - start;
   std::memcpy(heap, d.heap.data() + base, d.heap.size() - base);

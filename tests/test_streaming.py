@@ -307,3 +307,139 @@ class TestReviewRegressions:
         bundle = AvroDataReader({"g": imap}).read(p, require_labels=False)
         assert bundle.n_rows == 0
         assert bundle.features["g"].idx.shape[0] == 0
+
+
+class TestCollectFeatureKeys:
+    """Native index-build (collect) mode vs the per-record scan oracle."""
+
+    def _write(self, tmp_path, n=400, seed=0, name="d.avro", block_records=64):
+        rng = np.random.default_rng(seed)
+        path = tmp_path / name
+        _, records = _make_records(rng, n)
+        write_container(str(path), SCHEMA, records,
+                        block_records=block_records)
+        return str(path)
+
+    def test_matches_per_record_index(self, tmp_path, monkeypatch):
+        from photon_tpu.io import streaming
+        from photon_tpu.io.data_reader import build_index_from_avro
+
+        path = self._write(tmp_path)
+        native_map = build_index_from_avro(path)
+
+        # Force the per-record fallback and compare.
+        monkeypatch.setattr(
+            streaming, "collect_feature_keys",
+            lambda *a, **kw: (_ for _ in ()).throw(Unsupported("forced")),
+        )
+        fallback_map = build_index_from_avro(path)
+        assert len(native_map) == len(fallback_map)
+        assert list(native_map.keys_in_order) == list(fallback_map.keys_in_order)
+        assert native_map.intercept_index == fallback_map.intercept_index
+
+    def test_multi_shard_and_file_shard(self, tmp_path):
+        from photon_tpu.io.streaming import collect_feature_keys
+
+        p1 = self._write(tmp_path, seed=1, name="a.avro")
+        p2 = self._write(tmp_path, seed=2, name="b.avro")
+        keys = collect_feature_keys(
+            [p1, p2],
+            {"g": FeatureShardConfig(("features",)),
+             "g2": FeatureShardConfig(("features",))},
+        )
+        assert keys["g"] == keys["g2"] and len(keys["g"]) > 0
+        # (name, term) pairs round-trip through the \x01 key encoding.
+        names = {nm for nm, _ in keys["g"]}
+        assert names <= {f"f{i}" for i in range(50)} | {"UNKNOWN"}
+        # file_shard=(i, n) scans every n-th file only.
+        only_first = collect_feature_keys(
+            [p1, p2], {"g": FeatureShardConfig(("features",))},
+            file_shard=(0, 2),
+        )
+        direct = collect_feature_keys(
+            p1, {"g": FeatureShardConfig(("features",))})
+        assert only_first["g"] == direct["g"]
+
+    def test_chunk_reset_keeps_keys(self, tmp_path):
+        """Key dictionaries persist across row-buffer resets (constant host
+        memory on billion-row index builds)."""
+        from photon_tpu.io.streaming import collect_feature_keys
+
+        path = self._write(tmp_path, n=600, block_records=32)
+        small = collect_feature_keys(
+            path, {"g": FeatureShardConfig(("features",))},
+            reset_every_rows=64,
+        )
+        big = collect_feature_keys(
+            path, {"g": FeatureShardConfig(("features",))})
+        assert small["g"] == big["g"]
+
+    def test_multi_schema_stream_order_matches_fallback(self, tmp_path,
+                                                        monkeypatch):
+        """Alternating schemas across files must still index in record-stream
+        first-seen order, identical to the per-record scan (a grouped-by-
+        decoder merge would silently misalign column ids between the native
+        and fallback builds)."""
+        from photon_tpu.io import streaming
+        from photon_tpu.io.data_reader import build_index_from_avro
+
+        schema_b = {
+            "type": "record", "name": "Other", "fields": [
+                {"name": "response", "type": "double"},
+                {"name": "features", "type": {"type": "array", "items": {
+                    "type": "record", "name": "FeatureAvro", "fields": [
+                        {"name": "name", "type": "string"},
+                        {"name": "term", "type": ["null", "string"]},
+                        {"name": "value", "type": "double"},
+                    ]}}},
+            ],
+        }
+
+        def rec_b(names):
+            return [{"response": 1.0, "features": [
+                {"name": nm, "term": None, "value": 1.0} for nm in names
+            ]}]
+
+        p1 = self._write(tmp_path, n=5, seed=1, name="a1.avro")
+        p2 = str(tmp_path / "b.avro")
+        write_container(p2, schema_b, rec_b(["zz_new", "f0"]))
+        p3 = self._write(tmp_path, n=5, seed=9, name="c1.avro")
+
+        native_map = build_index_from_avro([p1, p2, p3])
+        monkeypatch.setattr(
+            streaming, "collect_feature_keys",
+            lambda *a, **kw: (_ for _ in ()).throw(Unsupported("forced")),
+        )
+        fallback_map = build_index_from_avro([p1, p2, p3])
+        assert list(native_map.keys_in_order) == list(fallback_map.keys_in_order)
+
+    def test_null_valued_features_are_indexed(self, tmp_path, monkeypatch):
+        """A feature with a null value emits no triple but IS indexed, as in
+        the per-record scan."""
+        from photon_tpu.io import streaming
+        from photon_tpu.io.data_reader import build_index_from_avro
+
+        schema = {
+            "type": "record", "name": "R", "fields": [
+                {"name": "response", "type": "double"},
+                {"name": "features", "type": {"type": "array", "items": {
+                    "type": "record", "name": "F", "fields": [
+                        {"name": "name", "type": "string"},
+                        {"name": "term", "type": ["null", "string"]},
+                        {"name": "value", "type": ["null", "double"]},
+                    ]}}},
+            ],
+        }
+        path = str(tmp_path / "nv.avro")
+        write_container(path, schema, [{"response": 0.0, "features": [
+            {"name": "a", "term": None, "value": 2.0},
+            {"name": "nullval", "term": "t", "value": None},
+        ]}])
+        native_map = build_index_from_avro(path)
+        monkeypatch.setattr(
+            streaming, "collect_feature_keys",
+            lambda *a, **kw: (_ for _ in ()).throw(Unsupported("forced")),
+        )
+        fallback_map = build_index_from_avro(path)
+        assert list(native_map.keys_in_order) == list(fallback_map.keys_in_order)
+        assert native_map.get_index("nullval", "t") >= 0
