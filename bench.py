@@ -282,18 +282,6 @@ def bench_fixed_effect_lbfgs():
     from photon_tpu.types import TaskType
 
     idx, val, labels = _make_data(N_ROWS, DIM, K)
-    sf = SparseFeatures(idx=jnp.asarray(idx), val=jnp.asarray(val), dim=DIM)
-    # Pallas kernels when they actually run on this backend (probed on a toy
-    # op first — an unexpected Mosaic lowering failure must degrade to the
-    # XLA fast path, not kill the bench); XLA fast path otherwise.
-    sf = sf.with_pallas_path() if _pallas_kernels_work() else sf.with_fast_path()
-    use_pallas = sf.pallas is not None   # attach can no-op on oversize data
-    batch = LabeledBatch(
-        features=sf,
-        labels=jnp.asarray(labels),
-        offsets=jnp.zeros((N_ROWS,), jnp.float32),
-        weights=jnp.ones((N_ROWS,), jnp.float32),
-    )
     problem = GLMOptimizationProblem(
         task=TaskType.LOGISTIC_REGRESSION,
         optimizer_type=OptimizerType.LBFGS,
@@ -302,16 +290,42 @@ def bench_fixed_effect_lbfgs():
         reg_weight=1.0,
     )
     w0 = jnp.zeros((DIM,), jnp.float32)
-    run = jax.jit(problem.run)
-    model, result = run(batch, w0)  # compile + warm up
-    np.asarray(result.value)
 
-    t0 = time.perf_counter()
-    model, result = run(batch, w0)
-    np.asarray(model.coefficients.means)
-    np.asarray(result.value)
-    dt = time.perf_counter() - t0
+    def solve(sf):
+        batch = LabeledBatch(
+            features=sf,
+            labels=jnp.asarray(labels),
+            offsets=jnp.zeros((N_ROWS,), jnp.float32),
+            weights=jnp.ones((N_ROWS,), jnp.float32),
+        )
+        run = jax.jit(problem.run)
+        model, result = run(batch, w0)  # compile + warm up
+        np.asarray(result.value)
+        t0 = time.perf_counter()
+        model, result = run(batch, w0)
+        np.asarray(model.coefficients.means)
+        np.asarray(result.value)
+        return time.perf_counter() - t0, result
 
+    # Measure the XLA fast path, and the Pallas kernels where they actually
+    # run (probed on a toy op first — an unexpected Mosaic lowering failure
+    # must degrade, not kill the bench). The HEADLINE is whichever is
+    # faster, with both timings recorded — a kernel must EARN its place,
+    # not win by compiling.
+    base = SparseFeatures(idx=jnp.asarray(idx), val=jnp.asarray(val), dim=DIM)
+    timings = {}
+    dt, result = solve(base.with_fast_path())
+    timings["xla_fast_seconds"] = round(dt, 3)
+    best, best_path = (dt, result), "xla_fast"
+    if _pallas_kernels_work():
+        sf = base.with_pallas_path()
+        if sf.pallas is not None:   # attach can no-op over the table budget
+            dtp, resp = solve(sf)
+            timings["pallas_seconds"] = round(dtp, 3)
+            if dtp < dt:
+                best, best_path = (dtp, resp), "pallas"
+
+    dt, result = best
     iters = int(result.iterations)
     # data_passes is the optimizer's on-device instrumented counter (see
     # OptimizerResult.data_passes) — measured, not derived from a formula;
@@ -325,7 +339,8 @@ def bench_fixed_effect_lbfgs():
         "samples_per_sec": N_ROWS * iters / dt,
         "entries_per_sec": N_ROWS * K * passes / dt,
         "ms_per_iteration": 1e3 * dt / max(iters, 1),
-        "sparse_path": "pallas" if use_pallas else "xla_fast",
+        "sparse_path": best_path,
+        **timings,
     }, (idx, val, labels)
 
 

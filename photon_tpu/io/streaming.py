@@ -528,6 +528,24 @@ def _np_ptr(a: np.ndarray, ctype):
     return a.ctypes.data_as(ctypes.POINTER(ctype))
 
 
+def _read_dict_range(state, index, start, size_fn, bytes_fn, range_fn):
+    """Fetch entries [start, size) of one native StrDict (string column or
+    collect-mode shard keys) as utf-8 strings — the one Python side of the
+    incremental dict-range protocol."""
+    n = size_fn(state, index)
+    if n <= start:
+        return n, []
+    hb = bytes_fn(state, index, start)
+    heap = np.empty(max(hb, 1), np.uint8)
+    offs = np.empty(n - start + 1, np.int64)
+    range_fn(state, index, start, _np_ptr(heap, ctypes.c_uint8),
+             _np_ptr(offs, ctypes.c_int64))
+    raw = heap.tobytes()
+    return n, [
+        raw[offs[i]:offs[i + 1]].decode("utf-8") for i in range(n - start)
+    ]
+
+
 class NativeDecoder:
     """ctypes wrapper around one avro_block.cc State."""
 
@@ -620,21 +638,13 @@ class NativeDecoder:
         out = {}
         for c, name in enumerate(self.program.str_names):
             cache = self._dict_cache.setdefault(name, [])
-            n = self.lib.ph_dict_size(self.state, c)
-            start = len(cache)
-            if n > start:
-                hb = self.lib.ph_dict_heap_bytes_from(self.state, c, start)
-                heap = np.empty(max(hb, 1), np.uint8)
-                offs = np.empty(n - start + 1, np.int64)
-                self.lib.ph_get_dict_range(
-                    self.state, c, start, _np_ptr(heap, ctypes.c_uint8),
-                    _np_ptr(offs, ctypes.c_int64),
-                )
-                raw = heap.tobytes()
-                cache.extend(
-                    raw[offs[i]:offs[i + 1]].decode("utf-8")
-                    for i in range(n - start)
-                )
+            _, new_entries = _read_dict_range(
+                self.state, c, len(cache),
+                self.lib.ph_dict_size,
+                self.lib.ph_dict_heap_bytes_from,
+                self.lib.ph_get_dict_range,
+            )
+            cache.extend(new_entries)
             out[name] = np.array(cache, object)
         return out
 
@@ -738,25 +748,17 @@ def collect_feature_keys(
         # first-seen order even when the schema (hence decoder) alternates
         # between files; keys another decoder saw earlier dedupe here.
         for si, shard in enumerate(dec.program.shard_order):
-            n = lib.ph_shard_dict_size(dec.state, si)
-            start = dec._drained[si]
-            if n <= start:
-                continue
-            hb = lib.ph_shard_dict_heap_bytes_from(dec.state, si, start)
-            heap = np.empty(max(hb, 1), np.uint8)
-            offs = np.empty(n - start + 1, np.int64)
-            lib.ph_shard_dict_range(
-                dec.state, si, start, _np_ptr(heap, ctypes.c_uint8),
-                _np_ptr(offs, ctypes.c_int64),
+            dec._drained[si], new_keys = _read_dict_range(
+                dec.state, si, dec._drained[si],
+                lib.ph_shard_dict_size,
+                lib.ph_shard_dict_heap_bytes_from,
+                lib.ph_shard_dict_range,
             )
-            raw = heap.tobytes()
-            for i in range(n - start):
-                k = raw[offs[i]:offs[i + 1]].decode("utf-8")
+            for k in new_keys:
                 if k not in seen[shard]:
                     seen[shard].add(k)
                     name, _, term = k.partition("\x01")
                     out[shard].append((name, term))
-            dec._drained[si] = n
 
     decoders: dict = {}
     for path in files:

@@ -131,24 +131,6 @@ uint64_t hash64(const uint8_t* key, int64_t len) {
 
 constexpr uint8_t KEY_DELIM = 0x01;  // feature_key's name\x01term delimiter
 
-// Assemble name\x01term on the stack (heap fallback for absurd lengths) and
-// hash it; returns 0 only never (0 is the table's empty sentinel).
-uint64_t hash_feature_key(const uint8_t* name, int64_t nlen,
-                          const uint8_t* term, int64_t tlen) {
-  uint8_t stackbuf[256];
-  int64_t total = nlen + 1 + tlen;
-  std::vector<uint8_t> heap;
-  uint8_t* buf = stackbuf;
-  if (total > (int64_t)sizeof stackbuf) {
-    heap.resize(total);
-    buf = heap.data();
-  }
-  std::memcpy(buf, name, nlen);
-  buf[nlen] = KEY_DELIM;
-  if (tlen) std::memcpy(buf + nlen + 1, term, tlen);
-  uint64_t h = hash64(buf, total);
-  return h == 0 ? 1 : h;
-}
 
 // Alloc-free interning dictionary: open addressing keyed by the shared hash64, values
 // appended to one heap; collisions verified against the heap bytes.
@@ -242,7 +224,9 @@ struct State {
   char fmtbuf[64];
 };
 
-// Assemble name\x01term into st.keybuf; returns its length.
+// Assemble name\x01term into st.keybuf (reused across calls — no per-call
+// allocation once warm); the ONE key-assembly definition shared by the
+// probe hash and collect-mode interning, so the two can never drift.
 int64_t build_feature_key(State& st, const uint8_t* name, int64_t nlen,
                           const uint8_t* term, int64_t tlen) {
   st.keybuf.resize((size_t)(nlen + 1 + tlen));
@@ -250,6 +234,14 @@ int64_t build_feature_key(State& st, const uint8_t* name, int64_t nlen,
   st.keybuf[nlen] = KEY_DELIM;
   if (tlen) std::memcpy(st.keybuf.data() + nlen + 1, term, (size_t)tlen);
   return nlen + 1 + tlen;
+}
+
+// Returns 0 never (0 is the probe table's empty sentinel).
+uint64_t hash_feature_key(State& st, const uint8_t* name, int64_t nlen,
+                          const uint8_t* term, int64_t tlen) {
+  int64_t len = build_feature_key(st, name, nlen, term, tlen);
+  uint64_t h = hash64(st.keybuf.data(), len);
+  return h == 0 ? 1 : h;
 }
 
 void collect_feature(State& st, const int32_t* op, int32_t n_sh,
@@ -480,7 +472,7 @@ bool decode_record(State& st, Reader& r) {
               if (any_coll)
                 collect_feature(st, op, n_sh, np_, nlen, tp, tlen);
               if (any_probe) {  // pure-collect ops skip hash/probe entirely
-                uint64_t h = hash_feature_key(np_, nlen, tp, tlen);
+                uint64_t h = hash_feature_key(st, np_, nlen, tp, tlen);
                 for (int32_t si = 0; si < n_sh; si++) {
                   const ShardOut& sh = st.shards[op[7 + si]];
                   if (sh.mask)
@@ -525,7 +517,7 @@ bool decode_record(State& st, Reader& r) {
                                 term != nullptr ? term_len : 0);
               if (!have_val || !any_probe) continue;
               uint64_t h = hash_feature_key(
-                  (const uint8_t*)name, name_len,
+                  st, (const uint8_t*)name, name_len,
                   (const uint8_t*)(term != nullptr ? term : ""),
                   term != nullptr ? term_len : 0);
               st.pending.push_back(PendingFeat{h, fval});
@@ -686,39 +678,43 @@ void ph_get_shard_triples(void* p, int32_t shard, int32_t* rows, int32_t* idx, d
 // Dictionary snapshots for one string column. The *_range forms fetch only
 // entries [start, size) so per-chunk snapshots cost O(new entries), not
 // O(all entries) — dictionaries grow monotonically across the stream.
-int64_t ph_dict_size(void* p, int32_t col) {
-  return (int64_t)((State*)p)->dicts[col].offsets.size() - 1;
+static int64_t dict_size(const StrDict& d) {
+  return (int64_t)d.offsets.size() - 1;
 }
-int64_t ph_dict_heap_bytes_from(void* p, int32_t col, int64_t start) {
-  StrDict& d = ((State*)p)->dicts[col];
+static int64_t dict_heap_bytes_from(const StrDict& d, int64_t start) {
   return (int64_t)d.heap.size() - d.offsets[start];
 }
-void ph_get_dict_range(void* p, int32_t col, int64_t start, uint8_t* heap,
+static void dict_range(const StrDict& d, int64_t start, uint8_t* heap,
                        int64_t* offsets) {
-  StrDict& d = ((State*)p)->dicts[col];
   int64_t base = d.offsets[start];
   int64_t n = (int64_t)d.offsets.size() - 1 - start;
   std::memcpy(heap, d.heap.data() + base, d.heap.size() - base);
   for (int64_t i = 0; i <= n; i++) offsets[i] = d.offsets[start + i] - base;
 }
 
+int64_t ph_dict_size(void* p, int32_t col) {
+  return dict_size(((State*)p)->dicts[col]);
+}
+int64_t ph_dict_heap_bytes_from(void* p, int32_t col, int64_t start) {
+  return dict_heap_bytes_from(((State*)p)->dicts[col], start);
+}
+void ph_get_dict_range(void* p, int32_t col, int64_t start, uint8_t* heap,
+                       int64_t* offsets) {
+  dict_range(((State*)p)->dicts[col], start, heap, offsets);
+}
+
 // Collected feature-key dictionaries for collect-mode shards (same range
 // protocol as the string-column dicts; keys are name\x01term bytes in
 // first-seen order, persisting across chunk resets).
 int64_t ph_shard_dict_size(void* p, int32_t shard) {
-  return (int64_t)((State*)p)->shards[shard].keys.offsets.size() - 1;
+  return dict_size(((State*)p)->shards[shard].keys);
 }
 int64_t ph_shard_dict_heap_bytes_from(void* p, int32_t shard, int64_t start) {
-  StrDict& d = ((State*)p)->shards[shard].keys;
-  return (int64_t)d.heap.size() - d.offsets[start];
+  return dict_heap_bytes_from(((State*)p)->shards[shard].keys, start);
 }
 void ph_shard_dict_range(void* p, int32_t shard, int64_t start, uint8_t* heap,
                          int64_t* offsets) {
-  StrDict& d = ((State*)p)->shards[shard].keys;
-  int64_t base = d.offsets[start];
-  int64_t n = (int64_t)d.offsets.size() - 1 - start;
-  std::memcpy(heap, d.heap.data() + base, d.heap.size() - base);
-  for (int64_t i = 0; i <= n; i++) offsets[i] = d.offsets[start + i] - base;
+  dict_range(((State*)p)->shards[shard].keys, start, heap, offsets);
 }
 
 // Clear per-chunk row buffers; dictionaries persist across chunks.
