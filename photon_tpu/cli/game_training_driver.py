@@ -137,6 +137,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "each process writes a heartbeat file and restart "
                         "attempts fail fast with the dead-host list instead "
                         "of hanging in a collective (SURVEY.md §5.3)")
+    p.add_argument("--ingest-workers", type=int, default=0,
+                   help="decode input files with this many worker processes "
+                        "(native block decoder per worker, file-sharded; "
+                        "the reference's per-executor-core split decode, "
+                        "SURVEY.md §2.3/§2.6); 0/1 = in-process")
     p.add_argument("--feature-summary", action="store_true",
                    help="write per-feature summary statistics (mean/var/min/"
                         "max/nnz) for every shard to <output-dir>/summary/"
@@ -363,13 +368,30 @@ def _run_inner(args, task) -> dict:
         )
 
         read_dtype = np.float64 if args.dtype == "float64" else np.float32
+
+        def read_data(paths):
+            if args.ingest_workers > 1:
+                from photon_tpu.io.parallel_ingest import read_parallel
+                from photon_tpu.io.streaming import Unsupported
+
+                try:
+                    return read_parallel(
+                        paths, index_maps, shard_cfgs, reader.columns,
+                        id_tags, n_workers=args.ingest_workers,
+                        dtype=read_dtype,
+                    )
+                except Unsupported as e:
+                    logger.info("parallel ingest unavailable (%s); "
+                                "in-process read", e)
+            return reader.read(paths, dtype=read_dtype)
+
         with Timed("read training data", logger) as t:
-            train = reader.read(args.train_data, dtype=read_dtype)
+            train = read_data(args.train_data)
         logger.info("training rows: %d", train.n_rows)
         validation = None
         if args.validation_data:
             with Timed("read validation data", logger):
-                validation = reader.read(args.validation_data, dtype=read_dtype)
+                validation = read_data(args.validation_data)
             logger.info("validation rows: %d", validation.n_rows)
 
         vtype = DataValidationType[args.data_validation]

@@ -1,0 +1,214 @@
+"""Intra-host parallel ingest: worker processes decoding file shards.
+
+Parity: the reference decodes Avro splits on every executor CORE in parallel
+(spark-avro tasks; SURVEY.md §2.3, §2.6 "host-side pre-sharding of input
+files"). Across hosts this rebuild uses one process per host with
+``StreamingAvroReader.iter_chunks(file_shard=...)`` (see
+``parallel/distributed.py``); THIS module is the within-host analog — a
+spawn pool where worker ``w`` of ``n`` block-decodes files ``w::n`` through
+the native decoder and ships columnar chunks back, and the parent reassembles
+them in file order into the same ``GameDataBundle`` an in-process read
+produces (equality-tested).
+
+Design constraints that shape the code:
+
+* Workers must NEVER touch an accelerator backend — on this machine the TPU
+  is a single-client tunnel and a worker claiming it would wedge the chip
+  for everyone (memory: axon-tpu-tunnel-wedge). Workers pin the CPU platform
+  defensively and only ever build NumPy-backed chunks (the streaming decoder
+  path never calls ``jnp.asarray``).
+* Everything crossing the process boundary must pickle: index maps travel as
+  specs (key lists, or the mmap store's directory), chunks as plain
+  numpy-dict payloads with dictionary columns materialized.
+* Chunks are tagged (file_position, sequence) so reassembly preserves the
+  exact global row order of a sequential read regardless of worker timing.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping, Optional, Sequence
+
+import numpy as np
+
+from photon_tpu.index.index_map import (
+    DefaultIndexMap,
+    IndexMap,
+    MmapIndexMap,
+    feature_key,
+)
+
+__all__ = ["read_parallel"]
+
+
+def _index_spec(im: IndexMap):
+    if isinstance(im, MmapIndexMap):
+        return ("mmap", im._dir)
+    try:
+        return ("keys", list(im.keys_in_order))
+    except AttributeError:
+        # feature_key keeps the delimiter for empty terms (the intercept's
+        # key is "(INTERCEPT)\x01") so worker-side lookups stay exact.
+        return ("keys", [
+            feature_key(*im.get_feature(i)) for i in range(len(im))
+        ])
+
+
+def _index_from_spec(spec) -> IndexMap:
+    kind, payload = spec
+    if kind == "mmap":
+        return MmapIndexMap(payload)
+    return DefaultIndexMap(payload)
+
+
+@dataclasses.dataclass
+class _WorkerConfig:
+    """Picklable reader construction recipe."""
+
+    index_specs: dict
+    shard_configs: dict
+    columns: object
+    id_tag_columns: tuple
+    chunk_rows: int
+    capture_uids: bool
+    dtype: str
+    require_labels: bool
+
+
+def _chunk_payload(chunk) -> dict:
+    """GameDataChunk -> picklable numpy dict (dictionaries materialized)."""
+    return {
+        "labels": chunk.labels,
+        "offsets": chunk.offsets,
+        "weights": chunk.weights,
+        "uids": chunk.uids.materialize(""),
+        "id_tags": {t: c.materialize() for t, c in chunk.id_tags.items()},
+        "features": {
+            s: (np.asarray(sf.idx), np.asarray(sf.val), sf.dim)
+            for s, sf in chunk.features.items()
+        },
+    }
+
+
+def _payload_chunk(payload: dict):
+    from photon_tpu.data.batch import SparseFeatures
+    from photon_tpu.io.streaming import DictColumn, GameDataChunk
+
+    def col(values):
+        return DictColumn(np.arange(len(values), dtype=np.int32), values)
+
+    return GameDataChunk(
+        labels=payload["labels"],
+        offsets=payload["offsets"],
+        weights=payload["weights"],
+        uids=col(payload["uids"]),
+        id_tags={t: col(v) for t, v in payload["id_tags"].items()},
+        features={
+            s: SparseFeatures(idx=i, val=v, dim=d)
+            for s, (i, v, d) in payload["features"].items()
+        },
+    )
+
+
+def _worker(args) -> list:
+    """Decode this worker's files; returns [(file_pos, seq, payload), ...]."""
+    cfg, files_with_pos = args
+    # Defensive: a worker must never initialize an accelerator client (the
+    # single-client TPU tunnel would wedge); the decode path is numpy-only
+    # but pin the platform in case anything downstream touches jax.
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    from photon_tpu.io.streaming import StreamingAvroReader
+
+    reader = StreamingAvroReader(
+        {s: _index_from_spec(sp) for s, sp in cfg.index_specs.items()},
+        cfg.shard_configs,
+        cfg.columns,
+        cfg.id_tag_columns,
+        chunk_rows=cfg.chunk_rows,
+        capture_uids=cfg.capture_uids,
+    )
+    out = []
+    for pos, path in files_with_pos:
+        # One iter_chunks per file so every chunk maps to a file position
+        # (chunk boundaries never straddle files) and global row order is
+        # reconstructable.
+        for seq, chunk in enumerate(
+            reader.iter_chunks(
+                [path], dtype=np.dtype(cfg.dtype),
+                require_labels=cfg.require_labels,
+            )
+        ):
+            out.append((pos, seq, _chunk_payload(chunk)))
+    return out
+
+
+def read_parallel(
+    paths,
+    index_maps: Mapping[str, IndexMap],
+    shard_configs: Mapping[str, object],
+    columns=None,
+    id_tag_columns: Sequence[str] = (),
+    n_workers: int = 0,
+    chunk_rows: int = 1 << 20,
+    capture_uids: bool = True,
+    dtype=np.float32,
+    require_labels: bool = True,
+):
+    """Read a multi-file Avro dataset with ``n_workers`` decode processes.
+
+    Returns the same ``GameDataBundle`` (same rows, same order) as
+    ``StreamingAvroReader.read`` — workers are a throughput detail, not a
+    semantics change. ``n_workers <= 1`` stays in-process. Raises
+    ``Unsupported`` (like the streaming reader) when the native decoder or
+    schema dialect is unavailable.
+    """
+    from photon_tpu import native
+    from photon_tpu.io.data_reader import InputColumnNames, _expand_paths
+    from photon_tpu.io.streaming import (
+        StreamingAvroReader,
+        Unsupported,
+        chunks_to_bundle,
+    )
+
+    if native.get_lib() is None:
+        # Fail BEFORE spawning a pool: every worker would only start a full
+        # interpreter to discover the same thing.
+        raise Unsupported("native decoder unavailable")
+    columns = columns or InputColumnNames()
+    files = _expand_paths(paths)
+    n_workers = min(int(n_workers), len(files))
+    if n_workers <= 1:
+        return StreamingAvroReader(
+            index_maps, shard_configs, columns, id_tag_columns,
+            chunk_rows=chunk_rows, capture_uids=capture_uids,
+        ).read(files, dtype=dtype, require_labels=require_labels)
+
+    cfg = _WorkerConfig(
+        index_specs={s: _index_spec(m) for s, m in index_maps.items()},
+        shard_configs=dict(shard_configs),
+        columns=columns,
+        id_tag_columns=tuple(id_tag_columns),
+        chunk_rows=chunk_rows,
+        capture_uids=capture_uids,
+        dtype=np.dtype(dtype).name,
+        require_labels=require_labels,
+    )
+    jobs = [
+        (cfg, [(pos, f) for pos, f in enumerate(files) if pos % n_workers == w])
+        for w in range(n_workers)
+    ]
+    # spawn, not fork: fork after JAX initialization can deadlock.
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    with ctx.Pool(n_workers) as pool:
+        results = pool.map(_worker, jobs)
+    tagged = [item for worker_items in results for item in worker_items]
+    tagged.sort(key=lambda t: (t[0], t[1]))
+    chunks = [_payload_chunk(p) for _, _, p in tagged]
+    return chunks_to_bundle(chunks, index_maps, id_tag_columns, dtype)

@@ -918,58 +918,71 @@ class StreamingAvroReader:
     def read(self, paths, dtype=np.float32, require_labels: bool = True):
         """Concatenate all chunks into a GameDataBundle (AvroDataReader-
         compatible output, streaming-speed decode)."""
-        import jax.numpy as jnp
-
-        from photon_tpu.io.data_reader import GameDataBundle
-
-        chunks = list(self.iter_chunks(paths, dtype, require_labels))
-        if not chunks:
-            # Valid zero-record dataset (e.g. an empty scoring partition):
-            # an empty bundle, like the per-record reader.
-            empty = np.zeros(0, np.float64)
-            return GameDataBundle(
-                features={
-                    s: SparseFeatures(
-                        idx=jnp.full((0, 1), len(m), jnp.int32),
-                        val=jnp.zeros((0, 1), np.dtype(dtype)),
-                        dim=len(m),
-                    )
-                    for s, m in self.index_maps.items()
-                },
-                labels=empty, offsets=empty, weights=empty,
-                uids=np.zeros(0, object),
-                id_tags={t: np.zeros(0, object) for t in self.id_tag_columns},
-            )
-        n = sum(c.n_rows for c in chunks)
-        labels = np.concatenate([c.labels for c in chunks])
-        offsets = np.concatenate([c.offsets for c in chunks])
-        weights = np.concatenate([c.weights for c in chunks])
-        uids = np.concatenate([c.uids.materialize("") for c in chunks])
-        id_tags = {
-            t: np.concatenate([c.id_tags[t].materialize() for c in chunks])
-            for t in self.id_tag_columns
-        }
-        features = {}
-        for shard in self.index_maps:
-            dim = len(self.index_maps[shard])
-            k = max(c.features[shard].idx.shape[1] for c in chunks)
-            iarr = np.full((n, k), dim, np.int32)
-            varr = np.zeros((n, k), np.dtype(dtype))
-            at = 0
-            for c in chunks:
-                sf = c.features[shard]
-                m, kk = sf.idx.shape
-                iarr[at:at + m, :kk] = sf.idx
-                varr[at:at + m, :kk] = sf.val
-                at += m
-            features[shard] = SparseFeatures(
-                idx=jnp.asarray(iarr), val=jnp.asarray(varr), dim=dim
-            )
-        return GameDataBundle(
-            features=features,
-            labels=labels,
-            offsets=offsets,
-            weights=weights,
-            uids=uids.astype(object),
-            id_tags=id_tags,
+        return chunks_to_bundle(
+            list(self.iter_chunks(paths, dtype, require_labels)),
+            self.index_maps, self.id_tag_columns, dtype,
         )
+
+
+def chunks_to_bundle(
+    chunks: Sequence[GameDataChunk],
+    index_maps: Mapping[str, IndexMap],
+    id_tag_columns: Sequence[str],
+    dtype=np.float32,
+):
+    """Concatenate streamed chunks (in order) into one GameDataBundle —
+    shared by in-process reads and the parallel-ingest reassembly."""
+    import jax.numpy as jnp
+
+    from photon_tpu.io.data_reader import GameDataBundle
+
+    if not chunks:
+        # Valid zero-record dataset (e.g. an empty scoring partition):
+        # an empty bundle, like the per-record reader.
+        empty = np.zeros(0, np.float64)
+        return GameDataBundle(
+            features={
+                s: SparseFeatures(
+                    idx=jnp.full((0, 1), len(m), jnp.int32),
+                    val=jnp.zeros((0, 1), np.dtype(dtype)),
+                    dim=len(m),
+                )
+                for s, m in index_maps.items()
+            },
+            labels=empty, offsets=empty, weights=empty,
+            uids=np.zeros(0, object),
+            id_tags={t: np.zeros(0, object) for t in id_tag_columns},
+        )
+    n = sum(c.n_rows for c in chunks)
+    labels = np.concatenate([c.labels for c in chunks])
+    offsets = np.concatenate([c.offsets for c in chunks])
+    weights = np.concatenate([c.weights for c in chunks])
+    uids = np.concatenate([c.uids.materialize("") for c in chunks])
+    id_tags = {
+        t: np.concatenate([c.id_tags[t].materialize() for c in chunks])
+        for t in id_tag_columns
+    }
+    features = {}
+    for shard in index_maps:
+        dim = len(index_maps[shard])
+        k = max(c.features[shard].idx.shape[1] for c in chunks)
+        iarr = np.full((n, k), dim, np.int32)
+        varr = np.zeros((n, k), np.dtype(dtype))
+        at = 0
+        for c in chunks:
+            sf = c.features[shard]
+            m, kk = sf.idx.shape
+            iarr[at:at + m, :kk] = sf.idx
+            varr[at:at + m, :kk] = sf.val
+            at += m
+        features[shard] = SparseFeatures(
+            idx=jnp.asarray(iarr), val=jnp.asarray(varr), dim=dim
+        )
+    return GameDataBundle(
+        features=features,
+        labels=labels,
+        offsets=offsets,
+        weights=weights,
+        uids=uids.astype(object),
+        id_tags=id_tags,
+    )

@@ -527,3 +527,36 @@ def test_feature_summary_flag(game_data, tmp_path):
     icpt = by_name[(INTERCEPT_NAME, INTERCEPT_TERM)]["metrics"]
     assert icpt["mean"] == pytest.approx(1.0)
     assert icpt["max"] == pytest.approx(1.0)
+
+
+def test_ingest_workers_flag(game_data, tmp_path):
+    """--ingest-workers decodes with worker processes; summary identical to
+    the in-process read."""
+    from photon_tpu import native
+
+    if native.get_lib() is None:
+        pytest.skip("native decoder unavailable")
+    d, n_train, _ = game_data
+    args = [
+        "--train-data", str(d / "train.avro"), str(d / "val.avro"),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate",
+        "fixed:type=fixed,shard=global,reg=L2,max_iter=8,reg_weights=1",
+        "--devices", "1",
+    ]
+    s1 = game_training_driver.run(
+        args + ["--output-dir", str(tmp_path / "o1")])
+    s2 = game_training_driver.run(
+        args + ["--output-dir", str(tmp_path / "o2"), "--ingest-workers", "2"])
+    from photon_tpu.io.model_io import load_game_model
+    from photon_tpu.index.index_map import MmapIndexMap
+
+    m1, _ = load_game_model(str(tmp_path / "o1" / "best"),
+                            {"global": MmapIndexMap(str(tmp_path / "o1" / "index" / "global"))})
+    m2, _ = load_game_model(str(tmp_path / "o2" / "best"),
+                            {"global": MmapIndexMap(str(tmp_path / "o2" / "index" / "global"))})
+    np.testing.assert_array_equal(
+        np.asarray(m1["fixed"].model.coefficients.means),
+        np.asarray(m2["fixed"].model.coefficients.means),
+    )

@@ -517,3 +517,50 @@ class TestMalformedInput:
         bad.write_bytes(bytes(raw))
         with pytest.raises(SchemaError):
             self._reader(_index(feat_names)).read(str(bad))
+
+
+class TestParallelIngest:
+    """Worker-process decode must be a pure throughput detail: identical
+    bundle (rows, order, features, tags) to the in-process read."""
+
+    def test_matches_in_process_read(self, tmp_path, rng):
+        from photon_tpu.io.parallel_ingest import read_parallel
+
+        feat_names, records = _make_records(rng, n=300)
+        paths = []
+        for i in range(4):   # 4 files, odd sizes, mixed codecs
+            p = str(tmp_path / f"part-{i}.avro")
+            lo, hi = i * 75, (i + 1) * 75
+            write_container(p, SCHEMA, records[lo:hi],
+                            codec="deflate" if i % 2 else "null",
+                            block_records=32)
+            paths.append(p)
+        imap = _index(feat_names)
+        cfg = {"g": FeatureShardConfig()}
+        ref = StreamingAvroReader(
+            {"g": imap}, cfg, InputColumnNames(), ("userId",),
+        ).read(paths)
+        par = read_parallel(
+            paths, {"g": imap}, cfg, InputColumnNames(), ("userId",),
+            n_workers=2, chunk_rows=50,
+        )
+        np.testing.assert_array_equal(par.labels, ref.labels)
+        np.testing.assert_array_equal(par.offsets, ref.offsets)
+        np.testing.assert_array_equal(par.weights, ref.weights)
+        assert list(par.uids) == list(ref.uids)
+        assert list(par.id_tags["userId"]) == list(ref.id_tags["userId"])
+        np.testing.assert_allclose(
+            _dense(par.features["g"]), _dense(ref.features["g"]), atol=1e-12
+        )
+
+    def test_single_worker_falls_through(self, tmp_path, rng):
+        from photon_tpu.io.parallel_ingest import read_parallel
+
+        feat_names, records = _make_records(rng, n=40)
+        p = str(tmp_path / "one.avro")
+        write_container(p, SCHEMA, records)
+        b = read_parallel(
+            p, {"g": _index(feat_names)}, {"g": FeatureShardConfig()},
+            n_workers=8,   # more workers than files -> clamps, stays simple
+        )
+        assert b.n_rows == 40
