@@ -679,11 +679,48 @@ def bench_ingest():
         t0 = time.perf_counter()
         rows = sum(c.n_rows for c in sr.iter_chunks(path))
         best = min(best, time.perf_counter() - t0)
-    return {
+    out = {
         "ingest_rows_per_sec": round(rows / best, 1),
         "ingest_mb_per_sec": round(os.path.getsize(path) / best / 1e6, 1),
         "ingest_nnz_per_row": k,
     }
+
+    # Worker-process scaling (io/parallel_ingest) — only meaningful with
+    # real cores; a 1-core box records the count and skips the claim.
+    cores = os.cpu_count() or 1
+    out["ingest_host_cores"] = cores
+    if cores >= 2:
+        from photon_tpu.io.parallel_ingest import read_parallel
+
+        # Split the cached file into per-worker shards once.
+        w = min(4, cores)
+        shard_paths = [path.replace(".avro", f".w{i}.avro") for i in range(w)]
+        if not all(os.path.exists(p) for p in shard_paths):
+            from photon_tpu.io.avro import read_container
+
+            schema2, it = read_container(path)
+            recs = list(it)
+            per = -(-len(recs) // w)
+            for i, p in enumerate(shard_paths):
+                write_container(p + ".tmp", schema2,
+                                recs[i * per:(i + 1) * per],
+                                block_records=4096)
+                os.replace(p + ".tmp", p)
+        # Best-of-2 (file cache warm, like the sequential number). Each call
+        # spawns its own pool, so per-worker interpreter startup is PART of
+        # the recorded cost — that is what one read_parallel call really
+        # pays; at real dataset sizes it amortizes to noise.
+        best_p = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            bundle = read_parallel(
+                shard_paths, {"g": imap}, {"g": FeatureShardConfig()},
+                InputColumnNames(), (), n_workers=w, capture_uids=False,
+            )
+            best_p = min(best_p, time.perf_counter() - t0)
+        out["ingest_parallel_workers"] = w
+        out["ingest_parallel_rows_per_sec"] = round(bundle.n_rows / best_p, 1)
+    return out
 
 
 def main():

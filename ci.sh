@@ -27,6 +27,10 @@ python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok'
 echo "== entry compile check =="
 python -c "
 import jax
+# Hermetic CI: pin the CPU backend (this image's sitecustomize overrides
+# the JAX_PLATFORMS env var with 'axon,cpu', and CI must not depend on -
+# or wedge behind - the real chip's tunnel).
+jax.config.update('jax_platforms', 'cpu')
 import __graft_entry__ as g
 fn, args = g.entry()
 out = jax.jit(fn)(*args)

@@ -75,13 +75,16 @@ class _WorkerConfig:
     require_labels: bool
 
 
-def _chunk_payload(chunk) -> dict:
-    """GameDataChunk -> picklable numpy dict (dictionaries materialized)."""
+def _chunk_payload(chunk, capture_uids: bool) -> dict:
+    """GameDataChunk -> picklable numpy dict (dictionaries materialized).
+    With ``capture_uids=False`` the uid column is all defaults — ship None
+    instead of n_rows empty-string objects."""
     return {
+        "n": chunk.n_rows,
         "labels": chunk.labels,
         "offsets": chunk.offsets,
         "weights": chunk.weights,
-        "uids": chunk.uids.materialize(""),
+        "uids": chunk.uids.materialize("") if capture_uids else None,
         "id_tags": {t: c.materialize() for t, c in chunk.id_tags.items()},
         "features": {
             s: (np.asarray(sf.idx), np.asarray(sf.val), sf.dim)
@@ -97,11 +100,18 @@ def _payload_chunk(payload: dict):
     def col(values):
         return DictColumn(np.arange(len(values), dtype=np.int32), values)
 
+    uids = payload["uids"]
+    if uids is None:  # capture_uids=False: all-default column
+        uids = DictColumn(
+            np.full(payload["n"], -1, np.int32), np.zeros(0, object)
+        )
+    else:
+        uids = col(uids)
     return GameDataChunk(
         labels=payload["labels"],
         offsets=payload["offsets"],
         weights=payload["weights"],
-        uids=col(payload["uids"]),
+        uids=uids,
         id_tags={t: col(v) for t, v in payload["id_tags"].items()},
         features={
             s: SparseFeatures(idx=i, val=v, dim=d)
@@ -110,41 +120,45 @@ def _payload_chunk(payload: dict):
     )
 
 
-def _worker(args) -> list:
-    """Decode this worker's files; returns [(file_pos, seq, payload), ...]."""
-    cfg, files_with_pos = args
-    # Defensive: a worker must never initialize an accelerator client (the
-    # single-client TPU tunnel would wedge); the decode path is numpy-only
-    # but pin the platform in case anything downstream touches jax.
-    import jax
+# One reader per worker process, built lazily on the first job (spawn pools
+# reuse workers across jobs, so the per-process hash tables amortize).
+_WORKER_READER = None
 
-    try:
-        jax.config.update("jax_platforms", "cpu")
-    except Exception:
-        pass
-    from photon_tpu.io.streaming import StreamingAvroReader
 
-    reader = StreamingAvroReader(
-        {s: _index_from_spec(sp) for s, sp in cfg.index_specs.items()},
-        cfg.shard_configs,
-        cfg.columns,
-        cfg.id_tag_columns,
-        chunk_rows=cfg.chunk_rows,
-        capture_uids=cfg.capture_uids,
-    )
-    out = []
-    for pos, path in files_with_pos:
-        # One iter_chunks per file so every chunk maps to a file position
-        # (chunk boundaries never straddle files) and global row order is
-        # reconstructable.
-        for seq, chunk in enumerate(
-            reader.iter_chunks(
-                [path], dtype=np.dtype(cfg.dtype),
-                require_labels=cfg.require_labels,
-            )
-        ):
-            out.append((pos, seq, _chunk_payload(chunk)))
-    return out
+def _worker_file(args) -> tuple:
+    """Decode ONE file; returns (file_pos, [payload, ...]). Per-file jobs
+    bound worker memory to a single file's chunks and let results stream
+    back to the parent as each file completes."""
+    global _WORKER_READER
+    cfg, pos, path = args
+    if _WORKER_READER is None:
+        # Defensive: a worker must never initialize an accelerator client
+        # (the single-client TPU tunnel would wedge); the decode path is
+        # numpy-only but pin the platform in case anything touches jax.
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        from photon_tpu.io.streaming import StreamingAvroReader
+
+        _WORKER_READER = StreamingAvroReader(
+            {s: _index_from_spec(sp) for s, sp in cfg.index_specs.items()},
+            cfg.shard_configs,
+            cfg.columns,
+            cfg.id_tag_columns,
+            chunk_rows=cfg.chunk_rows,
+            capture_uids=cfg.capture_uids,
+        )
+    payloads = [
+        _chunk_payload(chunk, cfg.capture_uids)
+        for chunk in _WORKER_READER.iter_chunks(
+            [path], dtype=np.dtype(cfg.dtype),
+            require_labels=cfg.require_labels,
+        )
+    ]
+    return pos, payloads
 
 
 def read_parallel(
@@ -181,6 +195,14 @@ def read_parallel(
         raise Unsupported("native decoder unavailable")
     columns = columns or InputColumnNames()
     files = _expand_paths(paths)
+    if int(n_workers) > len(files) > 0:
+        import logging
+
+        logging.getLogger("photon_tpu.io").warning(
+            "parallel ingest: %d workers requested but only %d input "
+            "file(s) — parallelism is per-file (split the input, or accept "
+            "%d-way decode)", n_workers, len(files), len(files),
+        )
     n_workers = min(int(n_workers), len(files))
     if n_workers <= 1:
         return StreamingAvroReader(
@@ -198,17 +220,17 @@ def read_parallel(
         dtype=np.dtype(dtype).name,
         require_labels=require_labels,
     )
-    jobs = [
-        (cfg, [(pos, f) for pos, f in enumerate(files) if pos % n_workers == w])
-        for w in range(n_workers)
-    ]
-    # spawn, not fork: fork after JAX initialization can deadlock.
+    jobs = [(cfg, pos, f) for pos, f in enumerate(files)]
+    # spawn, not fork: fork after JAX initialization can deadlock. Per-file
+    # jobs + imap_unordered stream results back as each file finishes, so a
+    # worker holds at most one file's chunks and peak memory stays ~1x the
+    # dataset (the parent's reassembly) instead of 2x.
     import multiprocessing as mp
 
     ctx = mp.get_context("spawn")
     with ctx.Pool(n_workers) as pool:
-        results = pool.map(_worker, jobs)
-    tagged = [item for worker_items in results for item in worker_items]
-    tagged.sort(key=lambda t: (t[0], t[1]))
-    chunks = [_payload_chunk(p) for _, _, p in tagged]
+        by_pos = dict(pool.imap_unordered(_worker_file, jobs))
+    chunks = [
+        _payload_chunk(p) for pos in range(len(files)) for p in by_pos[pos]
+    ]
     return chunks_to_bundle(chunks, index_maps, id_tag_columns, dtype)
