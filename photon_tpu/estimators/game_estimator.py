@@ -415,7 +415,7 @@ class GameEstimator:
                     # Route only configurations fit_model_parallel supports;
                     # others stay data-parallel (replicated over the model
                     # axis) instead of failing mid-sweep.
-                    and problem.optimizer_type.name in ("LBFGS", "OWLQN")
+                    and problem.optimizer_type.name in ("LBFGS", "OWLQN", "TRON")
                     and problem.variance_type.name != "FULL"
                     and not (
                         prep["norm"][dcfg.feature_shard] is not None

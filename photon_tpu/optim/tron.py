@@ -30,8 +30,8 @@ from photon_tpu.optim.base import (
     ValueAndGrad,
     check_convergence,
     finalize_reason,
-    l2_norm,
 )
+from photon_tpu.optim.lbfgs import make_dot
 
 Array = jax.Array
 
@@ -40,21 +40,24 @@ _ETA0, _ETA1, _ETA2 = 1e-4, 0.25, 0.75
 _SIGMA1, _SIGMA2, _SIGMA3 = 0.25, 0.5, 4.0
 
 
-def _boundary_tau(p: Array, d: Array, delta: Array) -> Array:
+def _boundary_tau(p: Array, d: Array, delta: Array, dot) -> Array:
     """τ ≥ 0 with ‖p + τ·d‖ = delta (positive root of the quadratic)."""
-    dd = jnp.dot(d, d)
-    pd = jnp.dot(p, d)
-    pp = jnp.dot(p, p)
+    dd = dot(d, d)
+    pd = dot(p, d)
+    pp = dot(p, p)
     disc = jnp.sqrt(jnp.maximum(pd * pd + dd * (delta * delta - pp), 0.0))
     return (-pd + disc) / jnp.maximum(dd, 1e-30)
 
 
-def steihaug_cg(hvp, g: Array, delta: Array, max_iters: int, tol: Array):
+def steihaug_cg(hvp, g: Array, delta: Array, max_iters: int, tol: Array,
+                dot=jnp.dot):
     """Truncated CG for H p = −g inside ‖p‖ ≤ delta.
 
     Returns (p, Hp, n_hvp) — Hp is maintained incrementally so the caller can
     compute the predicted reduction without another Hessian pass; n_hvp is the
     number of Hessian-vector products performed (for pass accounting).
+    ``dot`` abstracts the inner product (a psum-reduced one when vectors are
+    shards over a mesh axis).
     """
 
     class CGState(NamedTuple):
@@ -69,7 +72,7 @@ def steihaug_cg(hvp, g: Array, delta: Array, max_iters: int, tol: Array):
     r0 = -g
     init = CGState(
         p=jnp.zeros_like(g), r=r0, d=r0, hp=jnp.zeros_like(g),
-        rr=jnp.dot(r0, r0), it=jnp.zeros((), jnp.int32),
+        rr=dot(r0, r0), it=jnp.zeros((), jnp.int32),
         done=jnp.zeros((), bool),
     )
 
@@ -78,19 +81,19 @@ def steihaug_cg(hvp, g: Array, delta: Array, max_iters: int, tol: Array):
 
     def body(st: CGState) -> CGState:
         hd = hvp(st.d)
-        dhd = jnp.dot(st.d, hd)
+        dhd = dot(st.d, hd)
         alpha = st.rr / jnp.where(dhd > 1e-30, dhd, 1.0)
         # Negative curvature or singular direction → walk to the boundary.
         neg_curv = dhd <= 1e-30
         p_try = st.p + alpha * st.d
-        outside = l2_norm(p_try) >= delta
-        tau = _boundary_tau(st.p, st.d, delta)
+        outside = jnp.sqrt(dot(p_try, p_try)) >= delta
+        tau = _boundary_tau(st.p, st.d, delta, dot)
         hit_boundary = neg_curv | outside
         step = jnp.where(hit_boundary, tau, alpha)
         p_new = st.p + step * st.d
         hp_new = st.hp + step * hd
         r_new = st.r - step * hd
-        rr_new = jnp.dot(r_new, r_new)
+        rr_new = dot(r_new, r_new)
         beta = rr_new / jnp.maximum(st.rr, 1e-30)
         d_new = r_new + beta * st.d
         return CGState(
@@ -125,7 +128,14 @@ class TRON(Optimizer):
     ``GLMObjective.bind_hvp_at``) out of the inner CG loop explicitly.
     Build one generically as
     ``lambda x: (lambda v: jax.jvp(grad_fn, (x,), (v,))[1])``.
+
+    With ``axis_name`` set, ``x0``/gradients/CG vectors are SHARDS over that
+    mesh axis (P3 feature sharding): every inner product psums across shards
+    and the caller's value_and_grad/hvp must return globally-reduced values
+    on shard-local vectors (see ``parallel/model_parallel.py``).
     """
+
+    axis_name: str = None
 
     def optimize(  # type: ignore[override]
         self,
@@ -144,9 +154,11 @@ class TRON(Optimizer):
         cfg = self.config
         max_it = cfg.max_iterations
         dtype = x0.dtype
+        dot = make_dot(self.axis_name)
+        norm = lambda v: jnp.sqrt(dot(v, v))
 
         f0, g0 = value_and_grad(x0)
-        gnorm0 = l2_norm(g0)
+        gnorm0 = norm(g0)
         values = jnp.full((max_it + 1,), jnp.inf, dtype).at[0].set(f0)
         gnorms = jnp.full((max_it + 1,), jnp.inf, dtype).at[0].set(gnorm0)
 
@@ -162,14 +174,14 @@ class TRON(Optimizer):
             return (st.reason == NOT_CONVERGED) & (st.it < max_it)
 
         def body(st: _LoopState) -> _LoopState:
-            gnorm = l2_norm(st.g)
+            gnorm = norm(st.g)
             cg_tol = 0.1 * gnorm
             p, hp, n_hvp = steihaug_cg(
                 hvp_at(st.x), st.g, st.delta,
-                cfg.max_cg_iterations, cg_tol,
+                cfg.max_cg_iterations, cg_tol, dot=dot,
             )
             # Predicted reduction of the quadratic model: −(gᵀp + ½ pᵀHp).
-            pred = -(jnp.dot(st.g, p) + 0.5 * jnp.dot(p, hp))
+            pred = -(dot(st.g, p) + 0.5 * dot(p, hp))
             x_try = st.x + p
             f_try, g_try = value_and_grad(x_try)
             actual = st.f - f_try
@@ -177,7 +189,7 @@ class TRON(Optimizer):
             # A non-finite trial value must take the shrink branch.
             rho = jnp.where(jnp.isfinite(f_try), rho, -jnp.inf)
 
-            pnorm = l2_norm(p)
+            pnorm = norm(p)
             # LIBLINEAR radius update: shrink on poor agreement, halve on
             # moderate, expand (bounded) on good.
             delta = jnp.where(
@@ -195,7 +207,7 @@ class TRON(Optimizer):
             g_new = jnp.where(accept, g_try, st.g)
 
             it = st.it + 1
-            gnorm_new = l2_norm(g_new)
+            gnorm_new = norm(g_new)
             # The function-value test is only meaningful on accepted steps —
             # a rejected step leaves f unchanged and must not read as
             # convergence; it shrinks delta and retries instead.
@@ -224,7 +236,7 @@ class TRON(Optimizer):
         st = lax.while_loop(cond, body, init)
         reason = finalize_reason(st.reason, st.it, max_it)
         return OptimizerResult(
-            x=st.x, value=st.f, grad_norm=l2_norm(st.g),
+            x=st.x, value=st.f, grad_norm=norm(st.g),
             iterations=st.it, converged_reason=reason,
             values=st.values, grad_norms=st.grad_norms,
             data_passes=st.passes,

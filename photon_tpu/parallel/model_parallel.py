@@ -35,7 +35,7 @@ from photon_tpu.data.batch import DenseFeatures, LabeledBatch, SparseFeatures
 from photon_tpu.functions.problem import GLMOptimizationProblem
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import GeneralizedLinearModel
-from photon_tpu.optim import LBFGS, OWLQN, OptimizerType
+from photon_tpu.optim import LBFGS, OWLQN, TRON, OptimizerType
 from photon_tpu.ops.losses import loss_for_task
 from photon_tpu.parallel.mesh import axes_size, axis_tuple, pad_rows_to_multiple
 
@@ -65,17 +65,22 @@ def fit_model_parallel(
     (GeneralizedLinearModel, OptimizerResult) with full-length
     (host-assembled) coefficients.
 
-    Supports L-BFGS and OWL-QN (orthant ops are elementwise → shard-local;
-    only inner products psum), NONE/SIMPLE variances (SIMPLE's Hessian
+    Supports L-BFGS, OWL-QN, and TRON (orthant/CG vector ops are elementwise
+    → shard-local; inner products psum over the model axis, and TRON's
+    Hessian-vector product composes the same margins-psum + shard-local
+    transpose as the gradient), NONE/SIMPLE variances (SIMPLE's Hessian
     diagonal is computed per feature shard), and normalization contexts (the
     coefficient-space map's shift correction is one scalar psum over the
-    model axis; SURVEY.md §7 hard-part #5). TRON and FULL variance use the
-    data-parallel path: TRON's inner CG and a D×D inverse don't fit the
-    sharded-state design.
+    model axis; SURVEY.md §7 hard-part #5). FULL variance uses the
+    data-parallel path: a D×D inverse doesn't fit the sharded-state design.
     """
-    if problem.optimizer_type not in (OptimizerType.LBFGS, OptimizerType.OWLQN):
+    # Guards a future OptimizerType addition from silently training with the
+    # wrong solver; every CURRENT member is supported.
+    if problem.optimizer_type not in (
+        OptimizerType.LBFGS, OptimizerType.OWLQN, OptimizerType.TRON
+    ):
         raise ValueError(
-            "model-parallel training supports LBFGS and OWLQN "
+            "model-parallel training supports LBFGS, OWLQN, and TRON "
             f"(got {problem.optimizer_type.name})"
         )
     if problem.variance_type.name == "FULL":
@@ -283,6 +288,32 @@ def fit_model_parallel(
         if key.optimizer_type == OptimizerType.OWLQN:
             result = OWLQN(key.optimizer_config, axis_name=model_axis).optimize(
                 vg, w_start, l1 * lam_shard
+            )
+        elif key.optimizer_type == OptimizerType.TRON:
+            # Sharded HVP: H'v = Jᵀ(Xᵀ D X)(Jv) + λv (+ prior precisions),
+            # with J the (linear) normalization coefficient map. Margins and
+            # curvature hoist per outer iterate, exactly like the
+            # single-device GLMObjective.bind_hvp_at.
+            def hvp_at(ws):
+                w_orig = to_original(ws) if use_norm else ws
+                z = margins(w_orig) + local_batch.offsets
+                d2w = local_batch.weights * loss.d2(z, local_batch.labels)
+
+                def hv(v):
+                    v_orig = to_original(v) if use_norm else v
+                    zv = margins(v_orig)
+                    out = lax.psum(grad_shard(d2w * zv), data_axes)
+                    if use_norm:
+                        out = pullback(out)
+                    out = out + lam * v
+                    if prior_shard is not None:
+                        out = out + prior_shard.hessian_vector(v)
+                    return out
+
+                return hv
+
+            result = TRON(key.optimizer_config, axis_name=model_axis).optimize(
+                vg, w_start, hvp_at
             )
         else:
             result = LBFGS(key.optimizer_config, axis_name=model_axis).optimize(

@@ -152,10 +152,6 @@ class TestModelParallelValidation:
     def test_unsupported_options_raise(self, rng, problem, mesh_4x2):
         batch = _sparse_problem(rng)
         w0 = jnp.zeros(batch.dim, jnp.float64)
-        with pytest.raises(ValueError, match="LBFGS and OWLQN"):
-            fit_model_parallel(
-                dataclasses.replace(problem, optimizer_type=OptimizerType.TRON),
-                batch, w0, mesh_4x2)
         from photon_tpu.functions.problem import VarianceComputationType
 
         with pytest.raises(ValueError, match="FULL"):
@@ -311,3 +307,101 @@ class TestMultiSliceModelParallel:
             rtol=0, atol=1e-6,
         )
         assert int(r_ms.iterations) == int(r_ref.iterations)
+
+
+class TestModelParallelTRON:
+    """TRON under feature sharding: sharded trust-region Newton (psum'd CG
+    inner products, margins-psum HVP) must match the single-device TRON
+    solve exactly — config (2)'s optimizer now has a 10M-feature scale path
+    (SURVEY.md §2.6 P3)."""
+
+    def _tron(self, task=TaskType.LOGISTIC_REGRESSION):
+        from photon_tpu.optim import OptimizerType
+
+        return GLMOptimizationProblem(
+            task=task,
+            optimizer_type=OptimizerType.TRON,
+            optimizer_config=OptimizerConfig(max_iterations=40),
+            regularization=L2,
+            reg_weight=1.0,
+        )
+
+    def test_matches_single_device(self, rng, mesh_4x2):
+        problem = self._tron()
+        batch = _sparse_problem(rng)
+        m_ref, r_ref = problem.fit(batch, jnp.zeros(batch.dim, jnp.float64))
+        m_mp, r_mp = fit_model_parallel(
+            problem, batch, jnp.zeros(batch.dim, jnp.float64), mesh_4x2
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_mp.coefficients.means),
+            np.asarray(m_ref.coefficients.means),
+            rtol=0, atol=1e-6,
+        )
+        assert int(r_mp.iterations) == int(r_ref.iterations)
+        assert float(r_mp.value) == pytest.approx(float(r_ref.value), rel=1e-10)
+
+    def test_prior_and_reg_mask(self, rng, mesh_4x2):
+        """Incremental-training prior under sharded TRON: the prior's
+        precision term rides the sharded HVP; must match single-device."""
+        batch = _sparse_problem(rng)
+        d = batch.dim
+        prior = PriorDistribution.from_model(
+            jnp.asarray(rng.normal(size=d)),
+            jnp.asarray(0.5 + rng.random(d)),
+            incremental_weight=3.0,
+        )
+        p = dataclasses.replace(
+            self._tron(),
+            reg_mask=jnp.ones(d, jnp.float64).at[0].set(0.0),
+            prior=prior,
+        )
+        m_ref, r_ref = p.fit(batch, jnp.zeros(d, jnp.float64))
+        m_mp, r_mp = fit_model_parallel(
+            p, batch, jnp.zeros(d, jnp.float64), mesh_4x2
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_mp.coefficients.means),
+            np.asarray(m_ref.coefficients.means),
+            atol=1e-8,
+        )
+        assert float(r_mp.value) == pytest.approx(float(r_ref.value), rel=1e-10)
+        assert int(r_mp.iterations) == int(r_ref.iterations)
+
+    def test_poisson_with_variance_and_normalization(self, rng, mesh_2x4):
+        from photon_tpu.data.normalization import (
+            NormalizationType,
+            context_from_statistics,
+        )
+        from photon_tpu.data.statistics import compute_feature_statistics
+        from photon_tpu.functions.problem import VarianceComputationType
+
+        batch = _sparse_problem(rng, task=TaskType.POISSON_REGRESSION)
+        y = np.abs(np.asarray(batch.labels))  # Poisson labels: counts
+        batch = dataclasses.replace(batch, labels=jnp.asarray(np.floor(y)))
+        problem = dataclasses.replace(
+            self._tron(TaskType.POISSON_REGRESSION),
+            variance_type=VarianceComputationType.SIMPLE,
+        )
+        stats = compute_feature_statistics(batch)
+        norm = context_from_statistics(
+            stats, NormalizationType.SCALE_WITH_STANDARD_DEVIATION,
+            intercept_index=None,
+        )
+        m_ref, r_ref = problem.fit(
+            batch, jnp.zeros(batch.dim, jnp.float64), normalization=norm
+        )
+        m_mp, r_mp = fit_model_parallel(
+            problem, batch, jnp.zeros(batch.dim, jnp.float64), mesh_2x4,
+            normalization=norm,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_mp.coefficients.means),
+            np.asarray(m_ref.coefficients.means),
+            rtol=0, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_mp.coefficients.variances),
+            np.asarray(m_ref.coefficients.variances),
+            rtol=1e-6, atol=0,
+        )
