@@ -53,6 +53,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--uid-column", default="uid")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"],
                    help="scoring precision (float64 enables jax x64)")
+    p.add_argument("--devices", type=int, default=1,
+                   help="shard the fixed-effect scoring matvec's rows over "
+                        "this many devices (0 = all visible); 1 = no mesh")
     p.add_argument("--chunk-rows", type=int, default=0,
                    help="stream the data in chunks of about this many rows: "
                         "features never fully materialize in host or device "
@@ -135,12 +138,29 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             ),
             id_tag_columns=sorted(id_tags),
         )
+        if args.devices < 0:
+            raise ValueError(f"--devices must be >= 0, got {args.devices}")
+        mesh = None
+        if args.devices == 0 or args.devices > 1:
+            import jax
+
+            from photon_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+            n = len(jax.devices()) if args.devices == 0 else args.devices
+            if n > len(jax.devices()):
+                raise ValueError(
+                    f"--devices {n} > {len(jax.devices())} visible devices"
+                )
+            if n > 1:
+                mesh = make_mesh({DATA_AXIS: n}, devices=jax.devices()[:n])
+                logger.info("scoring mesh: %s", mesh)
         transformer = GameTransformer(
             model,
             data_configs,
             intercept_indices={
                 s: im.intercept_index for s, im in index_maps.items()
             },
+            mesh=mesh,
         )
         scores_path = os.path.join(args.output_dir, "scores.avro")
         evaluation = None

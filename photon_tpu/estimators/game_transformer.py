@@ -40,16 +40,48 @@ Array = jax.Array
 @dataclasses.dataclass(frozen=True)
 class GameTransformer:
     """Bind a trained model to the per-coordinate data configs it was
-    trained with (shard names + entity columns)."""
+    trained with (shard names + entity columns).
+
+    ``mesh`` (optional): fixed-effect scoring — the rows × features matvec
+    that dominates serve cost — runs with rows sharded over ``data_axis``
+    (coefficients replicated, the reference's broadcast; SURVEY.md §3.6).
+    Random-effect scoring stays replicated: its per-row cost is a tiny
+    local-subspace gather-dot.
+    """
 
     model: GameModel
     coordinate_data_configs: Mapping[str, CoordinateDataConfig]
     intercept_indices: Optional[Mapping[str, int]] = None
+    mesh: Optional[object] = None
+    data_axis: str = "data"
 
     def _intercept_for(self, shard: str) -> Optional[int]:
         if self.intercept_indices is None:
             return None
         return self.intercept_indices.get(shard)
+
+    def _score_fixed(self, m: FixedEffectModel, batch) -> Array:
+        if self.mesh is None:
+            return m.score_batch(batch)
+        from photon_tpu.parallel.mesh import (
+            axes_size,
+            pad_rows_to_multiple,
+            shard_batch_pytree,
+        )
+
+        # Scoring reads ONLY the features — pad/shard them alone instead of
+        # round-tripping the three O(N) row columns the matvec never touches
+        # (billion-row serve path). Zero-valued padding rows contribute 0 to
+        # the matvec and are sliced off.
+        feats = batch.features
+        if getattr(feats, "fast", None) is not None:
+            feats = feats.without_fast_path()  # not row-shardable
+        n = feats.n_rows
+        axis_size = axes_size(self.mesh, self.data_axis)
+        if n % axis_size:
+            feats = pad_rows_to_multiple(feats, axis_size)
+        feats = shard_batch_pytree(feats, self.mesh, self.data_axis)
+        return feats.matvec(m.model.coefficients.means)[:n]
 
     def transform(self, data: GameDataBundle) -> Array:
         """Total additive score per row: offsets + Σ coordinate scores."""
@@ -65,7 +97,9 @@ class GameTransformer:
             if isinstance(dcfg, FixedEffectDataConfig):
                 if not isinstance(m, FixedEffectModel):
                     raise TypeError(f"{cid!r}: fixed-effect config, {type(m)} model")
-                total = total + m.score_batch(data.batch(dcfg.feature_shard))
+                total = total + self._score_fixed(
+                    m, data.batch(dcfg.feature_shard)
+                )
             elif isinstance(dcfg, RandomEffectDataConfig):
                 if not isinstance(m, RandomEffectModel):
                     raise TypeError(f"{cid!r}: random-effect config, {type(m)} model")

@@ -65,18 +65,9 @@ def fit_data_parallel(
     (padding is invisible to the objective — SURVEY.md batch semantics).
     Returns (GeneralizedLinearModel, OptimizerResult), both replicated.
     """
-    from photon_tpu.parallel.mesh import pad_rows_to_multiple
+    from photon_tpu.parallel.mesh import pad_and_shard_batch
 
-    axis_size = axes_size(mesh, data_axis)
-    if getattr(batch.features, "fast", None) is not None:
-        # The column-sorted fast-path table is not row-shardable.
-        batch = dataclasses.replace(
-            batch, features=batch.features.without_fast_path()
-        )
-    if batch.n_rows % axis_size:
-        batch = pad_rows_to_multiple(batch, axis_size)
-
-    batch = shard_batch_pytree(batch, mesh, data_axis)
+    batch = pad_and_shard_batch(batch, mesh, data_axis)
     rep = replicated(mesh)
     w0 = jax.device_put(w0, rep)
     # Array-valued reg_mask / prior / normalization can't be part of the

@@ -136,6 +136,23 @@ def shard_batch_pytree(batch, mesh: Mesh, axis=DATA_AXIS):
     return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), batch)
 
 
+def pad_and_shard_batch(batch, mesh: Mesh, axis=DATA_AXIS):
+    """The canonical row-distribution preamble: strip the non-row-shardable
+    fast/Pallas aux tables, pad rows to the axis-size multiple (weight-0 /
+    ghost-feature padding), and device_put row-sharded. Shared by training
+    (``fit_data_parallel``) and scoring so the aux-stripping invariant lives
+    in ONE place — row-sharding a column-sorted table would corrupt results."""
+    import dataclasses
+
+    axis_size = axes_size(mesh, axis)
+    feats = getattr(batch, "features", None)
+    if feats is not None and getattr(feats, "fast", None) is not None:
+        batch = dataclasses.replace(batch, features=feats.without_fast_path())
+    if batch.n_rows % axis_size:
+        batch = pad_rows_to_multiple(batch, axis_size)
+    return shard_batch_pytree(batch, mesh, axis)
+
+
 def pad_rows_to_multiple(arrs_n_leading, multiple: int):
     """Host-side: pad row count to a multiple (for even sharding), returning
     the padded pytree. Padding is zero-fill — for a LabeledBatch the padded

@@ -259,3 +259,50 @@ def test_re_down_sampling_reduces_training_mass(bundles):
     kept_mass = sum(float(np.asarray(b.train_weights).sum()) for b in sampled.buckets)
     orig_mass = sum(float(np.asarray(b.train_weights).sum()) for b in ds.buckets)
     assert kept_mass == pytest.approx(orig_mass, rel=0.15)
+
+
+def test_transformer_mesh_scoring_matches_single_device():
+    """Fixed-effect scoring with rows sharded over the mesh must equal the
+    replicated scoring exactly (serve path, SURVEY.md §3.6)."""
+    from photon_tpu.data.batch import SparseFeatures
+    from photon_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.default_rng(3)
+    n, d = 201, 40   # odd row count: exercises the pad-to-multiple path
+    users = np.array([f"u{i % 7}" for i in range(n)], object)
+    idx = rng.integers(0, d, size=(n, 5)).astype(np.int32)
+    val = rng.normal(size=(n, 5)).astype(np.float32)
+    bundle = GameDataBundle(
+        features={"g": SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)},
+        labels=(rng.random(n) < 0.5).astype(np.float64),
+        offsets=rng.normal(size=n) * 0.1,
+        weights=np.ones(n),
+        uids=np.arange(n).astype(object),
+        id_tags={"userId": users},
+    )
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={
+            "fixed": FixedEffectDataConfig("g"),
+            "perUser": RandomEffectDataConfig(re_type="userId",
+                                              feature_shard="g"),
+        },
+    )
+    cfg = {
+        cid: GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=10)
+        for cid in ("fixed", "perUser")
+    }
+    result = est.fit(bundle, None, [cfg])[0]
+
+    base = dict(
+        model=result.model,
+        coordinate_data_configs=est.coordinate_data_configs,
+    )
+    scores_rep = np.asarray(GameTransformer(**base).transform(bundle))
+    mesh = make_mesh()   # all 8 virtual devices on the data axis
+    scores_mesh = np.asarray(
+        GameTransformer(**base, mesh=mesh).transform(bundle)
+    )
+    np.testing.assert_allclose(scores_mesh, scores_rep, rtol=0, atol=1e-6)
