@@ -138,22 +138,11 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             ),
             id_tag_columns=sorted(id_tags),
         )
-        if args.devices < 0:
-            raise ValueError(f"--devices must be >= 0, got {args.devices}")
-        mesh = None
-        if args.devices == 0 or args.devices > 1:
-            import jax
+        from photon_tpu.cli.params import mesh_from_flags
 
-            from photon_tpu.parallel.mesh import DATA_AXIS, make_mesh
-
-            n = len(jax.devices()) if args.devices == 0 else args.devices
-            if n > len(jax.devices()):
-                raise ValueError(
-                    f"--devices {n} > {len(jax.devices())} visible devices"
-                )
-            if n > 1:
-                mesh = make_mesh({DATA_AXIS: n}, devices=jax.devices()[:n])
-                logger.info("scoring mesh: %s", mesh)
+        mesh = mesh_from_flags(args.devices)
+        if mesh is not None:
+            logger.info("scoring mesh: %s", mesh)
         transformer = GameTransformer(
             model,
             data_configs,

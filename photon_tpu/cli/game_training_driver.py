@@ -198,37 +198,6 @@ def _load_or_build_indexes(args, shard_specs, logger):
     return shard_cfgs, index_maps
 
 
-def _make_mesh(n_devices: int, mesh_spec: Optional[str] = None):
-    import jax
-
-    from photon_tpu.parallel.mesh import DATA_AXIS, make_mesh
-
-    avail = len(jax.devices())
-    if mesh_spec:
-        axes = {}
-        for item in mesh_spec.split(","):
-            name, sep, size = item.partition("=")
-            if not sep:
-                raise ValueError(f"--mesh items must be axis=size, got {item!r}")
-            axes[name.strip()] = int(size)
-        if DATA_AXIS not in axes:
-            raise ValueError(
-                f"--mesh must include the '{DATA_AXIS}' axis (got {sorted(axes)})"
-            )
-        total = 1
-        for s in axes.values():
-            total *= s
-        if total > avail:
-            raise ValueError(f"--mesh needs {total} devices, have {avail}")
-        return make_mesh(axes, devices=jax.devices()[:total])
-    n = avail if n_devices == 0 else n_devices
-    if n > avail:
-        raise ValueError(f"--devices {n} > {avail} visible devices")
-    if n <= 1:
-        return None
-    return make_mesh({DATA_AXIS: n}, devices=jax.devices()[:n])
-
-
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     """Run training; returns a result summary dict (also written to disk)."""
     args = build_arg_parser().parse_args(argv)
@@ -423,7 +392,9 @@ def _run_inner(args, task) -> dict:
                     args.model_input_dir, index_maps, dtype=read_dtype
                 )
 
-        mesh = _make_mesh(args.devices, args.mesh)
+        from photon_tpu.cli.params import mesh_from_flags
+
+        mesh = mesh_from_flags(args.devices, args.mesh)
         if mesh is not None:
             logger.info("mesh: %s", mesh)
         model_axis = (

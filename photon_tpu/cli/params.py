@@ -190,3 +190,40 @@ def parse_feature_shard(spec: str) -> FeatureShardSpec:
             raise ValueError(f"feature shard {shard!r}: expected 'no-intercept', got {parts[2]!r}")
         add_intercept = False
     return FeatureShardSpec(shard, bags, add_intercept)
+
+
+def mesh_from_flags(n_devices: int, mesh_spec=None):
+    """Shared --devices/--mesh handling for the drivers: 0 = all visible
+    devices, 1 = no mesh (None), N = data-axis mesh over the first N;
+    ``mesh_spec`` ("data=4,model=2") builds an explicit multi-axis mesh.
+    Negative counts and over-subscription fail loud."""
+    import jax
+
+    from photon_tpu.parallel.mesh import DATA_AXIS, make_mesh
+
+    avail = len(jax.devices())
+    if mesh_spec:
+        axes = {}
+        for item in mesh_spec.split(","):
+            name, sep, size = item.partition("=")
+            if not sep:
+                raise ValueError(f"--mesh items must be axis=size, got {item!r}")
+            axes[name.strip()] = int(size)
+        if DATA_AXIS not in axes:
+            raise ValueError(
+                f"--mesh must include the '{DATA_AXIS}' axis (got {sorted(axes)})"
+            )
+        total = 1
+        for s in axes.values():
+            total *= s
+        if total > avail:
+            raise ValueError(f"--mesh needs {total} devices, have {avail}")
+        return make_mesh(axes, devices=jax.devices()[:total])
+    if n_devices < 0:
+        raise ValueError(f"--devices must be >= 0, got {n_devices}")
+    n = avail if n_devices == 0 else n_devices
+    if n > avail:
+        raise ValueError(f"--devices {n} > {avail} visible devices")
+    if n <= 1:
+        return None
+    return make_mesh({DATA_AXIS: n}, devices=jax.devices()[:n])

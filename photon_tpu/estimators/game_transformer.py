@@ -63,24 +63,13 @@ class GameTransformer:
     def _score_fixed(self, m: FixedEffectModel, batch) -> Array:
         if self.mesh is None:
             return m.score_batch(batch)
-        from photon_tpu.parallel.mesh import (
-            axes_size,
-            pad_rows_to_multiple,
-            shard_batch_pytree,
-        )
+        from photon_tpu.parallel.mesh import pad_and_shard_batch
 
-        # Scoring reads ONLY the features — pad/shard them alone instead of
-        # round-tripping the three O(N) row columns the matvec never touches
-        # (billion-row serve path). Zero-valued padding rows contribute 0 to
-        # the matvec and are sliced off.
-        feats = batch.features
-        if getattr(feats, "fast", None) is not None:
-            feats = feats.without_fast_path()  # not row-shardable
-        n = feats.n_rows
-        axis_size = axes_size(self.mesh, self.data_axis)
-        if n % axis_size:
-            feats = pad_rows_to_multiple(feats, axis_size)
-        feats = shard_batch_pytree(feats, self.mesh, self.data_axis)
+        # Scoring reads ONLY the features — pad/shard them alone (device-
+        # side zero rows, contributing 0 to the matvec) instead of shipping
+        # the three O(N) row columns the matvec never touches.
+        n = batch.n_rows
+        feats = pad_and_shard_batch(batch.features, self.mesh, self.data_axis)
         return feats.matvec(m.model.coefficients.means)[:n]
 
     def transform(self, data: GameDataBundle) -> Array:

@@ -107,12 +107,11 @@ def spmd_value_and_grad(
     ``data_axis`` may be a tuple (multi-slice: the psum over
     ``("dcn", "data")`` is the hierarchical treeAggregate replacement).
     """
+    from photon_tpu.parallel.mesh import strip_unshardable_aux
+
     axes = axis_tuple(data_axis)
     data_obj = GLMObjective(loss=obj.loss, l2_weight=0.0, reg_mask=None)
-    if getattr(batch.features, "fast", None) is not None:
-        batch = dataclasses.replace(
-            batch, features=batch.features.without_fast_path()
-        )
+    batch = strip_unshardable_aux(batch)
     batch_specs = jax.tree.map(
         lambda leaf: P(axes, *([None] * (leaf.ndim - 1))), batch
     )

@@ -136,18 +136,34 @@ def shard_batch_pytree(batch, mesh: Mesh, axis=DATA_AXIS):
     return jax.tree.map(lambda leaf: jax.device_put(leaf, sharding), batch)
 
 
-def pad_and_shard_batch(batch, mesh: Mesh, axis=DATA_AXIS):
-    """The canonical row-distribution preamble: strip the non-row-shardable
-    fast/Pallas aux tables, pad rows to the axis-size multiple (weight-0 /
-    ghost-feature padding), and device_put row-sharded. Shared by training
-    (``fit_data_parallel``) and scoring so the aux-stripping invariant lives
-    in ONE place — row-sharding a column-sorted table would corrupt results."""
+def strip_unshardable_aux(batch_or_features):
+    """Drop fast/Pallas aux tables before row distribution — their
+    column-sorted layouts are NOT partitionable along the row axis and
+    sharding them would corrupt results. Accepts a LabeledBatch or a bare
+    features container; the one definition every distribution path uses."""
     import dataclasses
 
+    obj = batch_or_features
+    feats = getattr(obj, "features", None)
+    if feats is not None:
+        if getattr(feats, "fast", None) is not None or \
+                getattr(feats, "pallas", None) is not None:
+            return dataclasses.replace(obj, features=feats.without_fast_path())
+        return obj
+    if getattr(obj, "fast", None) is not None or \
+            getattr(obj, "pallas", None) is not None:
+        return obj.without_fast_path()
+    return obj
+
+
+def pad_and_shard_batch(batch, mesh: Mesh, axis=DATA_AXIS):
+    """The canonical row-distribution preamble: strip the non-row-shardable
+    aux tables (``strip_unshardable_aux``), pad rows to the axis-size
+    multiple (weight-0 / zero-feature padding), and device_put row-sharded.
+    Accepts a LabeledBatch or a bare features container — shared by
+    training (``fit_data_parallel``) and scoring (``GameTransformer``)."""
     axis_size = axes_size(mesh, axis)
-    feats = getattr(batch, "features", None)
-    if feats is not None and getattr(feats, "fast", None) is not None:
-        batch = dataclasses.replace(batch, features=feats.without_fast_path())
+    batch = strip_unshardable_aux(batch)
     if batch.n_rows % axis_size:
         batch = pad_rows_to_multiple(batch, axis_size)
     return shard_batch_pytree(batch, mesh, axis)
@@ -170,7 +186,39 @@ def pad_rows_to_multiple(arrs_n_leading, multiple: int):
         pad_width = [(0, r)] + [(0, 0)] * (a.ndim - 1)
         return _np.pad(_np.asarray(a), pad_width, constant_values=fill)
 
-    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+    from photon_tpu.data.batch import (
+        DenseFeatures,
+        LabeledBatch,
+        SparseFeatures,
+    )
+
+    # Bare feature containers pad DEVICE-side (jnp.concatenate): the scoring
+    # hot path must not round-trip the [N, K] arrays through host numpy just
+    # to append a handful of zero rows.
+    if isinstance(arrs_n_leading, SparseFeatures):
+        sf = arrs_n_leading
+        r = (-sf.n_rows) % multiple
+        if r == 0:
+            return sf
+        return SparseFeatures(
+            idx=jax.numpy.concatenate(
+                [sf.idx, jax.numpy.full((r, sf.max_nnz), sf.dim, sf.idx.dtype)]
+            ),
+            val=jax.numpy.concatenate(
+                [sf.val, jax.numpy.zeros((r, sf.max_nnz), sf.val.dtype)]
+            ),
+            dim=sf.dim,
+        )
+    if isinstance(arrs_n_leading, DenseFeatures):
+        x = arrs_n_leading.x
+        r = (-x.shape[0]) % multiple
+        if r == 0:
+            return arrs_n_leading
+        return DenseFeatures(
+            jax.numpy.concatenate(
+                [x, jax.numpy.zeros((r, x.shape[1]), x.dtype)]
+            )
+        )
 
     if isinstance(arrs_n_leading, LabeledBatch) and isinstance(
         arrs_n_leading.features, SparseFeatures

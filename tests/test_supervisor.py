@@ -192,3 +192,45 @@ def test_heartbeat_background_thread(tmp_path):
     assert payload["process_id"] == 7
     assert payload["beats"] >= 2
     assert hb.check_peers([7], max_age_seconds=30.0).healthy
+
+
+def test_driver_fails_fast_on_dead_peer(tmp_path, monkeypatch):
+    """With --heartbeat-dir, a retry attempt whose peer host stopped beating
+    raises RestartsUselessError (escaping the retry budget) instead of
+    re-entering a collective that cannot complete."""
+    from photon_tpu.cli import game_training_driver
+    from photon_tpu.cli.game_training_driver import RestartsUselessError
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from tests.test_drivers import _write_game_avro
+
+    d = tmp_path / "data"
+    d.mkdir()
+    _write_game_avro(d / "train.avro", seed=1, n_users=4, rows_per_user=12)
+
+    # Pretend this is a 2-process job whose peer (process 1) died long ago.
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    hdir = tmp_path / "hb"
+    hdir.mkdir()
+    stale = hdir / "host-1.hb"
+    stale.write_text('{"process_id": 1}')
+    old = time.time() - 3600
+    os.utime(stale, (old, old))
+
+    def always_fail(self, *a, **kw):
+        raise RuntimeError("transient-looking failure")
+
+    monkeypatch.setattr(GameEstimator, "fit", always_fail)
+    with pytest.raises(RestartsUselessError, match=r"dead=\[1\]"):
+        game_training_driver.run([
+            "--train-data", str(d / "train.avro"),
+            "--output-dir", str(tmp_path / "out"),
+            "--task", "LOGISTIC_REGRESSION",
+            "--feature-shard", "global:features",
+            "--coordinate",
+            "fixed:type=fixed,shard=global,reg=L2,max_iter=5,reg_weights=1",
+            "--max-restarts", "3", "--restart-backoff", "0",
+            "--heartbeat-dir", str(hdir),
+            "--devices", "1",
+        ])
