@@ -192,33 +192,30 @@ def pad_rows_to_multiple(arrs_n_leading, multiple: int):
         SparseFeatures,
     )
 
-    # Bare feature containers pad DEVICE-side (jnp.concatenate): the scoring
-    # hot path must not round-trip the [N, K] arrays through host numpy just
-    # to append a handful of zero rows.
+    # Bare feature containers: arrays ALREADY on device pad device-side
+    # (no host round-trip of [N, K] arrays to append a few zero rows);
+    # host-numpy arrays pad host-side so the subsequent
+    # device_put(NamedSharding) still streams shards directly to their
+    # devices without ever materializing the whole array on one.
+    def _pad2(a, fill):
+        r = (-a.shape[0]) % multiple
+        if isinstance(a, jax.Array):
+            ext = (jax.numpy.full((r, a.shape[1]), fill, a.dtype)
+                   if fill else jax.numpy.zeros((r, a.shape[1]), a.dtype))
+            return jax.numpy.concatenate([a, ext])
+        return pad(a, fill)
+
     if isinstance(arrs_n_leading, SparseFeatures):
         sf = arrs_n_leading
-        r = (-sf.n_rows) % multiple
-        if r == 0:
+        if (-sf.n_rows) % multiple == 0:
             return sf
         return SparseFeatures(
-            idx=jax.numpy.concatenate(
-                [sf.idx, jax.numpy.full((r, sf.max_nnz), sf.dim, sf.idx.dtype)]
-            ),
-            val=jax.numpy.concatenate(
-                [sf.val, jax.numpy.zeros((r, sf.max_nnz), sf.val.dtype)]
-            ),
-            dim=sf.dim,
+            idx=_pad2(sf.idx, sf.dim), val=_pad2(sf.val, 0), dim=sf.dim
         )
     if isinstance(arrs_n_leading, DenseFeatures):
-        x = arrs_n_leading.x
-        r = (-x.shape[0]) % multiple
-        if r == 0:
+        if (-arrs_n_leading.x.shape[0]) % multiple == 0:
             return arrs_n_leading
-        return DenseFeatures(
-            jax.numpy.concatenate(
-                [x, jax.numpy.zeros((r, x.shape[1]), x.dtype)]
-            )
-        )
+        return DenseFeatures(_pad2(arrs_n_leading.x, 0))
 
     if isinstance(arrs_n_leading, LabeledBatch) and isinstance(
         arrs_n_leading.features, SparseFeatures

@@ -560,3 +560,58 @@ def test_ingest_workers_flag(game_data, tmp_path):
         np.asarray(m1["fixed"].model.coefficients.means),
         np.asarray(m2["fixed"].model.coefficients.means),
     )
+
+
+def test_driver_coefficients_match_sklearn_golden(tmp_path):
+    """Known-answer tier (SURVEY.md §4): a CLI-trained fixed-effect logistic
+    model must match sklearn's LogisticRegression on the same data with the
+    same L2 objective (C = 1/reg_weight, unpenalized intercept) — the e2e
+    analog of the reference's precomputed-coefficient integration tests."""
+    sklearn = pytest.importorskip("sklearn")
+    from sklearn.linear_model import LogisticRegression
+
+    from photon_tpu.index.index_map import MmapIndexMap
+    from photon_tpu.io.model_io import load_game_model
+
+    rng = np.random.default_rng(21)
+    n, d = 600, 12
+    x = rng.normal(size=(n, d))
+    w_true = rng.normal(size=d)
+    y = (rng.random(n) < 1 / (1 + np.exp(-(x @ w_true - 0.3)))).astype(float)
+    recs = [
+        {
+            "uid": str(i), "response": float(y[i]), "offset": None,
+            "weight": None,
+            "features": [
+                {"name": "f", "term": str(j), "value": float(x[i, j])}
+                for j in range(d)
+            ],
+            "metadataMap": None,
+        }
+        for i in range(n)
+    ]
+    path = tmp_path / "golden.avro"
+    write_container(str(path), RECORD_SCHEMA, recs)
+
+    out = tmp_path / "out"
+    game_training_driver.run([
+        "--train-data", str(path),
+        "--output-dir", str(out),
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate",
+        "fixed:type=fixed,shard=global,reg=L2,max_iter=200,tol=1e-10,reg_weights=1",
+        "--dtype", "float64",
+        "--devices", "1",
+    ])
+    imap = MmapIndexMap(str(out / "index" / "global"))
+    model, _ = load_game_model(str(out / "best"), {"global": imap},
+                               dtype=np.float64)
+    w = np.asarray(model["fixed"].model.coefficients.means)
+    ours = np.array([w[imap.get_index("f", str(j))] for j in range(d)])
+    our_icpt = w[imap.intercept_index]
+
+    sk = LogisticRegression(C=1.0, fit_intercept=True, tol=1e-10, max_iter=5000)
+    sk.fit(x, y)
+    np.testing.assert_allclose(ours, sk.coef_[0], rtol=0, atol=2e-5)
+    np.testing.assert_allclose(our_icpt, sk.intercept_[0], rtol=0, atol=2e-5)
