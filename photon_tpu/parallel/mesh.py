@@ -220,16 +220,20 @@ def pad_rows_to_multiple(arrs_n_leading, multiple: int):
     if isinstance(arrs_n_leading, LabeledBatch) and isinstance(
         arrs_n_leading.features, SparseFeatures
     ):
+        # Stays HOST numpy on purpose: the caller's device_put(NamedSharding)
+        # then streams shards directly to their devices; wrapping in
+        # jnp.asarray here would first materialize the whole padded batch on
+        # the default device.
         batch = arrs_n_leading
         sf = batch.features
         return LabeledBatch(
             features=SparseFeatures(
-                idx=jax.numpy.asarray(pad(sf.idx, fill=sf.dim)),
-                val=jax.numpy.asarray(pad(sf.val)),
+                idx=pad(sf.idx, fill=sf.dim),
+                val=pad(sf.val),
                 dim=sf.dim,
             ),
-            labels=jax.numpy.asarray(pad(batch.labels)),
-            offsets=jax.numpy.asarray(pad(batch.offsets)),
-            weights=jax.numpy.asarray(pad(batch.weights)),
+            labels=pad(batch.labels),
+            offsets=pad(batch.offsets),
+            weights=pad(batch.weights),
         )
     return jax.tree.map(pad, arrs_n_leading)
