@@ -61,8 +61,10 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "features never fully materialize in host or device "
                         "memory and scores append to the output as computed "
                         "(billion-row serve path; 0 = whole-dataset). "
-                        "Evaluators still work - scores/labels/groups are "
-                        "O(rows) scalars and accumulate")
+                        "Evaluators still work but accumulate O(total rows) "
+                        "of numeric scalars (scores/labels/weights + int32 "
+                        "group codes; group-id strings are dictionary-"
+                        "encoded per chunk, never accumulated)")
     return p
 
 
@@ -211,7 +213,25 @@ def _score_chunked(args, reader, transformer, suite, scores_path, logger, _dt):
     n_rows = 0
     k_targets: dict = {}
     acc_scores, acc_labels, acc_weights = [], [], []
-    acc_tags: dict = {}
+    # Grouped evaluators need per-row group ids for ALL rows. Dictionary-
+    # encode them incrementally per chunk (ADVICE r3): what accumulates is
+    # 4 bytes/row of int32 codes + one dict entry per DISTINCT group, not
+    # O(total rows) of Python string objects — scores/labels/weights remain
+    # the O(rows) numeric floor any full-dataset evaluation pays.
+    group_cols = {
+        ev.group_column for ev in suite.evaluators if ev.group_column
+    } if suite else set()
+    tag_codes: dict = {col: {} for col in group_cols}
+    acc_tags: dict = {col: [] for col in group_cols}
+
+    def _encode_tags(col, values):
+        cmap = tag_codes[col]
+        uniq, inv = np.unique(np.asarray(values, object), return_inverse=True)
+        lut = np.fromiter(
+            (cmap.setdefault(u, len(cmap)) for u in uniq),
+            np.int32, len(uniq),
+        )
+        return lut[inv.astype(np.int64)]
     with Timed("score (chunked)", logger), ScoresWriter(scores_path) as writer:
         try:
             chunks = sr.iter_chunks(
@@ -240,13 +260,9 @@ def _score_chunked(args, reader, transformer, suite, scores_path, logger, _dt):
                     acc_scores.append(scores)
                     acc_labels.append(chunk.labels)
                     acc_weights.append(chunk.weights)
-                    for col in {
-                        ev.group_column
-                        for ev in suite.evaluators
-                        if ev.group_column
-                    }:
-                        acc_tags.setdefault(col, []).append(
-                            bundle.id_tags[col][: chunk.n_rows]
+                    for col in group_cols:
+                        acc_tags[col].append(
+                            _encode_tags(col, bundle.id_tags[col][: chunk.n_rows])
                         )
                 n_rows += chunk.n_rows
                 logger.info("scored %d rows", n_rows)
@@ -283,7 +299,13 @@ def _score_chunked(args, reader, transformer, suite, scores_path, logger, _dt):
             np.concatenate(acc_scores),
             np.concatenate(acc_labels),
             np.concatenate(acc_weights),
-            {col: np.concatenate(parts) for col, parts in acc_tags.items()},
+            {},
+            # Codes are already dense 0..n-1 per column (dictionary-encoded
+            # per chunk above) — skip the full-dataset np.unique sort.
+            factorized={
+                col: (np.concatenate(parts), len(tag_codes[col]))
+                for col, parts in acc_tags.items()
+            },
         )
     return n_rows, evaluation
 

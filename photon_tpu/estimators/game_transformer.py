@@ -18,6 +18,7 @@ from typing import Mapping, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_tpu.estimators.config import (
     CoordinateDataConfig,
@@ -115,18 +116,29 @@ class GameTransformer:
 
 
 def evaluate_scored_arrays(
-    suite: EvaluationSuite, scores, labels, weights, id_tags: Mapping
+    suite: EvaluationSuite, scores, labels, weights, id_tags: Mapping,
+    factorized: Optional[Mapping] = None,
 ) -> EvaluationResults:
     """Evaluate precomputed scores: factorize each grouped evaluator's id
     column, cast to f32, run the suite. Shared by whole-dataset scoring
     (above) and the chunked scoring driver (which accumulates these arrays
-    across streamed chunks)."""
+    across streamed chunks).
+
+    ``factorized`` maps a group column to ``(codes, n_groups)`` for callers
+    that already hold dense int codes (the chunked driver dictionary-encodes
+    per chunk); those columns skip the O(N log N) ``np.unique`` pass.
+    """
     group_cols = {ev.group_column for ev in suite.evaluators if ev.group_column}
     gids, ngroups = {}, {}
     for col in group_cols:
-        if col not in id_tags:
+        if factorized is not None and col in factorized:
+            codes, n = factorized[col]
+            gids[col] = jnp.asarray(np.asarray(codes, np.int32))
+            ngroups[col] = int(n)
+        elif col in id_tags:
+            gids[col], ngroups[col] = _factorize_group_ids(id_tags[col])
+        else:
             raise ValueError(f"grouped evaluator needs id column {col!r}")
-        gids[col], ngroups[col] = _factorize_group_ids(id_tags[col])
     return suite.evaluate(
         jnp.asarray(scores, jnp.float32),
         jnp.asarray(labels, jnp.float32),

@@ -102,7 +102,9 @@ class RandomSearch:
             pts.append(p)
             if on_trial is not None:
                 on_trial(_trial_state(pts, vals, rng, queue), len(pts))
-        return SearchResult(np.stack(pts), np.asarray(vals, float))
+        points = (np.stack(pts) if pts
+                  else np.zeros((0, self.rescaling.dim)))
+        return SearchResult(points, np.asarray(vals, float))
 
 
 @dataclasses.dataclass
@@ -205,4 +207,6 @@ class GaussianProcessSearch:
                 self.rescaling.from_unit(cand[int(np.argmax(ei))][None, :])[0]
             )
 
-        return SearchResult(np.stack(pts), np.asarray(vals, float))
+        points = (np.stack(pts) if pts
+                  else np.zeros((0, self.rescaling.dim)))
+        return SearchResult(points, np.asarray(vals, float))

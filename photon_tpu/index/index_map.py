@@ -165,6 +165,12 @@ class MmapIndexMap(IndexMap):
         self._parts: dict[int, tuple] = {}
         self._rev: Optional[tuple] = None
 
+    @property
+    def store_dir(self) -> str:
+        """On-disk store directory — the public handle for reopening this
+        map in another process (io/parallel_ingest ships it to workers)."""
+        return self._dir
+
     def _partition(self, p: int):
         if p not in self._parts:
             d = self._dir

@@ -325,6 +325,7 @@ class ContainerWriter:
         self._block = io.BytesIO()
         self._count = 0
         self.n_written = 0
+        self._path = path
         self._f = open(path, "wb")
         self._f.write(MAGIC)
         meta = {
@@ -378,11 +379,30 @@ class ContainerWriter:
             self._f.close()
             self._f = None
 
+    def abort(self) -> None:
+        """Close WITHOUT flushing the buffered block and rename the output to
+        ``<path>.partial``.
+
+        Avro containers have no end marker, so a flushed-then-abandoned file
+        is indistinguishable from complete output; an aborted chunked run
+        must not leave a well-formed partial file under the final name.
+        """
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+            try:
+                os.replace(self._path, self._path + ".partial")
+            except OSError:
+                pass  # unlinked/moved underneath us; nothing to mark
+
     def __enter__(self) -> "ContainerWriter":
         return self
 
-    def __exit__(self, *exc) -> None:
-        self.close()
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.abort()
+        else:
+            self.close()
 
 
 def write_container(

@@ -371,11 +371,16 @@ class ScoresWriter:
     def close(self) -> None:
         self._w.close()
 
+    def abort(self) -> None:
+        self._w.abort()
+
     def __enter__(self) -> "ScoresWriter":
         return self
 
-    def __exit__(self, *exc) -> None:
-        self.close()
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # Unwinding on an exception must not leave a well-formed partial
+        # scores file under the final name (see ContainerWriter.abort).
+        self._w.__exit__(exc_type, exc, tb)
 
 
 def save_scores(

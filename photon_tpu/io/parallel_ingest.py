@@ -43,7 +43,16 @@ __all__ = ["read_parallel"]
 
 def _index_spec(im: IndexMap):
     if isinstance(im, MmapIndexMap):
-        return ("mmap", im._dir)
+        from photon_tpu.io.streaming import Unsupported
+
+        # Workers reopen the store by path (spawn = same filesystem); a
+        # missing directory must surface as Unsupported HERE, before a pool
+        # spawns, so the caller's in-process fallback triggers cleanly.
+        if not os.path.isdir(im.store_dir):
+            raise Unsupported(
+                f"mmap index store not a directory: {im.store_dir!r}"
+            )
+        return ("mmap", im.store_dir)
     try:
         return ("keys", list(im.keys_in_order))
     except AttributeError:
@@ -57,6 +66,12 @@ def _index_spec(im: IndexMap):
 def _index_from_spec(spec) -> IndexMap:
     kind, payload = spec
     if kind == "mmap":
+        if not os.path.isdir(payload):
+            raise FileNotFoundError(
+                f"mmap index store {payload!r} not visible in worker "
+                "process (store must live on a filesystem shared with the "
+                "driver)"
+            )
         return MmapIndexMap(payload)
     return DefaultIndexMap(payload)
 

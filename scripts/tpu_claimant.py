@@ -1,0 +1,22 @@
+"""Single TPU claimant: probe the axon tunnel, run a tiny matmul, exit 0.
+
+Wedge protocol (.claude/skills/verify/SKILL.md): exactly ONE of these at a
+time; never kill it with SIGKILL; poll the log instead.
+"""
+import time, sys
+t0 = time.time()
+print(f"[claimant] start {time.strftime('%H:%M:%S')}", flush=True)
+import jax
+try:
+    devs = jax.devices()
+    t1 = time.time()
+    print(f"[claimant] devices OK in {t1-t0:.1f}s: {devs}", flush=True)
+    import jax.numpy as jnp
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    y = (x @ x).block_until_ready()
+    print(f"[claimant] matmul OK in {time.time()-t1:.1f}s; platform={devs[0].platform}", flush=True)
+    print("[claimant] SUCCESS", flush=True)
+    sys.exit(0)
+except Exception as e:
+    print(f"[claimant] FAILED after {time.time()-t0:.1f}s: {type(e).__name__}: {e}", flush=True)
+    sys.exit(1)

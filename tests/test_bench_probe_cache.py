@@ -1,0 +1,114 @@
+"""Probe-verdict cache in bench.py (VERDICT round-3 weak #7).
+
+A wedged TPU tunnel makes the accelerator probe burn its full timeout before
+falling back to CPU; the cache makes the SECOND run inside a wedged window
+start in seconds instead. Only failure verdicts are cached — a healthy chip
+is always re-probed.
+"""
+import importlib.util
+import json
+import os
+import sys
+import time
+
+import pytest
+
+_BENCH_PATH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+
+@pytest.fixture
+def bench(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location("photon_bench", _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "PROBE_CACHE_PATH", str(tmp_path / "verdict.json"))
+    monkeypatch.setattr(mod, "PROBE_CACHE_TTL_S", 100.0)
+    return mod
+
+
+def _write(mod, verdict="failure", reason="wedged", age_s=0.0):
+    with open(mod.PROBE_CACHE_PATH, "w") as f:
+        json.dump(
+            {"verdict": verdict, "reason": reason, "time": time.time() - age_s},
+            f,
+        )
+
+
+def test_fresh_failure_is_returned(bench):
+    _write(bench, age_s=10.0)
+    got = bench._read_cached_probe_failure()
+    assert got is not None
+    reason, age = got
+    assert reason == "wedged"
+    assert 9.0 <= age <= 60.0
+
+
+def test_stale_failure_is_ignored(bench):
+    _write(bench, age_s=101.0)
+    assert bench._read_cached_probe_failure() is None
+
+
+def test_future_timestamp_is_ignored(bench):
+    _write(bench, age_s=-30.0)  # clock skew / tampered file
+    assert bench._read_cached_probe_failure() is None
+
+
+def test_non_failure_and_corrupt_are_ignored(bench):
+    _write(bench, verdict="success")
+    assert bench._read_cached_probe_failure() is None
+    with open(bench.PROBE_CACHE_PATH, "w") as f:
+        f.write("{not json")
+    assert bench._read_cached_probe_failure() is None
+    os.remove(bench.PROBE_CACHE_PATH)
+    assert bench._read_cached_probe_failure() is None
+
+
+def test_write_then_clear_roundtrip(bench):
+    bench._write_probe_failure("probe hung > 240s")
+    got = bench._read_cached_probe_failure()
+    assert got is not None and got[0] == "probe hung > 240s"
+    bench._clear_probe_cache()
+    assert bench._read_cached_probe_failure() is None
+    bench._clear_probe_cache()  # idempotent on a missing file
+
+
+def test_probe_backend_uses_cached_verdict_fast(bench, monkeypatch):
+    """A cached failure must short-circuit _probe_backend (no subprocess)."""
+    _write(bench, reason="probe hung > 240s (wedged device grant?)", age_s=5.0)
+    monkeypatch.setattr(bench, "SMOKE", False)
+
+    def _boom(*a, **k):  # any subprocess launch means the cache was ignored
+        raise AssertionError("probe subprocess launched despite cached verdict")
+
+    import subprocess
+
+    monkeypatch.setattr(subprocess, "Popen", _boom)
+    t0 = time.perf_counter()
+    bench._probe_backend(timeout_s=240.0)
+    assert time.perf_counter() - t0 < 5.0
+    assert bench.BACKEND_FALLBACK is not None
+    assert "cached probe verdict" in bench.BACKEND_FALLBACK
+    assert "wedged device grant" in bench.BACKEND_FALLBACK
+    # fallback shrinks workloads to smoke shapes
+    assert (bench.N_ROWS, bench.DIM, bench.K, bench.MAX_ITER) == bench.SMOKE_SHAPES
+
+
+def test_force_probe_bypasses_cache(bench, monkeypatch):
+    _write(bench, age_s=5.0)
+    monkeypatch.setattr(bench, "SMOKE", False)
+    monkeypatch.setenv("PHOTON_BENCH_FORCE_PROBE", "1")
+
+    probed = {}
+
+    class _FakeProc:
+        returncode = 0
+
+        def communicate(self, timeout=None):
+            probed["ran"] = True
+            return "cpu\n", ""
+
+    import subprocess
+
+    monkeypatch.setattr(subprocess, "Popen", lambda *a, **k: _FakeProc())
+    bench._probe_backend(timeout_s=1.0)
+    assert probed.get("ran"), "--force-probe must re-run the real probe"

@@ -232,3 +232,21 @@ class TestMultiHostPrimitives:
             assert g[k].shape == batch[k].shape
             assert g[k].sharding == ref[k].sharding
             np.testing.assert_array_equal(np.asarray(g[k]), batch[k])
+
+    def test_benign_init_phrases_pinned_to_installed_jax(self):
+        """ADVICE r3: ensure_initialized classifies double-init as benign by
+        matching exact jax error text; a jax upgrade that rewords those
+        messages would silently turn a benign double-init into a hard
+        failure. Pin the matched phrases against the installed jax source so
+        the upgrade trips THIS test instead of breaking single-host flows."""
+        import inspect
+
+        import jax._src.distributed as jdist
+
+        src = inspect.getsource(jdist).lower()
+        # Phrases matched in photon_tpu/parallel/distributed.py (benign set).
+        for phrase in ("only be called once", "must be called before"):
+            assert phrase in src, (
+                f"jax {jax.__version__} no longer raises {phrase!r}: update "
+                "the benign-error classification in parallel/distributed.py"
+            )

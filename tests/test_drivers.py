@@ -451,23 +451,27 @@ def test_scoring_driver_chunked_matches_whole(game_data, tmp_path):
     small = tmp_path / "val_small_blocks.avro"
     write_container(str(small), schema, list(it), block_records=16)
 
+    # AUC:userId exercises the chunked grouped-evaluation path: group ids
+    # are dictionary-encoded incrementally per chunk and must produce the
+    # same grouped metric as the whole-dataset factorization.
     whole = game_scoring_driver.run([
         "--data", str(small),
         "--model-dir", str(out / "best"),
         "--output-dir", str(tmp_path / "s_whole"),
-        "--evaluators", "AUC",
+        "--evaluators", "AUC", "AUC:userId",
     ])
     chunked = game_scoring_driver.run([
         "--data", str(small),
         "--model-dir", str(out / "best"),
         "--output-dir", str(tmp_path / "s_chunk"),
-        "--evaluators", "AUC",
+        "--evaluators", "AUC", "AUC:userId",
         "--chunk-rows", "48",
     ])
     assert chunked["n_rows"] == whole["n_rows"] == n_val
-    assert chunked["evaluation"]["AUC"] == pytest.approx(
-        whole["evaluation"]["AUC"], abs=1e-6
-    )
+    for metric in ("AUC", "AUC:userId"):
+        assert chunked["evaluation"][metric] == pytest.approx(
+            whole["evaluation"][metric], abs=1e-6
+        )
     rw = read_records(str(tmp_path / "s_whole" / "scores.avro"))
     rc = read_records(str(tmp_path / "s_chunk" / "scores.avro"))
     assert [r["uid"] for r in rc] == [r["uid"] for r in rw]

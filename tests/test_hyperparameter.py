@@ -182,6 +182,13 @@ class TestSearch:
         assert gp.best_value < rnd.best_value + 1e-9
         assert gp.best_value < 2.0  # close to the 0.398 optimum
 
+    def test_zero_trials_returns_empty_result(self):
+        # ADVICE r3: np.stack([]) raises; n=0 must return an empty result.
+        for cls in (RandomSearch, GaussianProcessSearch):
+            res = cls(self.RESCALING, seed=0).search(_branin, 0)
+            assert res.points.shape == (0, 2)
+            assert len(res.values) == 0
+
     def test_gp_search_warm_start_observations(self):
         s = GaussianProcessSearch(self.RESCALING, n_seed=3, seed=1)
         s.observe(np.asarray([np.pi, 2.275]), _branin([np.pi, 2.275]))  # near-opt

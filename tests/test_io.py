@@ -107,6 +107,24 @@ class TestAvroBinary:
         out = read_records(path)
         assert out == recs
 
+    def test_exception_exit_leaves_no_final_file(self, tmp_path):
+        """ADVICE r3: Avro containers have no end marker, so an aborted
+        chunked run must not leave a well-formed partial file under the
+        final name — it is renamed ``<path>.partial``."""
+        from photon_tpu.io.avro import ContainerWriter
+
+        path = str(tmp_path / "scores.avro")
+        with pytest.raises(RuntimeError, match="mid-run"):
+            with ContainerWriter(path, "long", block_records=4) as w:
+                w.write_many(range(10))
+                raise RuntimeError("mid-run failure")
+        assert not os.path.exists(path)
+        assert os.path.exists(path + ".partial")
+        # Clean exit still produces the final file.
+        with ContainerWriter(path, "long", block_records=4) as w:
+            w.write_many(range(10))
+        assert read_records(path) == list(range(10))
+
     def test_corrupt_sync_detected(self, tmp_path):
         path = str(tmp_path / "x.avro")
         write_container(path, "long", list(range(10)))
