@@ -253,7 +253,30 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                     f"peer hosts dead={report.dead} missing={report.missing}; "
                     "restart the job (checkpoint resume will fast-forward)"
                 )
-        return _run_inner(args, task)
+        watchdog = None
+        if heartbeat is not None:
+            import jax
+
+            if jax.process_count() > 1:
+                # LIVE detection (round-3 scope note closed): a psum whose
+                # peer died blocks the main thread in C++ forever, so the
+                # between-attempts check above can never run while an attempt
+                # is wedged. Armed ONLY around the attempt body: between
+                # attempts the graceful check_peers path (and the retry
+                # loop's diagnostics) stay reachable. The watchdog aborts
+                # from a daemon thread (exit 43) and hands recovery to the
+                # scheduler restart + checkpoint resume.
+                import logging
+
+                watchdog = heartbeat.watchdog(
+                    range(jax.process_count()),
+                    logger=logging.getLogger("photon_tpu.supervisor"),
+                ).start()
+        try:
+            return _run_inner(args, task)
+        finally:
+            if watchdog is not None:
+                watchdog.stop()
 
     try:
         if args.max_restarts > 0:

@@ -33,6 +33,12 @@ missing Spark-runtime equivalents:
   surviving host blocks forever inside a psum whose peer died. The training
   driver checks peers between restart attempts and fails fast with the dead
   host list instead of hanging.
+
+* :class:`PeerWatchdog` — LIVE detection during the solve. The
+  between-attempts check above cannot fire while the main thread is wedged
+  inside a collective; the watchdog monitors heartbeats from a daemon
+  thread and hard-exits the process (``WATCHDOG_EXIT_CODE``) when peers go
+  stale, so the outer scheduler's restart + checkpoint resume takes over.
 """
 from __future__ import annotations
 
@@ -49,6 +55,8 @@ __all__ = [
     "run_with_recovery",
     "Heartbeat",
     "PeerReport",
+    "PeerWatchdog",
+    "WATCHDOG_EXIT_CODE",
 ]
 
 
@@ -234,6 +242,14 @@ class Heartbeat:
     def __exit__(self, *exc) -> None:
         self.stop()
 
+    def watchdog(
+        self,
+        expected: Sequence[int],
+        **kwargs,
+    ) -> "PeerWatchdog":
+        """A :class:`PeerWatchdog` over this beacon (sugar for the driver)."""
+        return PeerWatchdog(self, expected, **kwargs)
+
     def check_peers(
         self,
         expected: Sequence[int],
@@ -265,3 +281,138 @@ class Heartbeat:
                 continue
             (alive if age <= max_age_seconds else dead).append(pid)
         return PeerReport(alive=alive, dead=dead, missing=missing)
+
+
+WATCHDOG_EXIT_CODE = 43  # distinct from restart-budget exits; scheduler-visible
+
+
+class PeerWatchdog:
+    """Live peer monitor that aborts a hung process DURING the solve.
+
+    A collective whose peer died blocks forever inside the XLA runtime — no
+    Python exception can interrupt it, so the between-attempts
+    ``check_peers`` in the retry loop never runs (round-3 scope note). This
+    daemon thread checks peer heartbeats every ``check_interval_seconds``
+    while the solve is in flight; after ``grace_checks`` CONSECUTIVE
+    unhealthy reports it invokes ``on_dead(report)`` — by default: write
+    ``<dir>/watchdog-abort.json`` for the postmortem, log, and
+    ``os._exit(WATCHDOG_EXIT_CODE)``. A nonzero exit hands recovery to the
+    outer scheduler (k8s/systemd restartPolicy), whose process restart lands
+    in checkpoint resume — the same division of labor as Spark's executor
+    relaunch under YARN.
+
+    ``os._exit``, not ``sys.exit``: the main thread is wedged in C++ and will
+    never unwind; only a hard process exit releases it.
+    """
+
+    def __init__(
+        self,
+        heartbeat: Heartbeat,
+        expected: Sequence[int],
+        check_interval_seconds: Optional[float] = None,
+        max_age_seconds: Optional[float] = None,
+        grace_checks: int = 2,
+        startup_grace_seconds: float = 120.0,
+        on_dead: Optional[Callable[[PeerReport], None]] = None,
+        logger=None,
+    ):
+        self.heartbeat = heartbeat
+        self.expected = [int(p) for p in expected]
+        self.check_interval_seconds = (
+            heartbeat.interval_seconds
+            if check_interval_seconds is None
+            else check_interval_seconds
+        )
+        self.max_age_seconds = max_age_seconds
+        self.grace_checks = max(1, int(grace_checks))
+        # A peer that has NEVER been seen is distinct from one that stopped:
+        # startup skew or shared-fs attribute caching (NFS acdirmin) can hide
+        # a healthy peer's fresh file for many seconds. Never-seen peers only
+        # count as unhealthy after this grace; once seen, vanishing or going
+        # stale counts immediately.
+        self.startup_grace_seconds = startup_grace_seconds
+        self.on_dead = on_dead if on_dead is not None else self._abort
+        self.logger = logger
+        self.fired: Optional[PeerReport] = None
+        self._seen: set = set()
+        self._stop = None
+        self._thread = None
+
+    def _abort(self, report: PeerReport) -> None:
+        try:
+            payload = {
+                "process_id": self.heartbeat.process_id,
+                "time": time.time(),
+                "dead": report.dead,
+                "missing": report.missing,
+                "alive": report.alive,
+            }
+            path = os.path.join(
+                self.heartbeat.directory,
+                f"watchdog-abort.host-{self.heartbeat.process_id}.json",
+            )
+            with open(path + ".tmp", "w") as f:
+                json.dump(payload, f)
+            os.replace(path + ".tmp", path)
+        except OSError:
+            pass  # the exit below is the point; the breadcrumb is best-effort
+        if self.logger is not None:
+            self.logger.error(
+                "peer watchdog: dead=%s missing=%s — aborting for scheduler "
+                "restart (exit %d; checkpoint resume fast-forwards)",
+                report.dead, report.missing, WATCHDOG_EXIT_CODE,
+            )
+        os._exit(WATCHDOG_EXIT_CODE)
+
+    def start(self) -> "PeerWatchdog":
+        import threading
+
+        if self._thread is not None:
+            return self
+        self._stop = threading.Event()
+
+        started = time.monotonic()
+
+        def loop():
+            strikes = 0
+            while not self._stop.wait(self.check_interval_seconds):
+                try:
+                    report = self.heartbeat.check_peers(
+                        self.expected, self.max_age_seconds
+                    )
+                except OSError:
+                    continue  # shared fs hiccup; next check retries
+                self._seen.update(report.alive)
+                self._seen.update(report.dead)  # a stale file was still seen
+                in_grace = (
+                    time.monotonic() - started < self.startup_grace_seconds
+                )
+                unhealthy = bool(report.dead) or any(
+                    # missing-after-seen = vanished peer; missing-never-seen
+                    # only counts once the startup grace has elapsed
+                    (p in self._seen) or not in_grace
+                    for p in report.missing
+                )
+                strikes = strikes + 1 if unhealthy else 0
+                if strikes >= self.grace_checks:
+                    self.fired = report
+                    self.on_dead(report)
+                    return
+
+        self._thread = threading.Thread(
+            target=loop, daemon=True, name="photon-peer-watchdog"
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "PeerWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

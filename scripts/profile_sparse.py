@@ -1,0 +1,145 @@
+"""On-chip sparse-op microprofile (VERDICT r3 asks #2/#3).
+
+Times each candidate implementation of the GLM hot ops at bench shape on the
+real accelerator and dumps one JSON file. Run as the SINGLE TPU claimant:
+
+    nohup python scripts/profile_sparse.py > /tmp/profile_sparse.log 2>&1 &
+
+Stages (each timed warm, best-of-3, synced by D2H scalar fetch — the axon
+tunnel does not synchronize on block_until_ready):
+  - hbm_gbps: differenced fori_loop bandwidth (the roofline denominator)
+  - matvec_gather / matvec_fast / matvec_pallas
+  - rmatvec_segsum / rmatvec_fast / rmatvec_pallas
+  - fused_pass_fast / fused_pass_pallas (value+grad, the real per-iteration op)
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+OUT = f"/tmp/profile_sparse.{os.getuid()}.json"
+N, D, K = 1 << 19, 1 << 18, 32  # bench headline shape: 201 MB of idx+val+out
+
+
+def main() -> None:
+    t0 = time.time()
+    import jax
+    import jax.numpy as jnp
+
+    print(f"devices: {jax.devices()} ({time.time()-t0:.1f}s)", flush=True)
+    sys.path.insert(0, "/root/repo")
+
+    from photon_tpu.data.batch import SparseFeatures
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, D, size=(N, K)).astype(np.int32)
+    val = (rng.normal(size=(N, K)) / np.sqrt(K)).astype(np.float32)
+    w = rng.normal(size=D).astype(np.float32)
+    dz = rng.normal(size=N).astype(np.float32)
+
+    results: dict = {"n": N, "dim": D, "k": K}
+
+    def save() -> None:
+        with open(OUT, "w") as f:
+            json.dump(results, f, indent=1)
+
+    def timed(name, fn, *args):
+        try:
+            jfn = jax.jit(fn)
+            np.asarray(jfn(*args))  # compile + warm
+            best = float("inf")
+            for _ in range(3):
+                t = time.perf_counter()
+                np.asarray(jfn(*args))
+                best = min(best, time.perf_counter() - t)
+            results[name] = round(best * 1e3, 3)  # ms
+            print(f"{name}: {best*1e3:.2f} ms", flush=True)
+        except Exception as e:  # noqa: BLE001 - record and continue
+            results[name + "_error"] = f"{type(e).__name__}: {e}"[:300]
+            print(f"{name} FAILED: {e}", flush=True)
+        save()
+
+    # Roofline denominator
+    from bench import measured_hbm_bandwidth  # repo-root bench.py
+
+    try:
+        results["hbm_gbps"] = round(measured_hbm_bandwidth(), 1)
+        print(f"hbm_gbps: {results['hbm_gbps']}", flush=True)
+    except Exception as e:  # noqa: BLE001
+        results["hbm_gbps_error"] = str(e)[:300]
+    save()
+
+    ji, jv, jw, jdz = map(jnp.asarray, (idx, val, w, dz))
+
+    # --- naive XLA formulations (the 100x-off lowerings, for the record)
+    timed("matvec_gather_ms", lambda w_, i_, v_: (v_ * w_[i_]).sum(1), jw, ji, jv)
+    timed(
+        "rmatvec_segsum_ms",
+        lambda dz_, i_, v_: jax.ops.segment_sum(
+            (dz_[:, None] * v_).ravel(), i_.ravel(), num_segments=D
+        ),
+        jdz, ji, jv,
+    )
+
+    # --- current XLA fast paths
+    base = SparseFeatures(idx=ji, val=jv, dim=D).with_fast_path()
+    aux = base.fast
+    from photon_tpu.ops.fast_sparse import matvec_fast, rmatvec_fast
+
+    timed("matvec_fast_ms", lambda w_: matvec_fast(aux, jv, w_, D), jw)
+    timed("rmatvec_fast_ms", lambda dz_: rmatvec_fast(aux, dz_, D), jdz)
+
+    def fused_fast(w_, dz_):
+        z = matvec_fast(aux, jv, w_, D)
+        g = rmatvec_fast(aux, dz_, D)
+        return z.sum() + g.sum()
+
+    timed("fused_pass_fast_ms", fused_fast, jw, jdz)
+
+    # --- Pallas kernels (the unproven-on-hw contenders)
+    try:
+        from photon_tpu.ops.pallas_sparse import (
+            build_pallas_aux,
+            matvec_pallas,
+            rmatvec_pallas,
+        )
+
+        paux = build_pallas_aux(idx, val, D)
+        if paux is None:
+            results["pallas_note"] = "build_pallas_aux returned None (budget)"
+        else:
+            timed("matvec_pallas_ms", lambda w_: matvec_pallas(paux, w_), jw)
+            timed(
+                "rmatvec_pallas_ms", lambda dz_: rmatvec_pallas(paux, dz_), jdz
+            )
+
+            def fused_pallas(w_, dz_):
+                return (
+                    matvec_pallas(paux, w_).sum()
+                    + rmatvec_pallas(paux, dz_).sum()
+                )
+
+            timed("fused_pass_pallas_ms", fused_pallas, jw, jdz)
+    except Exception as e:  # noqa: BLE001
+        results["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+    save()
+
+    bytes_per_pass = N * K * 12
+    if "hbm_gbps" in results and "fused_pass_fast_ms" in results:
+        ideal_ms = bytes_per_pass / (results["hbm_gbps"] * 1e9) * 1e3 * 2
+        # x2: a fused pass touches idx+val twice (matvec + rmatvec)
+        for key in ("fused_pass_fast_ms", "fused_pass_pallas_ms"):
+            if key in results:
+                results[key.replace("_ms", "_fraction_of_roofline")] = round(
+                    ideal_ms / results[key], 4
+                )
+    save()
+    print("DONE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
