@@ -677,20 +677,73 @@ def bench_tuner():
             regularization=l2, reg_weight=1.0, max_iterations=10)
         for cid in ("fixed", "perUser", "perItem")
     }
+    reg_ranges = {"fixed": (0.01, 100.0), "perUser": (0.01, 100.0),
+                  "perItem": (0.01, 100.0)}
     n_trials = 2 if SMOKE else 3
-    t0 = time.perf_counter()
-    result = tune_regularization(
-        estimator, train, val, base,
-        reg_ranges={"fixed": (0.01, 100.0), "perUser": (0.01, 100.0),
-                    "perItem": (0.01, 100.0)},
-        n_iterations=n_trials, strategy="gp",
-    )
-    dt = time.perf_counter() - t0
-    return {
+    trial_seconds: list = []
+    t_last = time.perf_counter()
+    orig_fit = type(estimator).fit
+
+    def timed_fit(self, *a, **kw):
+        out = orig_fit(self, *a, **kw)
+        nonlocal t_last
+        now = time.perf_counter()
+        trial_seconds.append(round(now - t_last, 2))
+        t_last = now
+        return out
+
+    type(estimator).fit = timed_fit
+    try:
+        t0 = time.perf_counter()
+        result = tune_regularization(
+            estimator, train, val, base, reg_ranges=reg_ranges,
+            n_iterations=n_trials, strategy="gp",
+        )
+        dt = time.perf_counter() - t0
+    finally:
+        type(estimator).fit = orig_fit
+
+    out = {
         "tuner_trials": n_trials,
+        "tuner_total_seconds": round(dt, 2),
         "tuner_seconds_per_trial": round(dt / n_trials, 2),
+        "tuner_trial_seconds": trial_seconds[:n_trials],
         "tuner_best_auc": round(float(-result.search.best_value), 4),
     }
+
+    # Kill/resume demonstration (BASELINE config 4's operational story): run
+    # one trial under a checkpoint manager, then a fresh call resumes and
+    # finishes the remaining trials with bit-identical history semantics.
+    import shutil
+    import tempfile
+
+    from photon_tpu.checkpoint import CheckpointManager
+
+    ckdir = tempfile.mkdtemp(prefix="photon_bench_tuner_ck_")
+    try:
+        t0 = time.perf_counter()
+        tune_regularization(
+            estimator, train, val, base, reg_ranges=reg_ranges,
+            n_iterations=1, strategy="gp",
+            checkpoint_manager=CheckpointManager(ckdir),
+        )
+        out["tuner_ck_first_trial_seconds"] = round(time.perf_counter() - t0, 2)
+        t0 = time.perf_counter()
+        resumed = tune_regularization(
+            estimator, train, val, base, reg_ranges=reg_ranges,
+            n_iterations=n_trials, strategy="gp",
+            checkpoint_manager=CheckpointManager(ckdir),
+        )
+        out["tuner_resume_remaining_seconds"] = round(
+            time.perf_counter() - t0, 2
+        )
+        out["tuner_resume_matches_best"] = bool(
+            abs(float(resumed.search.best_value)
+                - float(result.search.best_value)) < 1e-9
+        )
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+    return out
 
 
 def bench_ingest():

@@ -257,6 +257,27 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         if heartbeat is not None:
             import jax
 
+            # Attempt-epoch barrier: a host may only (re-)enter the solve
+            # once EVERY peer advertises the same attempt index. A lone
+            # retrier would otherwise issue collectives that mismatch a peer
+            # still blocked in the previous attempt's psum — all hosts then
+            # hang with perfectly fresh heartbeats, invisible to both the
+            # dead-peer check above and the liveness watchdog below.
+            heartbeat.set_epoch(i)
+            if i > 0 and jax.process_count() > 1:
+                # (attempt 0 needs no barrier: the jax.distributed runtime
+                # bring-up already synchronized process start.)
+                laggards = heartbeat.wait_for_epoch(
+                    range(jax.process_count()), i,
+                    timeout_seconds=max(30.0, 3 * args.restart_backoff),
+                )
+                if laggards:
+                    raise RestartsUselessError(
+                        f"peer hosts {laggards} never reached attempt epoch "
+                        f"{i} (wedged in a previous attempt's collective?); "
+                        "restart the job (checkpoint resume will "
+                        "fast-forward)"
+                    )
             if jax.process_count() > 1:
                 # LIVE detection (round-3 scope note closed): a psum whose
                 # peer died blocks the main thread in C++ forever, so the

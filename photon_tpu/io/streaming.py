@@ -506,18 +506,49 @@ def ell_from_triples(
     )
     k = int(counts.max()) + base if n_rows else base
     k = max(k, 1)
-    starts = np.zeros(n_rows + 1, np.int64)
-    np.cumsum(counts, out=starts[1:])
     iarr = np.full((n_rows, k), dim, np.int32)
     varr = np.zeros((n_rows, k), np.dtype(dtype))
     if len(rows):
-        pos = np.arange(len(rows), dtype=np.int64) - starts[rows] + base
-        iarr[rows, pos] = idx
-        varr[rows, pos] = vals.astype(varr.dtype)
+        scatter = _ell_scatter_fn(varr.dtype)
+        if scatter is not None:
+            fn, out_ctype = scatter
+            rows32 = np.ascontiguousarray(rows, np.int32)
+            idx32 = np.ascontiguousarray(idx, np.int32)
+            vals64 = np.ascontiguousarray(vals, np.float64)
+            fn(
+                _np_ptr(rows32, ctypes.c_int32),
+                _np_ptr(idx32, ctypes.c_int32),
+                _np_ptr(vals64, ctypes.c_double),
+                len(rows32), k, base,
+                _np_ptr(iarr, ctypes.c_int32),
+                _np_ptr(varr, out_ctype),
+            )
+        else:
+            starts = np.zeros(n_rows + 1, np.int64)
+            np.cumsum(counts, out=starts[1:])
+            pos = np.arange(len(rows), dtype=np.int64) - starts[rows] + base
+            iarr[rows, pos] = idx
+            varr[rows, pos] = vals.astype(varr.dtype)
     if base:
         iarr[:, 0] = intercept_index
         varr[:, 0] = 1.0
     return SparseFeatures(idx=iarr, val=varr, dim=dim)
+
+
+def _ell_scatter_fn(dtype: np.dtype):
+    """(native scatter fn, output ctype) for float32/float64 outputs, None
+    otherwise (fallback to the numpy fancy-index path — e.g. no compiler,
+    or exotic dtypes)."""
+    from photon_tpu import native
+
+    lib = native.get_lib()
+    if lib is None:
+        return None
+    if dtype == np.float32:
+        return lib.ph_ell_scatter_f32, ctypes.c_float
+    if dtype == np.float64:
+        return lib.ph_ell_scatter_f64, ctypes.c_double
+    return None
 
 
 # ---------------------------------------------------------------------------

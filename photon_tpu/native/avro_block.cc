@@ -654,6 +654,40 @@ int64_t ph_decode_block(void* p, const uint8_t* payload, int64_t size, int64_t c
 
 int64_t ph_chunk_rows(void* p) { return ((State*)p)->n_rows; }
 
+// Scatter row-major (row, col, value) triples into preinitialized padded ELL
+// arrays ([n_rows, k]; iarr prefilled with the ghost column, varr with 0).
+// `rows` must be nondecreasing — exactly the order ph_get_shard_triples
+// emits — so each entry's slot is a running position within its row, no
+// per-row counts or index arithmetic arrays needed (replaces the numpy
+// fancy-index scatter that was ~26% of ingest time).
+void ph_ell_scatter_f32(const int32_t* rows, const int32_t* idx,
+                        const double* val, int64_t nnz, int64_t k,
+                        int64_t base, int32_t* iarr, float* varr) {
+  int64_t pos = 0;
+  int32_t cur = -1;
+  for (int64_t e = 0; e < nnz; e++) {
+    int32_t r = rows[e];
+    if (r != cur) { cur = r; pos = base; }
+    int64_t o = (int64_t)r * k + pos++;
+    iarr[o] = idx[e];
+    varr[o] = (float)val[e];
+  }
+}
+
+void ph_ell_scatter_f64(const int32_t* rows, const int32_t* idx,
+                        const double* val, int64_t nnz, int64_t k,
+                        int64_t base, int32_t* iarr, double* varr) {
+  int64_t pos = 0;
+  int32_t cur = -1;
+  for (int64_t e = 0; e < nnz; e++) {
+    int32_t r = rows[e];
+    if (r != cur) { cur = r; pos = base; }
+    int64_t o = (int64_t)r * k + pos++;
+    iarr[o] = idx[e];
+    varr[o] = val[e];
+  }
+}
+
 void ph_get_num_col(void* p, int32_t col, double* out) {
   State& st = *(State*)p;
   std::memcpy(out, st.num_cols[col].data(), st.num_cols[col].size() * 8);

@@ -190,6 +190,7 @@ class Heartbeat:
         self.directory = directory
         self.process_id = int(process_id)
         self.interval_seconds = interval_seconds
+        self.epoch = 0
         self._stop = None
         self._thread = None
         self._beats = 0
@@ -205,11 +206,55 @@ class Heartbeat:
             "pid": os.getpid(),
             "time": time.time(),
             "beats": self._beats,
+            "epoch": self.epoch,
         }
         tmp = self._path(self.process_id) + ".tmp"
         with open(tmp, "w") as f:
             json.dump(payload, f)
         os.replace(tmp, self._path(self.process_id))
+
+    def set_epoch(self, epoch: int) -> None:
+        """Advertise this process's attempt epoch (and beat immediately).
+
+        Multi-host in-process retry is only safe when EVERY host re-enters
+        the attempt together — a host retrying alone issues collectives that
+        mismatch a peer still blocked in the previous attempt's psum, and
+        both then hang with perfectly fresh heartbeats. The epoch in the
+        beat payload is what :meth:`wait_for_epoch` synchronizes on.
+        """
+        self.epoch = int(epoch)
+        self.beat_once()
+
+    def peer_epochs(self, expected: Sequence[int]) -> dict:
+        """Last advertised attempt epoch per peer (-1: no/unreadable beat)."""
+        out = {}
+        for pid in expected:
+            try:
+                with open(self._path(pid)) as f:
+                    out[pid] = int(json.load(f).get("epoch", -1))
+            except (OSError, ValueError):
+                out[pid] = -1
+        return out
+
+    def wait_for_epoch(
+        self,
+        expected: Sequence[int],
+        epoch: int,
+        timeout_seconds: float = 30.0,
+        poll_seconds: Optional[float] = None,
+    ) -> list:
+        """Block until every expected peer advertises ``epoch`` or newer;
+        returns the laggards (empty = barrier passed). A peer wedged inside
+        the previous attempt's collective never advances its epoch, so the
+        caller can fail fast instead of desynchronizing the retry."""
+        poll = self.interval_seconds if poll_seconds is None else poll_seconds
+        deadline = time.monotonic() + timeout_seconds
+        while True:
+            epochs = self.peer_epochs(expected)
+            laggards = [p for p, e in epochs.items() if e < epoch]
+            if not laggards or time.monotonic() >= deadline:
+                return laggards
+            time.sleep(poll)
 
     def start(self) -> "Heartbeat":
         import threading

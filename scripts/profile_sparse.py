@@ -31,7 +31,9 @@ def main() -> None:
     import jax.numpy as jnp
 
     print(f"devices: {jax.devices()} ({time.time()-t0:.1f}s)", flush=True)
-    sys.path.insert(0, "/root/repo")
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
 
     from photon_tpu.data.batch import SparseFeatures
 

@@ -357,3 +357,32 @@ def test_watchdog_startup_grace_for_never_seen_peers(tmp_path):
     finally:
         wd.stop()
         me.stop()
+
+
+def test_attempt_epoch_barrier(tmp_path):
+    """A peer wedged in a previous attempt (epoch never advances) must be
+    reported as a laggard; a peer that advances passes the barrier."""
+    hdir = str(tmp_path / "hb")
+    me = Heartbeat(hdir, process_id=0, interval_seconds=0.05)
+    peer = Heartbeat(hdir, process_id=1, interval_seconds=0.05)
+    me.set_epoch(1)
+    peer.set_epoch(0)  # still in attempt 0: wedged in its collective
+
+    laggards = me.wait_for_epoch([0, 1], 1, timeout_seconds=0.3,
+                                 poll_seconds=0.05)
+    assert laggards == [1]
+
+    # Peer catches up mid-wait: the barrier passes before the timeout.
+    import threading
+
+    def advance():
+        time.sleep(0.2)
+        peer.set_epoch(1)
+
+    t = threading.Thread(target=advance)
+    t.start()
+    laggards = me.wait_for_epoch([0, 1], 1, timeout_seconds=5.0,
+                                 poll_seconds=0.05)
+    t.join()
+    assert laggards == []
+    assert me.peer_epochs([0, 1]) == {0: 1, 1: 1}
