@@ -391,12 +391,15 @@ def _run_inner(args, task) -> dict:
                     return read_parallel(
                         paths, index_maps, shard_cfgs, reader.columns,
                         id_tags, n_workers=args.ingest_workers,
-                        dtype=read_dtype,
+                        dtype=read_dtype, capture_uids=False,
                     )
                 except Unsupported as e:
                     logger.info("parallel ingest unavailable (%s); "
                                 "in-process read", e)
-            return reader.read(paths, dtype=read_dtype)
+            # Training never reads the uid column; skipping it keeps host
+            # memory at the numeric floor (10^8 uid strings would dwarf the
+            # ELL arrays themselves).
+            return reader.read(paths, dtype=read_dtype, capture_uids=False)
 
         with Timed("read training data", logger) as t:
             train = read_data(args.train_data)

@@ -147,11 +147,14 @@ class AvroDataReader:
         self._streaming = None
 
     def read(
-        self, paths, dtype=jnp.float32, require_labels: bool = True
+        self, paths, dtype=jnp.float32, require_labels: bool = True,
+        capture_uids: bool = True,
     ) -> GameDataBundle:
         """``require_labels=False`` admits unlabeled records (label → NaN) —
         the reference GameScoringDriver treats response as optional at
-        scoring time.
+        scoring time. ``capture_uids=False`` skips materializing the uid
+        string column (training never reads it; at 10^8 rows the Python
+        string objects would dominate host memory).
 
         Decoding goes through the streaming block engine
         (``io/streaming.py`` + the native decoder) when the schema supports
@@ -161,7 +164,9 @@ class AvroDataReader:
         from photon_tpu.io.streaming import StreamingAvroReader, Unsupported
 
         try:
-            if self._streaming is None:
+            if self._streaming is None or (
+                self._streaming.capture_uids != capture_uids
+            ):
                 # Cached: the per-shard hash tables and compiled programs are
                 # config-determined and reused across read() calls.
                 self._streaming = StreamingAvroReader(
@@ -169,6 +174,7 @@ class AvroDataReader:
                     self.shard_configs,
                     self.columns,
                     self.id_tag_columns,
+                    capture_uids=capture_uids,
                 )
             return self._streaming.read(
                 paths, dtype=dtype, require_labels=require_labels

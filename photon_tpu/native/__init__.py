@@ -16,7 +16,26 @@ from typing import Optional
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "avro_block.cc")
-_SO = os.path.join(_HERE, "_avro_block.so")
+
+
+def _isa_tag() -> str:
+    """Short tag of this host's vector ISA, so a -march=native build cached
+    in a checkout shared over a network filesystem is never dlopen'd by a
+    host with a different instruction set (SIGILL)."""
+    import hashlib
+    import platform
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.md5(line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    return platform.machine() or "unknown"
+
+
+_SO = os.path.join(_HERE, f"_avro_block.{_isa_tag()}.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None

@@ -200,6 +200,8 @@ class Heartbeat:
         return os.path.join(self.directory, f"host-{pid}.hb")
 
     def beat_once(self) -> None:
+        import threading
+
         self._beats += 1
         payload = {
             "process_id": self.process_id,
@@ -208,7 +210,14 @@ class Heartbeat:
             "beats": self._beats,
             "epoch": self.epoch,
         }
-        tmp = self._path(self.process_id) + ".tmp"
+        # Thread-unique tmp name: set_epoch beats from the caller's thread
+        # while the background loop beats on its own schedule; a shared tmp
+        # path would let one writer os.replace the other's file away mid-
+        # rename (FileNotFoundError out of a harmless race).
+        tmp = (
+            f"{self._path(self.process_id)}.tmp{os.getpid()}"
+            f".{threading.get_ident()}"
+        )
         with open(tmp, "w") as f:
             json.dump(payload, f)
         os.replace(tmp, self._path(self.process_id))

@@ -50,6 +50,7 @@ class phase:
 
     def __enter__(self):
         print(f"=== {self.name}", flush=True)
+        REPORT["phases"].setdefault(self.name, {})
         self.t0 = time.perf_counter()
         return self
 
@@ -93,15 +94,13 @@ def write_tiled_avro(path: str, n_rows: int, n_features: int, n_users: int,
     # Ground truth for the synthetic labels: sparse global weights.
     w = rng.normal(size=64).astype(np.float64)  # low-rank-ish signal
 
-    blocks: list[bytes] = []
-    n_blocks_unique = max(1, unique_rows // block_records)
-    for b in range(n_blocks_unique):
+    def encode_block(count: int, base_uid: int) -> bytes:
         buf = _io.BytesIO()
-        for i in range(block_records):
+        for i in range(count):
             ids = rng.integers(0, n_features, k)
             vals = rng.normal(size=k) / np.sqrt(k)
             z = float((vals * w[ids % 64]).sum())
-            uid = b * block_records + i
+            uid = base_uid + i
             enc.encode({
                 "uid": f"u{uid}",
                 "response": float(rng.random() < 1 / (1 + np.exp(-z))),
@@ -111,7 +110,12 @@ def write_tiled_avro(path: str, n_rows: int, n_features: int, n_users: int,
                 ],
                 "metadataMap": {"userId": f"user{uid % n_users}"},
             }, out=buf)
-        blocks.append(buf.getvalue())
+        return buf.getvalue()
+
+    blocks: list[bytes] = []
+    n_blocks_unique = max(1, min(unique_rows, n_rows) // block_records)
+    for b in range(n_blocks_unique):
+        blocks.append(encode_block(block_records, b * block_records))
 
     from photon_tpu.io.avro import MAGIC, SYNC_SIZE
     import json as _json
@@ -127,17 +131,24 @@ def write_tiled_avro(path: str, n_rows: int, n_features: int, n_users: int,
         }))
         f.write(sync)
         hdr_enc = Encoder("long")
-        bi = 0
-        while written < n_rows:
-            take = min(block_records, n_rows - written)
-            payload = blocks[bi % len(blocks)]
-            if take < block_records:
-                break  # tail short block: skip (rows are approximate anyway)
-            f.write(hdr_enc.encode(block_records))
+
+        def write_block(count: int, payload: bytes) -> None:
+            f.write(hdr_enc.encode(count))
             f.write(hdr_enc.encode(len(payload)))
             f.write(payload)
             f.write(sync)
-            written += take
+
+        bi = 0
+        while written < n_rows:
+            remaining = n_rows - written
+            if remaining < block_records:
+                # Short tail block, encoded fresh so the file holds EXACTLY
+                # n_rows (a tail-skip would write 0 rows for small n_rows).
+                write_block(remaining, encode_block(remaining, written))
+                written += remaining
+                break
+            write_block(block_records, blocks[bi % len(blocks)])
+            written += block_records
             bi += 1
     os.replace(path + ".tmp", path)
     return written
@@ -152,8 +163,18 @@ def main() -> None:
     ap.add_argument("--out", default="/tmp/photon_rehearsal")
     ap.add_argument("--smoke", action="store_true",
                     help="CPU-feasible shapes; mechanics only")
+    ap.add_argument("--tpu", action="store_true",
+                    help="allow the real accelerator (claims the single-"
+                         "client tunnel!); default pins the CPU backend")
     ap.add_argument("--keep-data", action="store_true")
     args = ap.parse_args()
+    if not args.tpu:
+        # This image's sitecustomize force-sets jax_platforms="axon,cpu";
+        # without the pin a 'CPU' rehearsal would become a second TPU
+        # claimant and could wedge the single-client tunnel (verify skill).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     if args.smoke:
         args.rows = min(args.rows, 2_000_000)
         args.features = min(args.features, 100_000)
@@ -167,11 +188,23 @@ def main() -> None:
     }
     data = os.path.join(args.out, "train.avro")
 
+    shape = {"rows": args.rows, "features": args.features,
+             "users": args.users, "unique_rows": args.unique_rows}
+    meta_path = data + ".meta.json"
     with phase("write_tiled_avro", args.out):
-        if not os.path.exists(data):
+        cached_ok = False
+        if os.path.exists(data) and os.path.exists(meta_path):
+            with open(meta_path) as f:
+                cached_ok = json.load(f) == shape
+        if not cached_ok:
+            # Never reuse a file written at a different shape: the artifact
+            # would report rows/s against rows that were never in the file.
             n = write_tiled_avro(data, args.rows, args.features, args.users,
                                  args.unique_rows)
             REPORT["phases"]["write_tiled_avro"]["rows_written"] = n
+            assert n == args.rows, (n, args.rows)
+            with open(meta_path, "w") as f:
+                json.dump(shape, f)
         REPORT["phases"]["write_tiled_avro"]["file_gb"] = round(
             os.path.getsize(data) / 1e9, 2
         )
@@ -191,7 +224,7 @@ def main() -> None:
             "perUser:type=random,re_type=userId,shard=global,reg=L2,"
             "max_iter=10,reg_weights=1",
             "--checkpoint-dir", os.path.join(args.out, "ck"),
-            "--mesh", "model=1",
+            "--mesh", "data=1,model=1",
         ])
         took = time.perf_counter() - t0
         REPORT["phases"]["train"]["summary"] = {

@@ -1,0 +1,71 @@
+"""TPU recovery daemon: rotate single claimants, log every attempt.
+
+Wedge protocol (.claude/skills/verify/SKILL.md): exactly ONE claimant at a
+time, no SIGKILL, sequential rotation. Each attempt's outcome is appended to
+``TPU_RECOVERY.jsonl`` in the repo root so the round's bench artifact can
+prove recovery was attempted continuously even if the chip never answers
+(VERDICT r3 ask #1).
+
+On SUCCESS the daemon stops rotating and leaves ``/tmp/tpu_up.flag`` so the
+operator (or a watching build loop) knows the chip is claimable.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_RECOVERY.jsonl")
+FLAG = "/tmp/tpu_up.flag"
+CLAIMANT = os.path.join(REPO, "scripts", "tpu_claimant.py")
+
+
+def other_claimant_running() -> bool:
+    out = subprocess.run(
+        ["pgrep", "-f", "tpu_claimant.py"], capture_output=True, text=True
+    ).stdout.split()
+    return any(int(p) != os.getpid() for p in out if p.isdigit())
+
+
+def log(entry: dict) -> None:
+    entry["time"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(LOG, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def main() -> None:
+    attempt = 0
+    while True:
+        # Re-checked before EVERY attempt: a manual claimant started during
+        # rotation must never overlap with ours (two claimants re-wedge the
+        # single-client tunnel).
+        while other_claimant_running():
+            time.sleep(30)
+        attempt += 1
+        t0 = time.time()
+        p = subprocess.Popen(
+            [sys.executable, CLAIMANT],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        out, _ = p.communicate()  # no timeout: the claim may block ~25-75min
+        took = round(time.time() - t0, 1)
+        ok = p.returncode == 0 and "SUCCESS" in out
+        log({
+            "attempt": attempt,
+            "seconds": took,
+            "ok": ok,
+            "tail": out.strip().splitlines()[-1][-200:] if out.strip() else "",
+        })
+        if ok:
+            with open(FLAG, "w") as f:
+                f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+            print("TPU UP — stopping rotation", flush=True)
+            return
+        time.sleep(60)  # cooldown between claimants (never hammer the relay)
+
+
+if __name__ == "__main__":
+    main()
