@@ -180,14 +180,19 @@ class AvroDataReader:
                 paths, dtype=dtype, require_labels=require_labels
             )
         except Unsupported:
-            return self.read_per_record(paths, dtype, require_labels)
+            return self.read_per_record(
+                paths, dtype, require_labels, capture_uids=capture_uids
+            )
 
     def read_per_record(
-        self, paths, dtype=jnp.float32, require_labels: bool = True
+        self, paths, dtype=jnp.float32, require_labels: bool = True,
+        capture_uids: bool = True,
     ) -> GameDataBundle:
         """Per-record pure-Python decode — the reference implementation the
         streaming engine is tested against, and the fallback for schema
-        shapes the program compiler can't express."""
+        shapes the program compiler can't express. ``capture_uids=False``
+        keeps the uid column empty (same memory contract as the streaming
+        reader, so the fallback cannot silently drop it)."""
         cols = self.columns
         labels, offsets, weights, uids = [], [], [], []
         tags: dict[str, list] = {t: [] for t in self.id_tag_columns}
@@ -206,7 +211,8 @@ class AvroDataReader:
             offsets.append(rec.get(cols.offset) or 0.0)
             w = rec.get(cols.weight)
             weights.append(1.0 if w is None else w)
-            uids.append(rec.get(cols.uid) or "")
+            if capture_uids:
+                uids.append(rec.get(cols.uid) or "")
             meta = rec.get("metadataMap") or {}
             for t in self.id_tag_columns:
                 v = rec.get(t)
@@ -243,7 +249,8 @@ class AvroDataReader:
             labels=np.asarray(labels, np.float64),
             offsets=np.asarray(offsets, np.float64),
             weights=np.asarray(weights, np.float64),
-            uids=np.asarray(uids, object),
+            uids=(np.asarray(uids, object) if capture_uids
+                  else np.full(len(labels), "", object)),
             id_tags={t: np.asarray(v, object) for t, v in tags.items()},
         )
 

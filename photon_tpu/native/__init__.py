@@ -21,15 +21,16 @@ _SRC = os.path.join(_HERE, "avro_block.cc")
 def _isa_tag() -> str:
     """Short tag of this host's vector ISA, so a -march=native build cached
     in a checkout shared over a network filesystem is never dlopen'd by a
-    host with a different instruction set (SIGILL)."""
-    import hashlib
+    host with a different instruction set (SIGILL). crc32, not md5: FIPS
+    hosts raise on md5, and this is a cache key, not cryptography."""
     import platform
+    import zlib
 
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
                 if line.startswith("flags"):
-                    return hashlib.md5(line.encode()).hexdigest()[:8]
+                    return f"{zlib.crc32(line.encode()) & 0xFFFFFFFF:08x}"
     except OSError:
         pass
     return platform.machine() or "unknown"

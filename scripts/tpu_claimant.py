@@ -3,7 +3,20 @@
 Wedge protocol (.claude/skills/verify/SKILL.md): exactly ONE of these at a
 time; never kill it with SIGKILL; poll the log instead.
 """
-import time, sys
+import fcntl, time, sys
+
+# SELF-ENFORCED single-claimant invariant: every claimant (manual or
+# daemon-spawned) takes this exclusive lock before touching the tunnel, so
+# two can never overlap no matter who starts them (overlap re-wedges the
+# single-client grant). Held for the process lifetime.
+_lock = open("/tmp/tpu_claimant.lock", "w")
+try:
+    fcntl.flock(_lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+except BlockingIOError:
+    print("[claimant] another claimant holds /tmp/tpu_claimant.lock; "
+          "refusing to run two (wedge protocol)", flush=True)
+    sys.exit(3)
+
 t0 = time.time()
 print(f"[claimant] start {time.strftime('%H:%M:%S')}", flush=True)
 import jax

@@ -62,6 +62,15 @@ def main() -> None:
         if ok:
             with open(FLAG, "w") as f:
                 f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+            # A cached failure verdict must not outlive the recovery: the
+            # next bench run should re-probe and see the healthy chip.
+            try:
+                sys.path.insert(0, REPO)
+                import bench
+
+                bench._clear_probe_cache()
+            except Exception:  # noqa: BLE001 - cache clear is best-effort
+                pass
             print("TPU UP — stopping rotation", flush=True)
             return
         time.sleep(60)  # cooldown between claimants (never hammer the relay)
