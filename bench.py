@@ -78,6 +78,37 @@ PROBE_CACHE_PATH = os.path.join(
 )
 PROBE_CACHE_TTL_S = 1800.0
 
+# Machine-wide single-TPU-claimant lock, shared with scripts/tpu_claimant.py:
+# the axon tunnel grants ONE client at a time and overlapping clients can
+# wedge it, so EVERY tunnel client (claimants, this bench's probe + run)
+# must hold the flock. The per-uid fallback keeps self-exclusion working on
+# a shared sticky /tmp where another user owns the shared path.
+TPU_CLAIM_LOCK = "/tmp/tpu_claimant.lock"
+_CLAIM_LOCK_HANDLE = None  # held for the process lifetime once acquired
+
+
+def _try_claim_lock():
+    """Acquire the machine-wide TPU claim lock; False if another client
+    holds it (do NOT touch the tunnel), True once held (kept until exit)."""
+    global _CLAIM_LOCK_HANDLE
+    if _CLAIM_LOCK_HANDLE is not None:
+        return True
+    import fcntl
+
+    for path in (TPU_CLAIM_LOCK, f"{TPU_CLAIM_LOCK}.{os.getuid()}"):
+        try:
+            f = open(path, "a")
+        except OSError:
+            continue  # foreign-owned path on sticky /tmp: per-uid fallback
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            f.close()
+            return False  # a claimant is active
+        _CLAIM_LOCK_HANDLE = f
+        return True
+    return True  # no lockable path: don't block the bench over it
+
 
 def _read_cached_probe_failure(now: float | None = None):
     """(reason, age_seconds) from a fresh cached failure verdict, else None."""
@@ -137,6 +168,14 @@ def _probe_backend(timeout_s: float = 240.0) -> None:
             f"cached probe verdict ({cached[1]:.0f}s old, "
             f"TTL {PROBE_CACHE_TTL_S:.0f}s; --force-probe overrides): "
             f"{cached[0]}"
+        )
+    elif not _try_claim_lock():
+        # Another tunnel client (a recovery claimant) is mid-claim; probing
+        # now would be a second concurrent client — the wedge trigger.
+        # Transient state, so do NOT cache it as a chip verdict.
+        reason = (
+            "TPU claim lock held by another client (recovery claimant?); "
+            "not probing — rerun when the claim resolves"
         )
     else:
         code = (

@@ -156,14 +156,19 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         )
         read_dtype = np.float64 if args.dtype == "float64" else np.float32
         with Timed("read training data", logger):
-            train = reader.read(args.train_data, dtype=read_dtype)
+            # Training never reads the uid column (same memory contract as
+            # the GAME training driver).
+            train = reader.read(
+                args.train_data, dtype=read_dtype, capture_uids=False
+            )
         batch = train.batch(SHARD)
         sanity_check_data(batch, task, DataValidationType[args.data_validation])
         val_batch = None
         if args.validation_data:
             with Timed("read validation data", logger):
                 val_batch = reader.read(
-                    args.validation_data, dtype=read_dtype
+                    args.validation_data, dtype=read_dtype,
+                    capture_uids=False,
                 ).batch(SHARD)
 
         import jax.numpy as jnp

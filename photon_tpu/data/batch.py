@@ -160,7 +160,10 @@ class SparseFeatures:
                 _WARNED_PALLAS_F64 = True
                 import logging
 
-                logging.getLogger("photon_tpu.ops").info(
+                # warning, not info: without a configured handler INFO is
+                # dropped and the downgrade would stay silent for direct
+                # estimator-API users.
+                logging.getLogger("photon_tpu.ops").warning(
                     "Pallas tables attached but operand dtype is %s; the "
                     "kernels are float32-only — using the XLA fast path",
                     jnp.dtype(dtype),

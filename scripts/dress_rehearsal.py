@@ -166,6 +166,10 @@ def main() -> None:
     ap.add_argument("--tpu", action="store_true",
                     help="allow the real accelerator (claims the single-"
                          "client tunnel!); default pins the CPU backend")
+    ap.add_argument("--ingest-only", action="store_true",
+                    help="run the data path at full shape (write, index, "
+                         "stream every row) without the solve — host-side "
+                         "proof while the accelerator is unavailable")
     ap.add_argument("--keep-data", action="store_true")
     args = ap.parse_args()
     if not args.tpu:
@@ -175,6 +179,15 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # Every tunnel client must hold the machine-wide claim lock
+        # (wedge protocol): stand down if a claimant is mid-claim.
+        import bench
+
+        if not bench._try_claim_lock():
+            print("another TPU client holds the claim lock; rerun when the "
+                  "claim resolves (or without --tpu)", flush=True)
+            sys.exit(3)
     if args.smoke:
         args.rows = min(args.rows, 2_000_000)
         args.features = min(args.features, 100_000)
@@ -208,6 +221,49 @@ def main() -> None:
         REPORT["phases"]["write_tiled_avro"]["file_gb"] = round(
             os.path.getsize(data) / 1e9, 2
         )
+
+    if args.ingest_only:
+        with phase("index_build", args.out):
+            from photon_tpu.cli import feature_indexing_driver
+
+            feature_indexing_driver.run([
+                "--data", data,
+                "--output-dir", os.path.join(args.out, "index"),
+                "--feature-shard", "global:features",
+            ])
+        with phase("stream_all_rows", args.out):
+            from photon_tpu.index.index_map import MmapIndexMap
+            from photon_tpu.io.data_reader import (
+                FeatureShardConfig,
+                InputColumnNames,
+            )
+            from photon_tpu.io.streaming import StreamingAvroReader
+
+            imap = MmapIndexMap(os.path.join(args.out, "index", "global"))
+            sr = StreamingAvroReader(
+                {"global": imap}, {"global": FeatureShardConfig()},
+                InputColumnNames(), ("userId",), chunk_rows=1 << 20,
+                capture_uids=False,
+            )
+            t0 = time.perf_counter()
+            rows = nnz = 0
+            for chunk in sr.iter_chunks(data):
+                rows += chunk.n_rows
+                nnz += int(chunk.features["global"].idx.shape[0]
+                           * chunk.features["global"].idx.shape[1])
+            took = time.perf_counter() - t0
+            entry = REPORT["phases"]["stream_all_rows"]
+            entry["rows"] = rows
+            entry["rows_per_sec"] = round(rows / took, 1)
+            entry["nnz_slots"] = nnz
+        if not args.keep_data:
+            try:
+                os.remove(data)
+            except OSError:
+                pass
+        _flush(args.out)
+        print(json.dumps(REPORT, indent=1), flush=True)
+        return
 
     with phase("train", args.out):
         from photon_tpu.cli import game_training_driver

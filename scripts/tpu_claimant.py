@@ -9,13 +9,22 @@ import fcntl, time, sys
 # daemon-spawned) takes this exclusive lock before touching the tunnel, so
 # two can never overlap no matter who starts them (overlap re-wedges the
 # single-client grant). Held for the process lifetime.
-_lock = open("/tmp/tpu_claimant.lock", "w")
-try:
-    fcntl.flock(_lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
-except BlockingIOError:
-    print("[claimant] another claimant holds /tmp/tpu_claimant.lock; "
-          "refusing to run two (wedge protocol)", flush=True)
-    sys.exit(3)
+import os
+
+_lock = None
+for _path in ("/tmp/tpu_claimant.lock",
+              f"/tmp/tpu_claimant.lock.{os.getuid()}"):
+    try:
+        _lock = open(_path, "a")  # append: never truncate a foreign file
+    except OSError:
+        continue  # foreign-owned path on sticky /tmp: per-uid fallback
+    try:
+        fcntl.flock(_lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        break
+    except OSError:
+        print(f"[claimant] another claimant holds {_path}; refusing to run "
+              "two (wedge protocol)", flush=True)
+        sys.exit(3)
 
 t0 = time.time()
 print(f"[claimant] start {time.strftime('%H:%M:%S')}", flush=True)

@@ -23,6 +23,9 @@ def bench(tmp_path, monkeypatch):
     spec.loader.exec_module(mod)
     monkeypatch.setattr(mod, "PROBE_CACHE_PATH", str(tmp_path / "verdict.json"))
     monkeypatch.setattr(mod, "PROBE_CACHE_TTL_S", 100.0)
+    # Tests must not contend with a REAL recovery claimant's machine-wide
+    # lock (one may legitimately be mid-claim while the suite runs).
+    monkeypatch.setattr(mod, "TPU_CLAIM_LOCK", str(tmp_path / "claim.lock"))
     return mod
 
 
@@ -112,3 +115,27 @@ def test_force_probe_bypasses_cache(bench, monkeypatch):
     monkeypatch.setattr(subprocess, "Popen", lambda *a, **k: _FakeProc())
     bench._probe_backend(timeout_s=1.0)
     assert probed.get("ran"), "--force-probe must re-run the real probe"
+
+
+def test_probe_skips_when_claim_lock_held(bench, monkeypatch):
+    """An active claimant (held lock) must make the probe stand down with a
+    TRANSIENT fallback — no subprocess, and no cached failure verdict."""
+    import fcntl
+
+    monkeypatch.setattr(bench, "SMOKE", False)
+    holder = open(bench.TPU_CLAIM_LOCK, "a")
+    fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+
+    def _boom(*a, **k):
+        raise AssertionError("probe subprocess launched despite held lock")
+
+    import subprocess
+
+    monkeypatch.setattr(subprocess, "Popen", _boom)
+    try:
+        bench._probe_backend(timeout_s=240.0)
+    finally:
+        holder.close()
+    assert bench.BACKEND_FALLBACK is not None
+    assert "claim lock held" in bench.BACKEND_FALLBACK
+    assert bench._read_cached_probe_failure() is None  # transient: uncached

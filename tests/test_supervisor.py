@@ -386,3 +386,65 @@ def test_attempt_epoch_barrier(tmp_path):
     t.join()
     assert laggards == []
     assert me.peer_epochs([0, 1]) == {0: 1, 1: 1}
+
+
+def test_driver_epoch_barrier_blocks_lone_retry(tmp_path, monkeypatch):
+    """A retry whose peer never advances its attempt epoch (wedged in the
+    previous attempt's collective, heartbeat still fresh) must fail fast
+    with RestartsUselessError instead of re-entering collectives alone."""
+    from photon_tpu.cli import game_training_driver
+    from photon_tpu.cli.game_training_driver import RestartsUselessError
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu import supervisor as sup
+    from tests.test_drivers import _write_game_avro
+
+    d = tmp_path / "data"
+    d.mkdir()
+    _write_game_avro(d / "train.avro", seed=1, n_users=4, rows_per_user=12)
+
+    import jax
+
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+    class _NoopWatchdog:
+        def start(self):
+            return self
+
+        def stop(self):
+            pass
+
+    monkeypatch.setattr(
+        sup.Heartbeat, "watchdog", lambda self, *a, **k: _NoopWatchdog()
+    )
+    # Peer 1 heartbeats freshly (so the dead-peer check passes) but stays
+    # pinned at epoch 0 — the wedged-in-a-collective signature.
+    hdir = tmp_path / "hb"
+    peer = sup.Heartbeat(str(hdir), process_id=1, interval_seconds=0.2).start()
+    # Shrink the barrier timeout so the test runs in seconds.
+    orig_wait = sup.Heartbeat.wait_for_epoch
+
+    def fast_wait(self, expected, epoch, timeout_seconds=30.0, **kw):
+        return orig_wait(self, expected, epoch, timeout_seconds=1.0,
+                         poll_seconds=0.1)
+
+    monkeypatch.setattr(sup.Heartbeat, "wait_for_epoch", fast_wait)
+
+    def always_fail(self, *a, **kw):
+        raise RuntimeError("transient-looking failure")
+
+    monkeypatch.setattr(GameEstimator, "fit", always_fail)
+    try:
+        with pytest.raises(RestartsUselessError, match="attempt epoch"):
+            game_training_driver.run([
+                "--train-data", str(d / "train.avro"),
+                "--output-dir", str(tmp_path / "out"),
+                "--task", "LOGISTIC_REGRESSION",
+                "--feature-shard", "global:features",
+                "--coordinate",
+                "fixed:type=fixed,shard=global,reg=L2,max_iter=5,reg_weights=1",
+                "--max-restarts", "3", "--restart-backoff", "0",
+                "--heartbeat-dir", str(hdir),
+                "--devices", "1",
+            ])
+    finally:
+        peer.stop()
