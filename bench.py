@@ -110,6 +110,17 @@ def _try_claim_lock():
     return True  # no lockable path: don't block the bench over it
 
 
+def _wait_claim_lock(timeout_s: float, poll_s: float = 5.0) -> bool:
+    """Poll for the claim lock up to ``timeout_s`` (0 = one try)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if _try_claim_lock():
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(poll_s)
+
+
 def _read_cached_probe_failure(now: float | None = None):
     """(reason, age_seconds) from a fresh cached failure verdict, else None."""
     try:
@@ -169,13 +180,19 @@ def _probe_backend(timeout_s: float = 240.0) -> None:
             f"TTL {PROBE_CACHE_TTL_S:.0f}s; --force-probe overrides): "
             f"{cached[0]}"
         )
-    elif not _try_claim_lock():
+    elif not _wait_claim_lock(
+        float(os.environ.get("PHOTON_BENCH_LOCK_WAIT", "240"))
+    ):
         # Another tunnel client (a recovery claimant) is mid-claim; probing
-        # now would be a second concurrent client — the wedge trigger.
-        # Transient state, so do NOT cache it as a chip verdict.
+        # now would be a second concurrent client — the wedge trigger. We
+        # waited a bounded window (the claimant exits quickly on success,
+        # freeing the lock for a healthy probe); still held means it is
+        # likely deep in a ~25 min wedge block. Transient state, so do NOT
+        # cache it as a chip verdict.
         reason = (
-            "TPU claim lock held by another client (recovery claimant?); "
-            "not probing — rerun when the claim resolves"
+            "TPU claim lock held by another client (recovery claimant?) "
+            "through the wait window; not probing — rerun when the claim "
+            "resolves"
         )
     else:
         code = (
@@ -934,6 +951,21 @@ def main():
         details["backend"] = "cpu-fallback"
         details["backend_fallback_reason"] = BACKEND_FALLBACK
         budget = min(budget, 300.0)  # optional CPU stages get a short leash
+        # Evidence that recovery was attempted continuously (VERDICT r3 ask
+        # #1): the rotation daemon logs every claim attempt; ship the tail
+        # in the artifact so a cpu-fallback round still shows its work.
+        rec_log = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "TPU_RECOVERY.jsonl"
+        )
+        try:
+            with open(rec_log) as f:
+                lines = f.readlines()
+            details["tpu_recovery_attempts"] = len(lines)
+            details["tpu_recovery_tail"] = [
+                json.loads(x) for x in lines[-8:]
+            ]
+        except (OSError, ValueError):
+            pass
     stage_seconds = {}
 
     # Smoke runs exercise the code path only, and a CPU fallback is not the

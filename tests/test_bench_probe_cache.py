@@ -123,6 +123,7 @@ def test_probe_skips_when_claim_lock_held(bench, monkeypatch):
     import fcntl
 
     monkeypatch.setattr(bench, "SMOKE", False)
+    monkeypatch.setenv("PHOTON_BENCH_LOCK_WAIT", "0")  # no 240s poll in tests
     holder = open(bench.TPU_CLAIM_LOCK, "a")
     fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
 
