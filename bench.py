@@ -939,8 +939,10 @@ def bench_ingest():
 def main():
     import sys
 
-    t_start = time.perf_counter()
     _probe_backend()
+    # The stage budget starts AFTER the probe: a 240s lock wait / probe
+    # timeout must not eat the window the stages (and their artifact) need.
+    t_start = time.perf_counter()
     # Soft wall-clock budget: once exceeded, remaining OPTIONAL stages are
     # skipped (recorded in ``skipped_stages``) so the headline JSON line
     # always prints well inside the driver's window. The required stages

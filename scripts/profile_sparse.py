@@ -130,6 +130,19 @@ def main() -> None:
         results["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
     save()
 
+    # --- microbenchmarks that size the design space for iteration:
+    # how fast IS a flat gather / scatter on this chip, per element?
+    nel = N * K
+    perm = rng.permutation(nel).astype(np.int32)
+    jperm = jnp.asarray(perm)
+    big = jnp.asarray(rng.normal(size=nel).astype(np.float32))
+    timed("flat_gather_16M_ms", lambda x, p: x[p].sum(), big, jperm)
+    small_tbl = jnp.asarray(rng.normal(size=D).astype(np.float32))
+    timed(
+        "flat_gather_small_table_ms",
+        lambda t, i_: t[i_.ravel()].sum(), small_tbl, ji,
+    )
+
     bytes_per_pass = N * K * 12
     if "hbm_gbps" in results and "fused_pass_fast_ms" in results:
         ideal_ms = bytes_per_pass / (results["hbm_gbps"] * 1e9) * 1e3 * 2
