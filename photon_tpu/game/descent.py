@@ -19,6 +19,7 @@ from typing import Mapping, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_tpu.evaluation import EvaluationResults, EvaluationSuite
 from photon_tpu.game.coordinates import Coordinate, DatumScoringModel
@@ -178,6 +179,13 @@ class CoordinateDescent:
                 total = residual_offset + new_score
                 scores[cid] = new_score
                 models[cid] = model
+                # Tiny D2H fetch: the step record must report COMPLETED
+                # compute, not async dispatch (without this the tracker
+                # claimed ~4s of a 70s fit; block_until_ready alone does not
+                # synchronize on the axon tunnel backend, a D2H does). The
+                # data dependency new_score <- model <- solve forces the
+                # whole step.
+                np.asarray(new_score[:1])
                 dt = time.perf_counter() - t0
 
                 record = CoordinateStepRecord(sweep, cid, dt)
