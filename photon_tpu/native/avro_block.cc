@@ -195,10 +195,13 @@ struct StrDict {
 };
 
 struct ShardOut {
-  // Feature hash table: interleaved (hash, value) slots so each probe costs
-  // one cache line, not two.
-  struct Slot { uint64_t h; int32_t v; int32_t pad; };
-  std::vector<Slot> table;
+  // Feature hash table: split hash/value arrays. At bench-scale tables
+  // (<=2^18 features) either layout is cache-resident; at config-5 scale
+  // (10^6 features, 2M slots) the 16 MB hash-only probe array stays far
+  // closer to cache than 32 MB of interleaved slots, and the 4-byte value
+  // is touched only on a hit.
+  std::vector<uint64_t> table_h;
+  std::vector<int32_t> table_v;
   uint64_t mask = 0;
   // Per-chunk triples, emitted in row-major order.
   std::vector<int32_t> rows;
@@ -404,9 +407,9 @@ int32_t probe(const ShardOut& sh, uint64_t h) {
   if (sh.mask == 0) return -1;
   uint64_t i = h & sh.mask;
   while (true) {
-    const ShardOut::Slot& s = sh.table[i];
-    if (s.h == h) return s.v;
-    if (s.h == 0) return -1;  // empty sentinel (hash 0 excluded at build)
+    uint64_t hv = sh.table_h[i];
+    if (hv == h) return sh.table_v[i];
+    if (hv == 0) return -1;  // empty sentinel (hash 0 excluded at build)
     i = (i + 1) & sh.mask;
   }
 }
@@ -489,7 +492,7 @@ bool decode_record(State& st, Reader& r) {
                 for (int32_t si = 0; si < n_sh; si++) {
                   const ShardOut& sh = st.shards[op[7 + si]];
                   if (sh.mask)
-                    __builtin_prefetch(&sh.table[h & sh.mask], 0, 1);
+                    __builtin_prefetch(&sh.table_h[h & sh.mask], 0, 1);
                 }
                 st.pending.push_back(PendingFeat{h, v});
               }
@@ -638,9 +641,8 @@ void* ph_create(
       sh.collect = true;
       continue;
     }
-    sh.table.resize(table_sizes[s]);
-    for (int64_t i = 0; i < table_sizes[s]; i++)
-      sh.table[i] = ShardOut::Slot{table_hashes[s][i], table_vals[s][i], 0};
+    sh.table_h.assign(table_hashes[s], table_hashes[s] + table_sizes[s]);
+    sh.table_v.assign(table_vals[s], table_vals[s] + table_sizes[s]);
     sh.mask = table_sizes[s] ? (uint64_t)(table_sizes[s] - 1) : 0;
   }
   st->dicts.resize(n_str);
