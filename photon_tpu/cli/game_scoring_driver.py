@@ -65,11 +65,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "of numeric scalars (scores/labels/weights + int32 "
                         "group codes; group-id strings are dictionary-"
                         "encoded per chunk, never accumulated)")
+    from photon_tpu.cli.params import add_compilation_cache_flag
+
+    add_compilation_cache_flag(p)
     return p
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
+    from photon_tpu.cli.params import enable_compilation_cache
+
+    enable_compilation_cache(args.compilation_cache_dir)
     if args.dtype == "float64":
         import jax
 

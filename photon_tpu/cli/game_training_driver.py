@@ -147,6 +147,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "max/nnz) for every shard to <output-dir>/summary/"
                         "<shard>.avro (reference FeatureSummarizationResultAvro "
                         "output, SURVEY.md §3.1 feature-summarization stage)")
+    from photon_tpu.cli.params import add_compilation_cache_flag
+
+    add_compilation_cache_flag(p)
     return p
 
 
@@ -201,6 +204,9 @@ def _load_or_build_indexes(args, shard_specs, logger):
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     """Run training; returns a result summary dict (also written to disk)."""
     args = build_arg_parser().parse_args(argv)
+    from photon_tpu.cli.params import enable_compilation_cache
+
+    enable_compilation_cache(args.compilation_cache_dir)
     # Join the multi-host runtime first (no-op single-process) so
     # jax.devices() below sees the whole pod slice (SURVEY.md §5.8).
     from photon_tpu.parallel.distributed import initialize_distributed

@@ -103,6 +103,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--response-column", default="response")
     p.add_argument("--uid-column", default="uid")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    from photon_tpu.cli.params import add_compilation_cache_flag
+
+    add_compilation_cache_flag(p)
     return p
 
 
@@ -117,6 +120,9 @@ def _default_evaluators(task: TaskType) -> tuple[str, ...]:
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
+    from photon_tpu.cli.params import enable_compilation_cache
+
+    enable_compilation_cache(args.compilation_cache_dir)
     if args.dtype == "float64":
         import jax
 

@@ -227,3 +227,34 @@ def mesh_from_flags(n_devices: int, mesh_spec=None):
     if n <= 1:
         return None
     return make_mesh({DATA_AXIS: n}, devices=jax.devices()[:n])
+
+
+def add_compilation_cache_flag(parser) -> None:
+    """Shared --compilation-cache-dir flag (default: $PHOTON_XLA_CACHE_DIR)."""
+    import os
+
+    parser.add_argument(
+        "--compilation-cache-dir",
+        default=os.environ.get("PHOTON_XLA_CACHE_DIR") or None,
+        help="persistent XLA compilation cache directory: compiled programs "
+             "survive process restarts (supervisor relaunches, repeated "
+             "driver runs), so a 20-40s accelerator compile is paid once "
+             "per program shape, not once per process "
+             "(default: $PHOTON_XLA_CACHE_DIR)")
+
+
+def enable_compilation_cache(path) -> None:
+    """Turn on jax's persistent compilation cache at ``path`` (no-op if
+    falsy). Must run before the first jit compilation."""
+    if not path:
+        return
+    import os
+
+    import jax
+
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs",
+        float(os.environ.get("PHOTON_XLA_CACHE_MIN_SECS", "1.0")),
+    )
