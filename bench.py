@@ -946,11 +946,12 @@ def main():
     if os.environ.get("PHOTON_BENCH_NO_CACHE") != "1":
         from photon_tpu.cli.params import enable_compilation_cache
 
+        # User-owned cache root (NOT the shared tempdir: the cache holds
+        # serialized executables, and a pre-created world-writable dir in
+        # sticky /tmp would let another local user plant artifacts).
         enable_compilation_cache(
             os.environ.get("PHOTON_XLA_CACHE_DIR")
-            or os.path.join(
-                tempfile.gettempdir(), f"photon_xla_cache.{os.getuid()}"
-            )
+            or os.path.expanduser("~/.cache/photon_tpu/xla")
         )
 
     _probe_backend()

@@ -158,6 +158,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 s: im.intercept_index for s, im in index_maps.items()
             },
             mesh=mesh,
+            # Chunked scoring keeps stable shapes for its one-compile
+            # guarantee; the layout tables' shapes are data-dependent.
+            accelerator_paths=args.chunk_rows <= 0,
         )
         scores_path = os.path.join(args.output_dir, "scores.avro")
         evaluation = None

@@ -169,6 +169,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             )
         batch = train.batch(SHARD)
         sanity_check_data(batch, task, DataValidationType[args.data_validation])
+        # No-op off-accelerator; on TPU the solves run the MXU-friendly
+        # sparse layouts instead of the generic gather/scatter.
+        batch = batch.with_accelerator_paths()
         val_batch = None
         if args.validation_data:
             with Timed("read validation data", logger):

@@ -138,6 +138,24 @@ class SparseFeatures:
             return out
         return dataclasses.replace(out, pallas=aux)
 
+    def with_accelerator_paths(self) -> "SparseFeatures":
+        """Attach the MXU-friendly layouts where they can actually win:
+        accelerator backend + unsharded features (row-sharding drops them —
+        the column-sorted tables are not partitionable along rows). The
+        estimator/transformer call this so driver-trained models run the
+        fast formulations on TPU without callers knowing about layouts;
+        off-accelerator this is a no-op (XLA's plain CPU lowerings beat the
+        fast-path formulations there, and the host-side table builds are
+        pure overhead). float64 operands attach only the XLA fast path
+        (the Pallas kernels are f32-only)."""
+        import jax
+
+        if jax.default_backend() not in ("tpu", "axon"):
+            return self
+        if jnp.dtype(self.val.dtype) != jnp.float32:
+            return self.with_fast_path()
+        return self.with_pallas_path()
+
     def without_fast_path(self) -> "SparseFeatures":
         """Drop the fast/pallas layouts (e.g. before row-sharding: the
         column-sorted tables are not partitionable along the row axis)."""
@@ -265,6 +283,24 @@ class LabeledBatch:
 
     def add_to_offsets(self, scores: Array) -> "LabeledBatch":
         return dataclasses.replace(self, offsets=self.offsets + scores)
+
+    def with_accelerator_paths(self, cache: Optional[dict] = None) -> "LabeledBatch":
+        """Sparse features gain the MXU layouts (see
+        ``SparseFeatures.with_accelerator_paths``); dense features no-op.
+        ``cache`` (id(features) -> attached features) lets config sweeps
+        reuse one host-side table build per distinct feature object."""
+        feats = self.features
+        if not hasattr(feats, "with_accelerator_paths"):
+            return self
+        if cache is not None and id(feats) in cache:
+            attached = cache[id(feats)]
+        else:
+            attached = feats.with_accelerator_paths()
+            if cache is not None:
+                cache[id(feats)] = attached
+        if attached is feats:
+            return self
+        return dataclasses.replace(self, features=attached)
 
 
 def make_dense_batch(

@@ -249,12 +249,16 @@ class GameEstimator:
 
         # Each config owns steps_per_config descent steps + 1 config-done slot.
         steps_per_config = self.n_sweeps * len(self.update_sequence)
+        # One host-side MXU-layout build per distinct feature object across
+        # the whole config sweep (id(features) -> attached features).
+        accel_cache: dict = {}
         for i, cfg in enumerate(configs):
             if i < start_config:
                 continue
             logger.info("=== configuration %d/%d ===", i + 1, len(configs))
             coordinates = self._build_coordinates(
-                prep, cfg, config_index=i, initial_model=initial_model
+                prep, cfg, config_index=i, initial_model=initial_model,
+                accel_cache=accel_cache,
             )
             descent = CoordinateDescent(
                 update_sequence=tuple(self.update_sequence),
@@ -355,6 +359,7 @@ class GameEstimator:
         cfg: GameOptimizationConfiguration,
         config_index: int,
         initial_model: Optional[GameModel] = None,
+        accel_cache: Optional[dict] = None,
     ) -> dict[str, Coordinate]:
         # Coordinates are built for EVERY data config, not just the update
         # sequence: coordinates outside the sequence are scoring-only (locked
@@ -423,6 +428,13 @@ class GameEstimator:
                     )
                 ):
                     model_axis = "model"
+                if self.mesh is None:
+                    # Single-device solve: attach the MXU-friendly sparse
+                    # layouts (no-op off-accelerator; one host-side build
+                    # per distinct feature object across the sweep). Mesh
+                    # runs shard rows, which the global tables cannot
+                    # follow — those keep the shardable plain formulation.
+                    batch = batch.with_accelerator_paths(accel_cache)
                 coordinates[cid] = FixedEffectCoordinate(
                     batch=batch,
                     problem=problem,

@@ -55,6 +55,11 @@ class GameTransformer:
     intercept_indices: Optional[Mapping[str, int]] = None
     mesh: Optional[object] = None
     data_axis: str = "data"
+    # Attach the MXU-friendly sparse layouts before the fixed-effect scoring
+    # matvec (no-op off-accelerator). The CHUNKED serve path disables this:
+    # its tables' static shapes are data-dependent per chunk, which would
+    # trade the one-compile stable-shape guarantee for a recompile per chunk.
+    accelerator_paths: bool = True
 
     def _intercept_for(self, shard: str) -> Optional[int]:
         if self.intercept_indices is None:
@@ -63,6 +68,10 @@ class GameTransformer:
 
     def _score_fixed(self, m: FixedEffectModel, batch) -> Array:
         if self.mesh is None:
+            if self.accelerator_paths:
+                # No-op off-accelerator; on TPU the scoring matvec runs the
+                # MXU-friendly layout instead of the generic gather.
+                batch = batch.with_accelerator_paths()
             return m.score_batch(batch)
         from photon_tpu.parallel.mesh import pad_and_shard_batch
 

@@ -318,3 +318,62 @@ def test_megadim_chunking_at_real_constants():
         rmatvec_pallas(aux, jnp.asarray(dz), interpret=True), g_ref,
         rtol=0, atol=5e-4,
     )
+
+
+def test_estimator_attaches_accelerator_paths(monkeypatch):
+    """Round-4 integration: on an accelerator backend the estimator attaches
+    the MXU layouts to fixed-effect batches automatically (drivers need no
+    layout knowledge), and the fit matches the plain-path fit. Backend
+    mocked to 'tpu' with the interpreter so the kernels execute on CPU."""
+    import jax
+
+    from photon_tpu.estimators.config import (
+        FixedEffectDataConfig,
+        GLMOptimizationConfiguration,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.io.data_reader import GameDataBundle
+    from photon_tpu.optim import RegularizationContext, RegularizationType
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(9)
+    n, d, k = 400, 200, 6
+    idx, val = _random_ell(rng, n, d, k)
+    labels = (rng.random(n) < 0.5).astype(np.float64)
+    bundle = GameDataBundle(
+        features={"global": SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d)},
+        labels=labels,
+        offsets=np.zeros(n),
+        weights=np.ones(n),
+        uids=np.arange(n).astype(object),
+        id_tags={},
+    )
+    est = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={"fixed": FixedEffectDataConfig("global")},
+        n_sweeps=1,
+    )
+    cfg = [{"fixed": GLMOptimizationConfiguration(
+        regularization=RegularizationContext(RegularizationType.L2),
+        reg_weight=1.0, max_iterations=10)}]
+
+    ref = est.fit(bundle, None, cfg)
+    w_plain = np.asarray(ref[0].model["fixed"].model.coefficients.means)
+
+    monkeypatch.setenv("PHOTON_PALLAS_INTERPRET", "1")
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    attached = {}
+    orig = SparseFeatures.with_accelerator_paths
+
+    def spy(self):
+        out = orig(self)
+        attached["pallas"] = out.pallas is not None
+        attached["fast"] = out.fast is not None
+        return out
+
+    monkeypatch.setattr(SparseFeatures, "with_accelerator_paths", spy)
+    got = est.fit(bundle, None, cfg)
+    w_acc = np.asarray(got[0].model["fixed"].model.coefficients.means)
+
+    assert attached == {"pallas": True, "fast": True}
+    np.testing.assert_allclose(w_acc, w_plain, rtol=0, atol=2e-3)
