@@ -148,9 +148,20 @@ class SparseFeatures:
         fast-path formulations there, and the host-side table builds are
         pure overhead). float64 operands attach only the XLA fast path
         (the Pallas kernels are f32-only)."""
+        import os
+
         import jax
 
         if jax.default_backend() not in ("tpu", "axon"):
+            return self
+        # HBM guard: the layouts cost ~20 bytes/entry on device on top of
+        # the 8 bytes/entry ELL data. At config-5 scale (1.3e9 entries)
+        # they would crowd out the batch itself; past the budget the solve
+        # keeps the plain formulation (and P3/row sharding remain the
+        # intended scale paths). Tunable: PHOTON_ACCEL_AUX_BUDGET_GB.
+        entries = int(self.idx.shape[0]) * int(self.idx.shape[1])
+        budget_gb = float(os.environ.get("PHOTON_ACCEL_AUX_BUDGET_GB", "4"))
+        if 20 * entries > budget_gb * 1e9:
             return self
         if jnp.dtype(self.val.dtype) != jnp.float32:
             return self.with_fast_path()
