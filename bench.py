@@ -153,6 +153,40 @@ def _clear_probe_cache() -> None:
         pass
 
 
+RECOVERY_LOG = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "TPU_RECOVERY.jsonl"
+)
+RECOVERY_LOG_MAX_AGE_S = 2100.0  # ~one claim-rotation period + slack
+
+
+def _recovery_log_failure(now: float | None = None):
+    """(reason, age_seconds) when the newest logged claim attempt is a
+    RECENT failure — continuous claimant evidence substitutes for burning a
+    probe timeout. A successful newest attempt (or a stale/absent log)
+    returns None so the probe runs for real."""
+    import calendar
+
+    try:
+        with open(RECOVERY_LOG) as f:
+            lines = f.readlines()
+        last = json.loads(lines[-1])
+        if last.get("ok"):
+            return None
+        t = calendar.timegm(
+            time.strptime(last["time"], "%Y-%m-%dT%H:%M:%SZ")
+        )
+        age = (time.time() if now is None else now) - t
+        if 0 <= age < RECOVERY_LOG_MAX_AGE_S:
+            return (
+                f"recovery log: newest claim attempt #{last.get('attempt')} "
+                f"failed {age:.0f}s ago after {last.get('seconds')}s "
+                f"({str(last.get('tail', ''))[-120:]})"
+            ), age
+    except (OSError, ValueError, KeyError, IndexError):
+        pass
+    return None
+
+
 def _probe_backend(timeout_s: float = 240.0) -> None:
     """Fail fast if the accelerator backend is unusable, instead of hanging.
 
@@ -174,12 +208,18 @@ def _probe_backend(timeout_s: float = 240.0) -> None:
         or os.environ.get("PHOTON_BENCH_FORCE_PROBE") == "1"
     )
     cached = None if force else _read_cached_probe_failure()
+    recovery = None if force or cached else _recovery_log_failure()
     if cached is not None:
         reason = (
             f"cached probe verdict ({cached[1]:.0f}s old, "
             f"TTL {PROBE_CACHE_TTL_S:.0f}s; --force-probe overrides): "
             f"{cached[0]}"
         )
+    elif recovery is not None:
+        # The rotation daemon's claimants ARE continuous probes; a fresh
+        # failure there means a probe now would only burn its timeout (and
+        # race the next claimant). Transient evidence — not cached.
+        reason = recovery[0]
     elif not _wait_claim_lock(
         float(os.environ.get("PHOTON_BENCH_LOCK_WAIT", "240"))
     ):

@@ -24,8 +24,10 @@ def bench(tmp_path, monkeypatch):
     monkeypatch.setattr(mod, "PROBE_CACHE_PATH", str(tmp_path / "verdict.json"))
     monkeypatch.setattr(mod, "PROBE_CACHE_TTL_S", 100.0)
     # Tests must not contend with a REAL recovery claimant's machine-wide
-    # lock (one may legitimately be mid-claim while the suite runs).
+    # lock (one may legitimately be mid-claim while the suite runs), nor
+    # read the repo's real recovery log.
     monkeypatch.setattr(mod, "TPU_CLAIM_LOCK", str(tmp_path / "claim.lock"))
+    monkeypatch.setattr(mod, "RECOVERY_LOG", str(tmp_path / "recovery.jsonl"))
     return mod
 
 
@@ -140,3 +142,44 @@ def test_probe_skips_when_claim_lock_held(bench, monkeypatch):
     assert bench.BACKEND_FALLBACK is not None
     assert "claim lock held" in bench.BACKEND_FALLBACK
     assert bench._read_cached_probe_failure() is None  # transient: uncached
+
+
+def test_recovery_log_substitutes_for_probe(bench, monkeypatch, tmp_path):
+    """A fresh claim failure in TPU_RECOVERY.jsonl must make the probe stand
+    down immediately (transient, uncached); a stale or successful newest
+    entry must NOT."""
+    import subprocess
+
+    log = tmp_path / "TPU_RECOVERY.jsonl"
+    monkeypatch.setattr(bench, "RECOVERY_LOG", str(log))
+    monkeypatch.setattr(bench, "SMOKE", False)
+    monkeypatch.setenv("PHOTON_BENCH_LOCK_WAIT", "0")
+
+    def write(ok, age_s):
+        ts = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime(time.time() - age_s)
+        )
+        with open(log, "a") as f:
+            f.write(json.dumps({
+                "attempt": 3, "seconds": 1504.0, "ok": ok,
+                "tail": "UNAVAILABLE: TPU backend setup/compile error",
+                "time": ts,
+            }) + "\n")
+
+    # Stale failure: no substitute.
+    write(ok=False, age_s=bench.RECOVERY_LOG_MAX_AGE_S + 60)
+    assert bench._recovery_log_failure() is None
+    # Fresh failure: substitutes, probe never launches, nothing cached.
+    write(ok=False, age_s=30)
+    got = bench._recovery_log_failure()
+    assert got is not None and "claim attempt" in got[0]
+    monkeypatch.setattr(
+        subprocess, "Popen",
+        lambda *a, **k: (_ for _ in ()).throw(AssertionError("probed")),
+    )
+    bench._probe_backend(timeout_s=240.0)
+    assert "recovery log" in bench.BACKEND_FALLBACK
+    assert bench._read_cached_probe_failure() is None
+    # Newest entry is a SUCCESS: the probe must run for real.
+    write(ok=True, age_s=5)
+    assert bench._recovery_log_failure() is None
