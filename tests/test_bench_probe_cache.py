@@ -183,3 +183,17 @@ def test_recovery_log_substitutes_for_probe(bench, monkeypatch, tmp_path):
     # Newest entry is a SUCCESS: the probe must run for real.
     write(ok=True, age_s=5)
     assert bench._recovery_log_failure() is None
+
+
+def test_wait_claim_lock_bounded(bench):
+    """_wait_claim_lock polls only until the deadline when the lock is held,
+    and returns immediately once it frees."""
+    import fcntl
+
+    holder = open(bench.TPU_CLAIM_LOCK, "a")
+    fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    t0 = time.perf_counter()
+    assert bench._wait_claim_lock(0.3, poll_s=0.1) is False
+    assert 0.25 <= time.perf_counter() - t0 < 3.0
+    holder.close()  # releases the flock
+    assert bench._wait_claim_lock(0.3, poll_s=0.1) is True

@@ -448,3 +448,17 @@ def test_driver_epoch_barrier_blocks_lone_retry(tmp_path, monkeypatch):
             ])
     finally:
         peer.stop()
+
+
+def test_peer_epochs_tolerates_corrupt_and_missing(tmp_path):
+    """A torn/corrupt peer file or a missing one reads as epoch -1 (laggard)
+    rather than raising mid-barrier."""
+    hdir = str(tmp_path / "hb")
+    me = Heartbeat(hdir, process_id=0, interval_seconds=0.05)
+    me.set_epoch(2)
+    with open(os.path.join(hdir, "host-1.hb"), "w") as f:
+        f.write("{torn json")
+    epochs = me.peer_epochs([0, 1, 2])
+    assert epochs == {0: 2, 1: -1, 2: -1}
+    assert me.wait_for_epoch([0, 1], 1, timeout_seconds=0.2,
+                             poll_seconds=0.05) == [1]
