@@ -1,0 +1,94 @@
+"""TPU autopilot: run the on-chip measurement sequence the moment the chip
+answers (VERDICT r3 asks #1/#2: the round's deliverable is hardware numbers,
+and a recovery window must never be wasted waiting for an operator).
+
+Watches for ``/tmp/tpu_up.flag`` (written by ``tpu_recovery_daemon.py`` after
+a successful claim), waits for the proving claimant to exit, then runs
+sequentially — each phase is itself a single tunnel client, so sequential
+execution preserves the one-claimant wedge protocol:
+
+  1. ``scripts/profile_sparse.py``  — the Pallas-vs-XLA race + roofline
+     (-> /tmp/profile_sparse.<uid>.json)
+  2. ``python bench.py``            — full hardware bench (-> BENCH_DETAILS.json)
+
+Phase outcomes append to ``AUTOPILOT.jsonl`` in the repo root. Timeouts are
+generous and enforced with SIGTERM + grace (never SIGKILL: a killed mid-init
+client can re-wedge the remote grant).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FLAG = "/tmp/tpu_up.flag"
+LOG = os.path.join(REPO, "AUTOPILOT.jsonl")
+
+
+def log(entry: dict) -> None:
+    entry["time"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    with open(LOG, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def claimant_running() -> bool:
+    out = subprocess.run(
+        ["pgrep", "-f", "tpu_claimant.py"], capture_output=True, text=True
+    ).stdout.split()
+    return any(p.isdigit() for p in out)
+
+
+def run_phase(name: str, argv: list[str], timeout_s: float,
+              extra_env: dict | None = None) -> bool:
+    logpath = f"/tmp/autopilot_{name}.log"
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
+    t0 = time.time()
+    log({"phase": name, "event": "start", "log": logpath})
+    with open(logpath, "w") as lf:
+        p = subprocess.Popen(
+            argv, stdout=lf, stderr=subprocess.STDOUT, cwd=REPO, env=env
+        )
+        try:
+            rc = p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            p.send_signal(signal.SIGTERM)  # grace, never SIGKILL (wedge)
+            try:
+                rc = p.wait(timeout=120)
+            except subprocess.TimeoutExpired:
+                rc = -1  # left running headless; do not escalate to SIGKILL
+            log({"phase": name, "event": "timeout",
+                 "seconds": round(time.time() - t0, 1)})
+            return False
+    log({"phase": name, "event": "done", "rc": rc,
+         "seconds": round(time.time() - t0, 1)})
+    return rc == 0
+
+
+def main() -> None:
+    log({"phase": "autopilot", "event": "watching"})
+    while not os.path.exists(FLAG):
+        time.sleep(15)
+    # Let the proving claimant exit and release the tunnel before claiming.
+    while claimant_running():
+        time.sleep(10)
+    log({"phase": "autopilot", "event": "chip-up, starting sequence"})
+
+    run_phase("profile_sparse",
+              [sys.executable, os.path.join(REPO, "scripts",
+                                            "profile_sparse.py")],
+              timeout_s=3600)
+    run_phase("bench",
+              [sys.executable, os.path.join(REPO, "bench.py")],
+              timeout_s=7200,
+              extra_env={"PHOTON_BENCH_FORCE_PROBE": "1"})
+    log({"phase": "autopilot", "event": "sequence complete"})
+
+
+if __name__ == "__main__":
+    main()
