@@ -1,21 +1,37 @@
-"""On-chip sparse-op microprofile (VERDICT r3 asks #2/#3).
+"""On-chip sparse-op microprofile (VERDICT r3 asks #2/#3), wedge-resilient.
 
 Times each candidate implementation of the GLM hot ops at bench shape on the
-real accelerator and dumps one JSON file. Run as the SINGLE TPU claimant:
+real accelerator and accumulates one JSON file. Run as the SINGLE TPU
+claimant:
 
     nohup python scripts/profile_sparse.py > /tmp/profile_sparse.log 2>&1 &
 
-Stages (each timed warm, best-of-3, synced by D2H scalar fetch — the axon
-tunnel does not synchronize on block_until_ready):
+2026-07-31 wedge lesson: the tunnel can die mid-window (the 03:47Z recovery
+ran HBM + 2 naive variants, then hung forever inside the matvec_fast remote
+compile). So each variant now runs in its OWN subprocess under a deadline,
+and results accumulate in OUT across invocations:
+
+  * a variant that completes writes its key into OUT (resume skips it);
+  * a variant that hangs gets SIGTERM + grace at the deadline and the runner
+    ABORTS (a hung grant poisons every later client — leave the remaining
+    keys for the next recovery window instead of burning a deadline each);
+  * a variant that fails fast records ``<key>_error`` and the runner
+    continues.
+
+Variant stages (each timed warm, best-of-3, synced by D2H fetch):
   - hbm_gbps: differenced fori_loop bandwidth (the roofline denominator)
   - matvec_gather / matvec_fast / matvec_pallas
   - rmatvec_segsum / rmatvec_fast / rmatvec_pallas
-  - fused_pass_fast / fused_pass_pallas (value+grad, the real per-iteration op)
+  - fused_pass_fast / fused_pass_pallas (value+grad, the real per-iter op)
+  - flat_gather_16M / flat_gather_small_table (design-space microbenches)
 """
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
@@ -23,87 +39,92 @@ import numpy as np
 
 OUT = f"/tmp/profile_sparse.{os.getuid()}.json"
 N, D, K = 1 << 19, 1 << 18, 32  # bench headline shape: 201 MB of idx+val+out
+VARIANT_DEADLINE_S = 600.0
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def main() -> None:
-    t0 = time.time()
-    import jax
-    import jax.numpy as jnp
+def _load() -> dict:
+    try:
+        with open(OUT) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"n": N, "dim": D, "k": K}
 
-    print(f"devices: {jax.devices()} ({time.time()-t0:.1f}s)", flush=True)
-    sys.path.insert(
-        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
 
-    from photon_tpu.data.batch import SparseFeatures
+def _save(results: dict) -> None:
+    with open(OUT, "w") as f:
+        json.dump(results, f, indent=1)
 
+
+# ----------------------------------------------------------------- variants
+
+def _data():
     rng = np.random.default_rng(0)
     idx = rng.integers(0, D, size=(N, K)).astype(np.int32)
     val = (rng.normal(size=(N, K)) / np.sqrt(K)).astype(np.float32)
     w = rng.normal(size=D).astype(np.float32)
     dz = rng.normal(size=N).astype(np.float32)
+    return rng, idx, val, w, dz
 
-    results: dict = {"n": N, "dim": D, "k": K}
 
-    def save() -> None:
-        with open(OUT, "w") as f:
-            json.dump(results, f, indent=1)
+def run_variant(key: str) -> None:
+    """Measure ONE variant in this process and merge its key into OUT."""
+    import jax
+    import jax.numpy as jnp
 
-    def timed(name, fn, *args):
-        try:
-            jfn = jax.jit(fn)
-            np.asarray(jfn(*args))  # compile + warm
-            best = float("inf")
-            for _ in range(3):
-                t = time.perf_counter()
-                np.asarray(jfn(*args))
-                best = min(best, time.perf_counter() - t)
-            results[name] = round(best * 1e3, 3)  # ms
-            print(f"{name}: {best*1e3:.2f} ms", flush=True)
-        except Exception as e:  # noqa: BLE001 - record and continue
-            results[name + "_error"] = f"{type(e).__name__}: {e}"[:300]
-            print(f"{name} FAILED: {e}", flush=True)
-        save()
+    results = _load()
 
-    # Roofline denominator
-    from bench import measured_hbm_bandwidth  # repo-root bench.py
+    def timed(fn, *args) -> float:
+        jfn = jax.jit(fn)
+        np.asarray(jfn(*args))  # compile + warm
+        best = float("inf")
+        for _ in range(3):
+            t = time.perf_counter()
+            np.asarray(jfn(*args))
+            best = min(best, time.perf_counter() - t)
+        return round(best * 1e3, 3)  # ms
 
-    try:
-        results["hbm_gbps"] = round(measured_hbm_bandwidth(), 1)
-        print(f"hbm_gbps: {results['hbm_gbps']}", flush=True)
-    except Exception as e:  # noqa: BLE001
-        results["hbm_gbps_error"] = str(e)[:300]
-    save()
+    if key == "hbm_gbps":
+        sys.path.insert(0, REPO)
+        from bench import measured_hbm_bandwidth
 
+        results[key] = round(measured_hbm_bandwidth(), 1)
+        _save(results)
+        print(f"{key}: {results[key]}", flush=True)
+        return
+
+    rng, idx, val, w, dz = _data()
     ji, jv, jw, jdz = map(jnp.asarray, (idx, val, w, dz))
+    sys.path.insert(0, REPO)
 
-    # --- naive XLA formulations (the 100x-off lowerings, for the record)
-    timed("matvec_gather_ms", lambda w_, i_, v_: (v_ * w_[i_]).sum(1), jw, ji, jv)
-    timed(
-        "rmatvec_segsum_ms",
-        lambda dz_, i_, v_: jax.ops.segment_sum(
-            (dz_[:, None] * v_).ravel(), i_.ravel(), num_segments=D
-        ),
-        jdz, ji, jv,
-    )
+    if key == "matvec_gather_ms":
+        ms = timed(lambda w_, i_, v_: (v_ * w_[i_]).sum(1), jw, ji, jv)
+    elif key == "rmatvec_segsum_ms":
+        ms = timed(
+            lambda dz_, i_, v_: jax.ops.segment_sum(
+                (dz_[:, None] * v_).ravel(), i_.ravel(), num_segments=D
+            ),
+            jdz, ji, jv,
+        )
+    elif key in ("matvec_fast_ms", "rmatvec_fast_ms", "fused_pass_fast_ms"):
+        from photon_tpu.data.batch import SparseFeatures
+        from photon_tpu.ops.fast_sparse import matvec_fast, rmatvec_fast
 
-    # --- current XLA fast paths
-    base = SparseFeatures(idx=ji, val=jv, dim=D).with_fast_path()
-    aux = base.fast
-    from photon_tpu.ops.fast_sparse import matvec_fast, rmatvec_fast
+        aux = SparseFeatures(idx=ji, val=jv, dim=D).with_fast_path().fast
+        if key == "matvec_fast_ms":
+            ms = timed(lambda w_: matvec_fast(aux, jv, w_, D), jw)
+        elif key == "rmatvec_fast_ms":
+            ms = timed(lambda dz_: rmatvec_fast(aux, dz_, D), jdz)
+        else:
+            def fused_fast(w_, dz_):
+                z = matvec_fast(aux, jv, w_, D)
+                g = rmatvec_fast(aux, dz_, D)
+                return z.sum() + g.sum()
 
-    timed("matvec_fast_ms", lambda w_: matvec_fast(aux, jv, w_, D), jw)
-    timed("rmatvec_fast_ms", lambda dz_: rmatvec_fast(aux, dz_, D), jdz)
-
-    def fused_fast(w_, dz_):
-        z = matvec_fast(aux, jv, w_, D)
-        g = rmatvec_fast(aux, dz_, D)
-        return z.sum() + g.sum()
-
-    timed("fused_pass_fast_ms", fused_fast, jw, jdz)
-
-    # --- Pallas kernels (the unproven-on-hw contenders)
-    try:
+            ms = timed(fused_fast, jw, jdz)
+    elif key in ("matvec_pallas_ms", "rmatvec_pallas_ms",
+                 "fused_pass_pallas_ms"):
         from photon_tpu.ops.pallas_sparse import (
             build_pallas_aux,
             matvec_pallas,
@@ -112,48 +133,131 @@ def main() -> None:
 
         paux = build_pallas_aux(idx, val, D)
         if paux is None:
+            # Mark ALL pallas variants resolved so later runner passes skip
+            # the (expensive) jax init + aux rebuild for each of the three.
             results["pallas_note"] = "build_pallas_aux returned None (budget)"
+            for k in ("matvec_pallas_ms", "rmatvec_pallas_ms",
+                      "fused_pass_pallas_ms"):
+                results[f"{k}_error"] = "build_pallas_aux returned None"
+            _save(results)
+            return
+        if key == "matvec_pallas_ms":
+            ms = timed(lambda w_: matvec_pallas(paux, w_), jw)
+        elif key == "rmatvec_pallas_ms":
+            ms = timed(lambda dz_: rmatvec_pallas(paux, dz_), jdz)
         else:
-            timed("matvec_pallas_ms", lambda w_: matvec_pallas(paux, w_), jw)
-            timed(
-                "rmatvec_pallas_ms", lambda dz_: rmatvec_pallas(paux, dz_), jdz
-            )
-
             def fused_pallas(w_, dz_):
                 return (
                     matvec_pallas(paux, w_).sum()
                     + rmatvec_pallas(paux, dz_).sum()
                 )
 
-            timed("fused_pass_pallas_ms", fused_pallas, jw, jdz)
-    except Exception as e:  # noqa: BLE001
-        results["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
-    save()
+            ms = timed(fused_pallas, jw, jdz)
+    elif key == "flat_gather_16M_ms":
+        perm = rng.permutation(N * K).astype(np.int32)
+        big = jnp.asarray(rng.normal(size=N * K).astype(np.float32))
+        ms = timed(lambda x, p: x[p].sum(), big, jnp.asarray(perm))
+    elif key == "flat_gather_small_table_ms":
+        tbl = jnp.asarray(rng.normal(size=D).astype(np.float32))
+        ms = timed(lambda t, i_: t[i_.ravel()].sum(), tbl, ji)
+    else:
+        raise SystemExit(f"unknown variant {key}")
 
-    # --- microbenchmarks that size the design space for iteration:
-    # how fast IS a flat gather / scatter on this chip, per element?
-    nel = N * K
-    perm = rng.permutation(nel).astype(np.int32)
-    jperm = jnp.asarray(perm)
-    big = jnp.asarray(rng.normal(size=nel).astype(np.float32))
-    timed("flat_gather_16M_ms", lambda x, p: x[p].sum(), big, jperm)
-    small_tbl = jnp.asarray(rng.normal(size=D).astype(np.float32))
-    timed(
-        "flat_gather_small_table_ms",
-        lambda t, i_: t[i_.ravel()].sum(), small_tbl, ji,
-    )
+    results[key] = ms
+    _save(results)
+    print(f"{key}: {ms:.2f} ms", flush=True)
 
+
+VARIANTS = [
+    "hbm_gbps",
+    "matvec_gather_ms",
+    "rmatvec_segsum_ms",
+    "matvec_fast_ms",
+    "rmatvec_fast_ms",
+    "fused_pass_fast_ms",
+    "matvec_pallas_ms",
+    "rmatvec_pallas_ms",
+    "fused_pass_pallas_ms",
+    "flat_gather_16M_ms",
+    "flat_gather_small_table_ms",
+]
+
+
+def _finalize(results: dict) -> None:
+    """Roofline fractions for whatever fused numbers exist."""
     bytes_per_pass = N * K * 12
-    if "hbm_gbps" in results and "fused_pass_fast_ms" in results:
-        ideal_ms = bytes_per_pass / (results["hbm_gbps"] * 1e9) * 1e3 * 2
-        # x2: a fused pass touches idx+val twice (matvec + rmatvec)
-        for key in ("fused_pass_fast_ms", "fused_pass_pallas_ms"):
-            if key in results:
-                results[key.replace("_ms", "_fraction_of_roofline")] = round(
-                    ideal_ms / results[key], 4
-                )
-    save()
+    if "hbm_gbps" not in results:
+        return
+    ideal_ms = bytes_per_pass / (results["hbm_gbps"] * 1e9) * 1e3 * 2
+    # x2: a fused pass touches idx+val twice (matvec + rmatvec)
+    for key in ("fused_pass_fast_ms", "fused_pass_pallas_ms"):
+        if key in results:
+            results[key.replace("_ms", "_fraction_of_roofline")] = round(
+                ideal_ms / results[key], 4
+            )
+    _save(results)
+
+
+def runner() -> int:
+    results = _load()
+    for key in VARIANTS:
+        if key in results or f"{key}_error" in results:
+            print(f"[runner] {key}: cached ({results.get(key, 'error')})",
+                  flush=True)
+            continue
+        t0 = time.time()
+        p = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--variant", key],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            out, _ = p.communicate(timeout=VARIANT_DEADLINE_S)
+        except subprocess.TimeoutExpired:
+            p.send_signal(signal.SIGTERM)  # grace, never SIGKILL (wedge)
+            try:
+                p.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                pass
+            print(f"[runner] {key}: HUNG > {VARIANT_DEADLINE_S:.0f}s — "
+                  "aborting (grant likely wedged; resume next window)",
+                  flush=True)
+            _finalize(_load())
+            return 1
+        took = time.time() - t0
+        tail = out.strip().splitlines()[-1][-200:] if out.strip() else ""
+        if p.returncode != 0:
+            # A tunnel/backend outage is RETRYABLE: leave the key absent so
+            # the next recovery window re-measures it, and abort this pass
+            # (every later client would fail the same way). Only genuine
+            # code failures are recorded permanently.
+            if any(s in out for s in
+                   ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                    "Unable to initialize backend")):
+                print(f"[runner] {key}: backend outage ({took:.0f}s): {tail}"
+                      " — aborting, will retry next window", flush=True)
+                _finalize(_load())
+                return 1
+            results = _load()
+            results[f"{key}_error"] = tail[:300]
+            _save(results)
+            print(f"[runner] {key}: FAILED rc={p.returncode} ({took:.0f}s): "
+                  f"{tail}", flush=True)
+        else:
+            print(f"[runner] {key}: ok ({took:.0f}s): {tail}", flush=True)
+    _finalize(_load())
     print("DONE", flush=True)
+    return 0
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variant", default=None,
+                    help="measure exactly one variant in-process (internal)")
+    args = ap.parse_args()
+    if args.variant:
+        run_variant(args.variant)
+    else:
+        raise SystemExit(runner())
 
 
 if __name__ == "__main__":

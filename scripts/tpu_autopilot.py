@@ -1,19 +1,20 @@
 """TPU autopilot: run the on-chip measurement sequence the moment the chip
-answers (VERDICT r3 asks #1/#2: the round's deliverable is hardware numbers,
-and a recovery window must never be wasted waiting for an operator).
+answers, and keep re-arming across flaky windows (VERDICT r3 asks #1/#2).
 
-Watches for ``/tmp/tpu_up.flag`` (written by ``tpu_recovery_daemon.py`` after
-a successful claim), waits for the proving claimant to exit, then runs
-sequentially — each phase is itself a single tunnel client, so sequential
-execution preserves the one-claimant wedge protocol:
+2026-07-31 lesson: the 03:47Z recovery window lasted ~4 minutes before the
+tunnel wedged again mid-compile. So the autopilot is a LOOP, not a one-shot:
 
-  1. ``scripts/profile_sparse.py``  — the Pallas-vs-XLA race + roofline
-     (-> /tmp/profile_sparse.<uid>.json)
-  2. ``python bench.py``            — full hardware bench (-> BENCH_DETAILS.json)
+  1. wait for ``/tmp/tpu_up.flag`` (written by ``tpu_recovery_daemon.py``)
+  2. consume the flag, run the sequence — **bench first** (the round's #1
+     deliverable), then the resumable per-variant ``profile_sparse.py``
+  3. if both completed, exit; otherwise restart the rotation daemon and go
+     back to waiting for the next window.
 
-Phase outcomes append to ``AUTOPILOT.jsonl`` in the repo root. Timeouts are
-generous and enforced with SIGTERM + grace (never SIGKILL: a killed mid-init
-client can re-wedge the remote grant).
+Phases run sequentially (each is a single tunnel client, preserving the
+one-claimant wedge protocol), under a hard timeout AND a stall detector
+(no log output for 15 min → SIGTERM + grace; never SIGKILL — a killed
+mid-init client can re-wedge the remote grant). Outcomes append to
+``AUTOPILOT.jsonl`` in the repo root.
 """
 from __future__ import annotations
 
@@ -27,6 +28,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FLAG = "/tmp/tpu_up.flag"
 LOG = os.path.join(REPO, "AUTOPILOT.jsonl")
+BENCH_DETAILS = os.path.join(REPO, "BENCH_DETAILS.json")
+STALL_S = 900.0
 
 
 def log(entry: dict) -> None:
@@ -42,6 +45,42 @@ def claimant_running() -> bool:
     return any(p.isdigit() for p in out)
 
 
+def daemon_running() -> bool:
+    out = subprocess.run(
+        ["pgrep", "-f", "tpu_recovery_daemon.py"],
+        capture_output=True, text=True,
+    ).stdout.split()
+    return any(p.isdigit() for p in out)
+
+
+def ensure_daemon() -> None:
+    if daemon_running():
+        return
+    with open("/tmp/tpu_daemon.log", "a") as lf:
+        subprocess.Popen(
+            [sys.executable,
+             os.path.join(REPO, "scripts", "tpu_recovery_daemon.py")],
+            stdout=lf, stderr=subprocess.STDOUT,
+            start_new_session=True,
+        )
+    log({"phase": "autopilot", "event": "rotation daemon restarted"})
+
+
+def _terminate(p: subprocess.Popen) -> int:
+    # SIGTERM the whole process GROUP (phases start their own session):
+    # profile_sparse's per-variant grandchild is the actual tunnel client,
+    # and orphaning it alive would overlap the next claimant — two clients
+    # re-wedge the grant. Grace only; never SIGKILL (wedge protocol).
+    try:
+        os.killpg(p.pid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError):
+        p.send_signal(signal.SIGTERM)
+    try:
+        return p.wait(timeout=120)
+    except subprocess.TimeoutExpired:
+        return -1  # left headless; do not escalate to SIGKILL
+
+
 def run_phase(name: str, argv: list[str], timeout_s: float,
               extra_env: dict | None = None) -> bool:
     logpath = f"/tmp/autopilot_{name}.log"
@@ -52,42 +91,108 @@ def run_phase(name: str, argv: list[str], timeout_s: float,
     log({"phase": name, "event": "start", "log": logpath})
     with open(logpath, "w") as lf:
         p = subprocess.Popen(
-            argv, stdout=lf, stderr=subprocess.STDOUT, cwd=REPO, env=env
+            argv, stdout=lf, stderr=subprocess.STDOUT, cwd=REPO, env=env,
+            start_new_session=True,  # so _terminate can killpg descendants
         )
-        try:
-            rc = p.wait(timeout=timeout_s)
-        except subprocess.TimeoutExpired:
-            p.send_signal(signal.SIGTERM)  # grace, never SIGKILL (wedge)
+        last_size, last_change = 0, time.time()
+        while True:
             try:
-                rc = p.wait(timeout=120)
+                rc = p.wait(timeout=20)
+                break
             except subprocess.TimeoutExpired:
-                rc = -1  # left running headless; do not escalate to SIGKILL
-            log({"phase": name, "event": "timeout",
-                 "seconds": round(time.time() - t0, 1)})
-            return False
+                pass
+            now = time.time()
+            try:
+                size = os.path.getsize(logpath)
+            except OSError:
+                size = last_size
+            if size != last_size:
+                last_size, last_change = size, now
+            if now - t0 > timeout_s:
+                rc = _terminate(p)
+                log({"phase": name, "event": "timeout",
+                     "seconds": round(now - t0, 1)})
+                return False
+            if now - last_change > STALL_S:
+                rc = _terminate(p)
+                log({"phase": name, "event": "stalled",
+                     "quiet_s": round(now - last_change, 1),
+                     "seconds": round(now - t0, 1)})
+                return False
     log({"phase": name, "event": "done", "rc": rc,
          "seconds": round(time.time() - t0, 1)})
     return rc == 0
 
 
+def bench_complete(attempts: int = 0) -> bool:
+    """Real-hardware BENCH_DETAILS.json, ideally with no skipped stages.
+
+    After 2 real-backend attempts a budget-limited artifact (skipped
+    stages) is accepted — a deterministically slow chip must not trap the
+    loop into rerunning an identical bench forever.
+    """
+    try:
+        with open(BENCH_DETAILS) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if "backend_fallback_reason" in d:
+        return False
+    return not d.get("skipped_stages") or attempts >= 2
+
+
+def profile_complete() -> bool:
+    out = f"/tmp/profile_sparse.{os.getuid()}.json"
+    try:
+        with open(out) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return False
+    need = ("fused_pass_fast_ms", "matvec_fast_ms", "rmatvec_fast_ms")
+    pallas_done = any(
+        k in d for k in
+        ("fused_pass_pallas_ms", "pallas_note", "fused_pass_pallas_ms_error",
+         "matvec_pallas_ms_error")
+    )
+    return all(k in d or f"{k}_error" in d for k in need) and pallas_done
+
+
 def main() -> None:
     log({"phase": "autopilot", "event": "watching"})
-    while not os.path.exists(FLAG):
-        time.sleep(15)
-    # Let the proving claimant exit and release the tunnel before claiming.
-    while claimant_running():
-        time.sleep(10)
-    log({"phase": "autopilot", "event": "chip-up, starting sequence"})
+    bench_attempts = 0
+    ensure_daemon()  # without a rotating claimant the flag never appears
+    while True:
+        while not os.path.exists(FLAG):
+            time.sleep(15)
+        # Let the proving claimant exit and release the tunnel.
+        while claimant_running():
+            time.sleep(10)
+        try:
+            os.remove(FLAG)  # consume: a later wedge must not look "up"
+        except OSError:
+            pass
+        log({"phase": "autopilot", "event": "chip-up, starting sequence"})
 
-    run_phase("profile_sparse",
-              [sys.executable, os.path.join(REPO, "scripts",
-                                            "profile_sparse.py")],
-              timeout_s=3600)
-    run_phase("bench",
-              [sys.executable, os.path.join(REPO, "bench.py")],
-              timeout_s=7200,
-              extra_env={"PHOTON_BENCH_FORCE_PROBE": "1"})
-    log({"phase": "autopilot", "event": "sequence complete"})
+        if not bench_complete(bench_attempts):
+            bench_attempts += 1
+            run_phase("bench", [sys.executable,
+                                os.path.join(REPO, "bench.py")],
+                      timeout_s=5400,
+                      extra_env={"PHOTON_BENCH_FORCE_PROBE": "1",
+                                 "PHOTON_BENCH_BUDGET": "2400"})
+        if not profile_complete():
+            # worst healthy case: 11 variants x (jax init + tunnel compile)
+            run_phase("profile_sparse",
+                      [sys.executable,
+                       os.path.join(REPO, "scripts", "profile_sparse.py")],
+                      timeout_s=8400)
+
+        if bench_complete(bench_attempts) and profile_complete():
+            log({"phase": "autopilot", "event": "sequence complete"})
+            return
+        log({"phase": "autopilot",
+             "event": "incomplete (wedge?) — re-arming rotation"})
+        ensure_daemon()
 
 
 if __name__ == "__main__":
