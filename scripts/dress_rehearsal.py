@@ -266,6 +266,15 @@ def main() -> None:
         return
 
     with phase("train", args.out):
+        # Record which backend ACTUALLY serves the solve: under the axon
+        # sitecustomize (jax_platforms="axon,cpu") a tunnel that dies
+        # between the claim check and jax init silently falls back to CPU,
+        # and a CPU solve must never read as a chip result.
+        import jax
+
+        REPORT["backend"] = jax.devices()[0].platform
+        _flush(args.out)
+
         from photon_tpu.cli import game_training_driver
 
         t0 = time.perf_counter()
