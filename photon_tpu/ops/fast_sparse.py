@@ -152,7 +152,10 @@ def matvec_fast(aux: FastSparseAux, val: Array, w: Array, dim: int) -> Array:
     w2 = jnp.concatenate([w2, jnp.zeros((1, LANE), w.dtype)])  # ghost row
     rows = w2[aux.hi]                                  # [N, K, 128]
     sel = jnp.where(aux.lo[..., None] == _lane_iota(), rows, 0.0)
-    return jnp.sum(jnp.sum(sel, axis=-1) * val, axis=-1)
+    # Narrow-stored values (bfloat16 via with_value_dtype) upcast on load:
+    # the accumulation stays in w's precision, only the HBM stream shrinks.
+    valf = val.astype(jnp.promote_types(val.dtype, w.dtype))
+    return jnp.sum(jnp.sum(sel, axis=-1) * valf, axis=-1)
 
 
 def rmatvec_fast(
@@ -170,7 +173,10 @@ def rmatvec_fast(
     rows = dz2[aux.cs_rhi]                             # [B, Q, 128]
     iota = _lane_iota()
     dz_at = jnp.sum(jnp.where(aux.cs_rlo[..., None] == iota, rows, 0.0), axis=-1)
-    v = aux.cs_val * aux.cs_val if square_vals else aux.cs_val
+    # Upcast BEFORE squaring: bfloat16-stored values must square in the
+    # accumulation precision, not in 8 mantissa bits.
+    csv = aux.cs_val.astype(jnp.promote_types(aux.cs_val.dtype, dz.dtype))
+    v = csv * csv if square_vals else csv
     contrib = dz_at * v                                # [B, Q]
     oh = jnp.where(aux.cs_clo[..., None] == iota, 1.0, 0.0)
     out_b = jnp.einsum(

@@ -165,7 +165,8 @@ def profile_complete() -> bool:
             d = json.load(f)
     except (OSError, ValueError):
         return False
-    need = ("fused_pass_fast_ms", "matvec_fast_ms", "rmatvec_fast_ms")
+    need = ("fused_pass_fast_ms", "matvec_fast_ms", "rmatvec_fast_ms",
+            "fused_pass_fast_bf16_ms")
     pallas_done = any(
         k in d for k in
         ("fused_pass_pallas_ms", "pallas_note", "fused_pass_pallas_ms_error",

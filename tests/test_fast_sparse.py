@@ -150,3 +150,107 @@ def test_problem_run_uses_scored_path_and_matches():
     np.testing.assert_allclose(
         np.asarray(m_fast.coefficients.means),
         np.asarray(m_slow.coefficients.means), rtol=0.05, atol=0.05)
+
+
+def test_value_dtype_bfloat16_exact_for_binary_features():
+    """One-hot/binary values are exactly representable in bfloat16, so the
+    narrowed storage (with_value_dtype) must reproduce f32 results bit-for-
+    bit on matvec/rmatvec/sq_rmatvec."""
+    n, dim = 200, 300
+    rng = np.random.default_rng(11)
+    rows = [(np.unique(rng.integers(0, dim, size=5)).tolist(), None)
+            for _ in range(n)]
+    rows = [(cols, [1.0] * len(cols)) for cols, _ in rows]
+    sf = ell_from_rows(rows, dim=dim).with_fast_path(q_capacity=128)
+    nf = sf.with_value_dtype(jnp.bfloat16)
+    assert nf.val.dtype == jnp.bfloat16
+    assert nf.fast.cs_val.dtype == jnp.bfloat16
+
+    w = jnp.asarray(rng.normal(size=dim).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    for op in ("matvec", "rmatvec", "sq_rmatvec"):
+        a = getattr(sf, op)(w if op == "matvec" else v)
+        b = getattr(nf, op)(w if op == "matvec" else v)
+        assert b.dtype == jnp.float32  # accumulation stays in f32
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_value_dtype_bfloat16_close_for_continuous_features():
+    """Continuous values round to 8 mantissa bits; results must stay within
+    bf16 quantization error of the f32 path, including the square path
+    (which must upcast BEFORE squaring)."""
+    n, dim, k = 300, 517, 9
+    sf = _random_sparse(n, dim, k, seed=12).with_fast_path(q_capacity=64)
+    nf = sf.with_value_dtype(jnp.bfloat16)
+    rng = np.random.default_rng(13)
+    w = jnp.asarray(rng.normal(size=dim).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(nf.matvec(w)),
+                               np.asarray(sf.matvec(w)),
+                               rtol=0.03, atol=0.03)
+    np.testing.assert_allclose(np.asarray(nf.rmatvec(v)),
+                               np.asarray(sf.rmatvec(v)),
+                               rtol=0.03, atol=0.03)
+    np.testing.assert_allclose(np.asarray(nf.sq_rmatvec(v)),
+                               np.asarray(sf.sq_rmatvec(v)),
+                               rtol=0.05, atol=0.05)
+
+
+def test_value_dtype_drops_pallas_and_is_idempotent():
+    sf = _random_sparse(50, 64, 4, seed=14).with_fast_path(q_capacity=32)
+    # Fake an attached pallas aux: the cast must drop it (kernels are
+    # f32-only) rather than leave a stale-layout object behind.
+    import dataclasses as _dc
+
+    sf2 = _dc.replace(sf, pallas=object())
+    nf = sf2.with_value_dtype(jnp.bfloat16)
+    assert nf.pallas is None
+    assert nf.with_value_dtype(jnp.bfloat16) is nf  # no-op when already cast
+
+
+def test_glm_fit_with_bfloat16_values_converges_close():
+    """End-to-end: an L2 logistic fit on bf16-stored values reaches an
+    optimum close to the f32 fit (solver math itself stays f32)."""
+    n, dim, k = 300, 200, 8
+    sf = _random_sparse(n, dim, k, seed=15)
+    rng = np.random.default_rng(16)
+    labels = (rng.random(n) < 0.4).astype(np.float32)
+
+    def make_batch(features):
+        return LabeledBatch(
+            features=features, labels=jnp.asarray(labels),
+            offsets=jnp.zeros((n,), jnp.float32),
+            weights=jnp.ones((n,), jnp.float32),
+        )
+
+    problem = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_type=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=200, tolerance=1e-10),
+        regularization=RegularizationContext(RegularizationType.L2),
+        reg_weight=1.0,
+    )
+    w0 = jnp.zeros((dim,), jnp.float32)
+    m32, r32 = problem.run(make_batch(sf.with_fast_path(q_capacity=256)), w0)
+    m16, r16 = problem.run(
+        make_batch(sf.with_fast_path(q_capacity=256)
+                   .with_value_dtype(jnp.bfloat16)), w0)
+    assert float(r16.value) == pytest.approx(float(r32.value), rel=2e-2)
+    np.testing.assert_allclose(
+        np.asarray(m16.coefficients.means),
+        np.asarray(m32.coefficients.means), rtol=0.1, atol=0.1)
+
+
+def test_value_dtype_then_fast_path_casts_column_table():
+    """Attach order must not matter: narrowing BEFORE with_fast_path still
+    yields a bf16 column-sorted table (the builder emits f32)."""
+    sf = _random_sparse(80, 96, 5, seed=17)
+    nf = sf.with_value_dtype(jnp.bfloat16).with_fast_path(q_capacity=32)
+    assert nf.val.dtype == jnp.bfloat16
+    assert nf.fast.cs_val.dtype == jnp.bfloat16
+    rng = np.random.default_rng(18)
+    w = jnp.asarray(rng.normal(size=96).astype(np.float32))
+    # Same result as narrowing after attach.
+    other = sf.with_fast_path(q_capacity=32).with_value_dtype(jnp.bfloat16)
+    np.testing.assert_array_equal(np.asarray(nf.matvec(w)),
+                                  np.asarray(other.matvec(w)))
