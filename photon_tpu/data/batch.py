@@ -118,10 +118,13 @@ class SparseFeatures:
             jax.device_get(self.idx), jax.device_get(self.val), self.dim,
             q_capacity=q_capacity,
         )
-        if jnp.dtype(self.val.dtype) != jnp.float32:
+        if jnp.dtype(self.val.dtype).itemsize < 4:
             # Values were already narrowed (with_value_dtype before attach):
             # the column table must match or the rmatvec half of the
             # bandwidth saving silently evaporates (builder emits f32).
+            # Only narrow-dtype casts: f64 runs keep the f32 table (the
+            # builder already truncated through f32, so widening would
+            # double its memory for zero precision).
             aux = dataclasses.replace(
                 aux, cs_val=aux.cs_val.astype(self.val.dtype)
             )
@@ -173,7 +176,7 @@ class SparseFeatures:
         vd = os.environ.get("PHOTON_VALUE_DTYPE")
         if vd is not None and jnp.dtype(vd) != jnp.dtype(self.val.dtype):
             # Opt-in narrow value storage (e.g. PHOTON_VALUE_DTYPE=bfloat16):
-            # ~17% less hot-loop HBM traffic; see with_value_dtype. Tables
+            # ~27% less hot-loop HBM traffic; see with_value_dtype. Tables
             # build in f32 first, then storage casts (Pallas is f32-only
             # and is skipped).
             return self.with_fast_path().with_value_dtype(vd)
@@ -184,10 +187,9 @@ class SparseFeatures:
     def with_value_dtype(self, dtype) -> "SparseFeatures":
         """Store feature VALUES in a narrower dtype (e.g. ``jnp.bfloat16``).
 
-        The fused GLM pass is HBM-bound and values are 4 B of its ~12 B
-        per-entry stream (index digit splits and the column-sorted table
-        make up the rest), so bfloat16 storage cuts hot-loop traffic ~17%
-        on TPU; the ops upcast
+        The fused GLM pass is HBM-bound and values are 8 B of its 15 B
+        per-entry stream (with int16 digit splits; 19 B at int32), so
+        bfloat16 storage cuts hot-loop traffic ~27% on TPU; the ops upcast
         on load and accumulate in the operand precision, so only storage
         narrows. One-hot / binary / small-integer features are EXACT in
         bfloat16; continuous features round to 8 mantissa bits — opting in

@@ -47,8 +47,10 @@ class FastSparseAux:
     """Static auxiliary layouts for the fast paths.
 
     Row-major digit split (for matvec's row-slice gather):
-      ``hi[N, K]`` int32 — column id >> 7 (ghost entries point at the zero
-      row appended to the coefficient table); ``lo[N, K]`` int8 — column & 127.
+      ``hi[N, K]`` int16/int32 — column id >> 7 (ghost entries point at the
+      zero row appended to the coefficient table; int16 when the block count
+      fits, halving that index stream's HBM traffic); ``lo[N, K]`` int8 —
+      column & 127.
 
     Column-sorted table (for rmatvec's one-hot reduce): ``B`` rows of capacity
     ``Q``; every slot in row b carries an entry whose column lies in the
@@ -57,15 +59,24 @@ class FastSparseAux:
     ``cs_val`` is the feature value (0 in padding slots).
     """
 
-    hi: Array        # [N, K] int32
+    hi: Array        # [N, K] int16 or int32 (see _digit_dtype)
     lo: Array        # [N, K] int8
-    cs_rhi: Array    # [B, Q] int32
+    cs_rhi: Array    # [B, Q] int16 or int32
     cs_rlo: Array    # [B, Q] int8
     cs_clo: Array    # [B, Q] int8
     cs_val: Array    # [B, Q] float32
     cs_range: Array  # [B] int32 (sorted; == n_ranges for padding rows)
     n_ranges: int = dataclasses.field(metadata=dict(static=True))
     n_row_blocks: int = dataclasses.field(metadata=dict(static=True))
+
+
+def _digit_dtype(n_blocks: int):
+    """Narrowest int dtype for a >>7 digit stream with ``n_blocks`` valid
+    block ids PLUS the ghost/zero block. The digit arrays are pure HBM
+    traffic in the hot loop, so int16 (feature spaces <= 128*32767 ≈ 4.19M,
+    row spaces likewise) halves their share of the stream; beyond that the
+    layout transparently stays int32."""
+    return np.int16 if n_blocks + 1 <= np.iinfo(np.int16).max else np.int32
 
 
 def build_fast_aux(
@@ -85,7 +96,7 @@ def build_fast_aux(
     n_col_blocks = -(-dim // LANE)
 
     # Row-major digit split; ghost entries -> appended zero row of w table.
-    hi = (idx >> 7).astype(np.int32)
+    hi = (idx >> 7).astype(_digit_dtype(n_col_blocks))
     lo = (idx & 127).astype(np.int8)
     ghost = idx >= dim
     hi[ghost] = n_col_blocks
@@ -106,7 +117,7 @@ def build_fast_aux(
     b_total = int(rows_per_range.sum())
     b_pad = -(-b_total // 8) * 8
 
-    cs_rhi = np.zeros((b_pad, q_capacity), np.int32)
+    cs_rhi = np.zeros((b_pad, q_capacity), _digit_dtype(n_row_blocks))
     cs_rlo = np.zeros((b_pad, q_capacity), np.int8)
     cs_clo = np.zeros((b_pad, q_capacity), np.int8)
     cs_val = np.zeros((b_pad, q_capacity), np.float32)
@@ -121,7 +132,7 @@ def build_fast_aux(
             m = end - off
             if m > 0:
                 sl = slice(off, end)
-                cs_rhi[b, :m] = (rows[sl] >> 7).astype(np.int32)
+                cs_rhi[b, :m] = (rows[sl] >> 7).astype(cs_rhi.dtype)
                 cs_rlo[b, :m] = (rows[sl] & 127).astype(np.int8)
                 cs_clo[b, :m] = (cols[sl] & 127).astype(np.int8)
                 cs_val[b, :m] = vals[sl]

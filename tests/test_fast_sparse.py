@@ -254,3 +254,22 @@ def test_value_dtype_then_fast_path_casts_column_table():
     other = sf.with_fast_path(q_capacity=32).with_value_dtype(jnp.bfloat16)
     np.testing.assert_array_equal(np.asarray(nf.matvec(w)),
                                   np.asarray(other.matvec(w)))
+
+
+def test_digit_dtype_narrows_and_results_match():
+    """Small spaces store >>7 digits as int16 (pure-HBM-stream halving);
+    the threshold leaves room for the ghost block, and results are
+    unchanged vs the generic path (covered by the match tests, which now
+    exercise the int16 branch at their shapes)."""
+    from photon_tpu.ops.fast_sparse import _digit_dtype
+
+    assert _digit_dtype(100) == np.int16
+    assert _digit_dtype(np.iinfo(np.int16).max - 1) == np.int16  # +ghost fits
+    assert _digit_dtype(np.iinfo(np.int16).max) == np.int32      # would clip
+    assert _digit_dtype(1 << 20) == np.int32
+
+    sf = _random_sparse(300, 517, 9, seed=19)
+    aux = build_fast_aux(np.asarray(sf.idx), np.asarray(sf.val), 517,
+                         q_capacity=64)
+    assert aux.hi.dtype == jnp.int16
+    assert aux.cs_rhi.dtype == jnp.int16
