@@ -1003,6 +1003,7 @@ def main():
     # always prints well inside the driver's window. The required stages
     # (headline solve + numpy baseline) always run.
     budget = float(os.environ.get("PHOTON_BENCH_BUDGET", "900"))
+    here = os.path.dirname(os.path.abspath(__file__))
     details = {"smoke_mode": True} if SMOKE else {}
     if BACKEND_FALLBACK is not None:
         details["backend"] = "cpu-fallback"
@@ -1011,9 +1012,7 @@ def main():
         # Evidence that recovery was attempted continuously (VERDICT r3 ask
         # #1): the rotation daemon logs every claim attempt; ship the tail
         # in the artifact so a cpu-fallback round still shows its work.
-        rec_log = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "TPU_RECOVERY.jsonl"
-        )
+        rec_log = os.path.join(here, "TPU_RECOVERY.jsonl")
         try:
             with open(rec_log) as f:
                 lines = f.readlines()
@@ -1021,6 +1020,28 @@ def main():
             details["tpu_recovery_tail"] = [
                 json.loads(x) for x in lines[-8:]
             ]
+        except (OSError, ValueError):
+            pass
+        # A mid-round recovery window may have banked a real-hardware
+        # artifact (the autopilot runs the full bench the moment the chip
+        # answers). A wedged round-end run must still surface those
+        # numbers: embed the real artifact's headline, honestly labeled
+        # with its measurement time — never as this run's own result.
+        real = os.path.join(here, "BENCH_DETAILS.json")
+        try:
+            with open(real) as f:
+                rd = json.load(f)
+            if "backend_fallback_reason" not in rd:
+                # written_at is stamped by flush(); artifacts predating the
+                # stamp get an honest "unknown" rather than a file mtime
+                # (git checkouts reset mtime to clone time, which would
+                # mislabel old numbers as freshly measured).
+                lrh = {"measured_at": rd.get(
+                    "written_at", "unknown (artifact predates written_at)")}
+                for k in ("fixed_effect_lbfgs", "roofline", "baseline_model"):
+                    if k in rd:
+                        lrh[k] = rd[k]
+                details["last_real_hardware"] = lrh
         except (OSError, ValueError):
             pass
     stage_seconds = {}
@@ -1032,10 +1053,16 @@ def main():
         else "BENCH_DETAILS.cpu-fallback.json" if BACKEND_FALLBACK is not None
         else "BENCH_DETAILS.json"
     )
-    details_path = os.path.join(os.path.dirname(__file__) or ".", details_name)
+    details_path = os.path.join(here, details_name)
 
     def flush():
         # Persist after every stage: a killed run keeps everything finished.
+        # written_at is measurement provenance (read back by the fallback
+        # path's last_real_hardware embed) — file mtime is NOT trustworthy
+        # for a git-tracked artifact.
+        details["written_at"] = time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        )
         details["stage_seconds"] = {k: round(v, 1) for k, v in stage_seconds.items()}
         with open(details_path, "w") as f:
             json.dump(details, f, indent=2)
