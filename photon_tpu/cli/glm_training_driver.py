@@ -103,6 +103,15 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--response-column", default="response")
     p.add_argument("--uid-column", default="uid")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    p.add_argument("--row-chunk-rows", type=int, default=-1,
+                   help="out-of-core training: keep the ELL arrays "
+                        "host-resident in row chunks of this size and stream "
+                        "them through the accelerator per optimizer pass "
+                        "(datasets beyond device memory; LBFGS+L2, "
+                        "normalization/variance NONE). 0 = always in-core; "
+                        "-1 = auto (accelerator backends route here when the "
+                        "input file size exceeds "
+                        "$PHOTON_DEVICE_DATA_BUDGET_GB, default 10)")
     from photon_tpu.cli.params import add_compilation_cache_flag
 
     add_compilation_cache_flag(p)
@@ -116,6 +125,182 @@ def _default_evaluators(task: TaskType) -> tuple[str, ...]:
         TaskType.POISSON_REGRESSION: ("POISSON_LOSS",),
         TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM: ("AUC",),
     }[task]
+
+
+def _save_best(args, imap, shard_cfg, best, logger) -> None:
+    """Persist the selected model as a standard single-coordinate GAME model
+    plus its mmap index — shared by the in-core and out-of-core routes."""
+    from photon_tpu.game.coordinates import FixedEffectModel
+    from photon_tpu.game.descent import GameModel
+
+    with Timed("save model", logger):
+        gm = GameModel(models={
+            "fixed": FixedEffectModel(model=best, feature_shard=SHARD)
+        })
+        save_game_model(
+            os.path.join(args.output_dir, "best"), gm,
+            {SHARD: imap}, {"fixed": SHARD}, {SHARD: shard_cfg},
+        )
+        idir = os.path.join(args.output_dir, "index", SHARD)
+        if isinstance(imap, MmapIndexMap):
+            if not os.path.exists(idir):
+                import shutil
+
+                shutil.copytree(imap.store_dir, idir)
+        else:
+            build_mmap_index(imap, idir)
+
+
+def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
+    """Out-of-core fixed-effect route (optim/out_of_core.py): host-resident
+    row chunks streamed per pass — for datasets a single device's memory
+    cannot hold. Supports the smooth L2/LBFGS configuration (the config-5
+    scale shape); anything needing in-core data (normalization, variances,
+    bootstrap, other optimizers) raises loudly instead of silently
+    degrading."""
+    import jax.numpy as jnp
+
+    from photon_tpu.io.streaming import StreamingAvroReader
+    from photon_tpu.optim.out_of_core import (
+        ChunkedGLMData,
+        run_out_of_core,
+        scores_out_of_core,
+    )
+
+    for flag, want, got in (
+        ("--optimizer", "LBFGS", args.optimizer),
+        ("--regularization", "L2", args.regularization),
+        ("--normalization", "NONE", args.normalization),
+        ("--variance", "NONE", args.variance),
+        ("--dtype", "float32", args.dtype),
+    ):
+        if got != want:
+            raise ValueError(
+                f"out-of-core training supports {flag}={want} only "
+                f"(got {got}); pass --row-chunk-rows 0 to force in-core"
+            )
+    if args.bootstrap_replicates:
+        raise ValueError("bootstrap CIs need in-core refits; drop "
+                         "--bootstrap-replicates or force in-core")
+
+    columns = InputColumnNames(
+        uid=args.uid_column,
+        response=args.response_column,
+        offset=args.offset_column,
+        weight=args.weight_column,
+    )
+    sreader = StreamingAvroReader(
+        {SHARD: imap}, {SHARD: shard_cfg}, columns, (),
+        chunk_rows=chunk_rows, capture_uids=False,
+    )
+    value_dtype = os.environ.get("PHOTON_VALUE_DTYPE")
+    validation = DataValidationType[args.data_validation]
+
+    def validated_chunks():
+        # Per-chunk data validation: same --data-validation contract as the
+        # in-core path, applied as the stream flows (each streamed chunk is
+        # a bona fide LabeledBatch of true rows — no padding yet).
+        from photon_tpu.data.batch import LabeledBatch
+
+        for c in sreader.iter_chunks(args.train_data):
+            sanity_check_data(
+                LabeledBatch(
+                    features=c.features[SHARD],
+                    labels=jnp.asarray(c.labels, jnp.float32),
+                    offsets=jnp.asarray(c.offsets, jnp.float32),
+                    weights=jnp.asarray(c.weights, jnp.float32),
+                ),
+                task, validation,
+            )
+            yield c
+
+    with Timed("stream training data (host-resident chunks)", logger):
+        data = ChunkedGLMData.from_stream(
+            validated_chunks(), SHARD, len(imap),
+            chunk_rows=chunk_rows,
+            value_dtype=jnp.dtype(value_dtype) if value_dtype else None,
+        )
+    logger.info(
+        "out-of-core: %d rows in %d chunks, %.2f GB streamed per pass",
+        data.n_rows, data.n_chunks, data.streamed_bytes_per_pass() / 1e9,
+    )
+
+    suite = EvaluationSuite.parse(
+        list(args.evaluators or _default_evaluators(task))
+    )
+    reg = RegularizationContext(RegularizationType[args.regularization])
+
+    # Evaluation labels/weights: validation set in-core if given (it is
+    # normally far smaller than train), else streamed train scores.
+    val_batch = None
+    if args.validation_data:
+        reader = AvroDataReader({SHARD: imap}, {SHARD: shard_cfg},
+                                columns=columns)
+        with Timed("read validation data", logger):
+            val_batch = reader.read(
+                args.validation_data, capture_uids=False
+            ).batch(SHARD)
+
+    sweep, models, best_i = [], [], 0
+    with Timed("regularization sweep (out-of-core)", logger):
+        for i, lam in enumerate(args.reg_weights):
+            problem = GLMOptimizationProblem(
+                task=task,
+                optimizer_type=OptimizerType[args.optimizer],
+                optimizer_config=OptimizerConfig(
+                    max_iterations=args.max_iterations,
+                    tolerance=args.tolerance,
+                ),
+                regularization=reg,
+                reg_weight=lam,
+            )
+            model, result = run_out_of_core(problem, data)
+            if val_batch is not None:
+                scores = model.compute_score(
+                    val_batch.features, val_batch.offsets
+                )
+                ev = suite.evaluate(scores, val_batch.labels,
+                                    val_batch.weights)
+            else:
+                scores = scores_out_of_core(data, model.coefficients.means)
+                ev = suite.evaluate(
+                    scores, data.labels_np(), data.weights_np()
+                )
+            sweep.append({
+                "reg_weight": lam,
+                "iterations": int(result.iterations),
+                "objective": float(result.value),
+                "data_passes": int(result.data_passes),
+                **{k: float(v) for k, v in ev.values.items()},
+            })
+            models.append(model)
+            if i > 0 and suite.primary.better_than(
+                ev.primary, sweep[best_i][suite.primary.name]
+            ):
+                best_i = i
+            logger.info("λ=%g: %s", lam, sweep[-1])
+    best, best_lam = models[best_i], args.reg_weights[best_i]
+    logger.info("selected λ=%g (%s)", best_lam, suite.primary.name)
+
+    _save_best(args, imap, shard_cfg, best, logger)
+
+    summary = {
+        "task": task.name,
+        "mode": "out_of_core",
+        "row_chunk_rows": chunk_rows,
+        "n_rows": data.n_rows,
+        "n_chunks": data.n_chunks,
+        "streamed_gb_per_pass": round(
+            data.streamed_bytes_per_pass() / 1e9, 3),
+        "selected_reg_weight": best_lam,
+        "sweep": sweep,
+        "evaluation": sweep[best_i],
+        "model_dir": os.path.join(args.output_dir, "best"),
+    }
+    with open(os.path.join(args.output_dir, "training-summary.json"),
+              "w") as f:
+        json.dump(summary, f, indent=2)
+    return summary
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
@@ -149,6 +334,32 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 add_intercept=shard_cfg.add_intercept,
             )
         logger.info("index: %d features", len(imap))
+
+        ooc_rows = args.row_chunk_rows
+        if ooc_rows < 0:
+            import jax
+
+            budget_gb = float(
+                os.environ.get("PHOTON_DEVICE_DATA_BUDGET_GB", "10")
+            )
+            from photon_tpu.io.data_reader import _expand_paths
+
+            total = sum(
+                os.path.getsize(f) for f in _expand_paths(args.train_data)
+            )
+            on_accel = jax.default_backend() in ("tpu", "axon")
+            ooc_rows = (1 << 20) if (
+                on_accel and total > budget_gb * 1e9
+            ) else 0
+            if ooc_rows:
+                logger.info(
+                    "train data %.1f GB exceeds device budget %.0f GB: "
+                    "out-of-core path (chunk %d rows)",
+                    total / 1e9, budget_gb, ooc_rows,
+                )
+        if ooc_rows:
+            return _run_out_of_core(args, task, imap, shard_cfg, ooc_rows,
+                                    logger)
 
         reader = AvroDataReader(
             {SHARD: imap},
@@ -280,25 +491,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                         hl.statistic, hl.df, hl.p_value)
         imp = feature_importance(np.asarray(best.coefficients.means), stats)
 
-        with Timed("save model", logger):
-            from photon_tpu.game.descent import GameModel
-            from photon_tpu.game.coordinates import FixedEffectModel
-
-            gm = GameModel(models={
-                "fixed": FixedEffectModel(model=best, feature_shard=SHARD)
-            })
-            save_game_model(
-                os.path.join(args.output_dir, "best"), gm,
-                {SHARD: imap}, {"fixed": SHARD}, {SHARD: shard_cfg},
-            )
-            idir = os.path.join(args.output_dir, "index", SHARD)
-            if isinstance(imap, MmapIndexMap):
-                if not os.path.exists(idir):
-                    import shutil
-
-                    shutil.copytree(imap._dir, idir)
-            else:
-                build_mmap_index(imap, idir)
+        _save_best(args, imap, shard_cfg, best, logger)
 
         report_path = None
         if not args.no_report:
