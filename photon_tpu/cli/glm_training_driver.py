@@ -196,30 +196,33 @@ def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
     value_dtype = os.environ.get("PHOTON_VALUE_DTYPE")
     validation = DataValidationType[args.data_validation]
 
-    def validated_chunks():
-        # Per-chunk data validation: same --data-validation contract as the
-        # in-core path, applied as the stream flows (each streamed chunk is
-        # a bona fide LabeledBatch of true rows — no padding yet).
-        from photon_tpu.data.batch import LabeledBatch
-
-        for c in sreader.iter_chunks(args.train_data):
-            sanity_check_data(
-                LabeledBatch(
-                    features=c.features[SHARD],
-                    labels=jnp.asarray(c.labels, jnp.float32),
-                    offsets=jnp.asarray(c.offsets, jnp.float32),
-                    weights=jnp.asarray(c.weights, jnp.float32),
-                ),
-                task, validation,
-            )
-            yield c
-
     with Timed("stream training data (host-resident chunks)", logger):
         data = ChunkedGLMData.from_stream(
-            validated_chunks(), SHARD, len(imap),
+            sreader.iter_chunks(args.train_data), SHARD, len(imap),
             chunk_rows=chunk_rows,
             value_dtype=jnp.dtype(value_dtype) if value_dtype else None,
         )
+    with Timed("data validation (per chunk)", logger):
+        # Same --data-validation contract as the in-core path, applied to
+        # the ASSEMBLED fixed-shape chunks: every chunk shares one shape,
+        # so the jitted violation counts compile once (streamed chunks vary
+        # in rows and ELL width — validating those would recompile per
+        # chunk). Padding rows carry weight 0 / ghost columns, the same
+        # convention the in-core bundle batch is validated under.
+        from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+
+        for i, c in enumerate(data.chunks):
+            sanity_check_data(
+                LabeledBatch(
+                    features=SparseFeatures(idx=jnp.asarray(c.idx),
+                                            val=jnp.asarray(c.val),
+                                            dim=data.dim),
+                    labels=data.labels[i],
+                    offsets=data.offsets[i],
+                    weights=data.weights[i],
+                ),
+                task, validation,
+            )
     logger.info(
         "out-of-core: %d rows in %d chunks, %.2f GB streamed per pass",
         data.n_rows, data.n_chunks, data.streamed_bytes_per_pass() / 1e9,
@@ -254,7 +257,13 @@ def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
                 regularization=reg,
                 reg_weight=lam,
             )
-            model, result = run_out_of_core(problem, data)
+            model, result = run_out_of_core(
+                problem, data,
+                progress=lambda it, f, gn, p: logger.info(
+                    "λ=%g iter %d: f=%.6g |g|=%.3g passes=%d", lam, it, f,
+                    gn, p,
+                ),
+            )
             if val_batch is not None:
                 scores = model.compute_score(
                     val_batch.features, val_batch.offsets
@@ -347,15 +356,23 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             total = sum(
                 os.path.getsize(f) for f in _expand_paths(args.train_data)
             )
+            # On-disk Avro bytes UNDERESTIMATE device footprint (deflate
+            # blocks commonly shrink 3-5x; decoded ELL adds padding), so
+            # the auto-route applies a conservative expansion factor.
+            expand = float(
+                os.environ.get("PHOTON_AVRO_EXPANSION_FACTOR", "4")
+            )
+            est = total * expand
             on_accel = jax.default_backend() in ("tpu", "axon")
             ooc_rows = (1 << 20) if (
-                on_accel and total > budget_gb * 1e9
+                on_accel and est > budget_gb * 1e9
             ) else 0
             if ooc_rows:
                 logger.info(
-                    "train data %.1f GB exceeds device budget %.0f GB: "
-                    "out-of-core path (chunk %d rows)",
-                    total / 1e9, budget_gb, ooc_rows,
+                    "train data %.1f GB on disk (est. %.1f GB decoded) "
+                    "exceeds device budget %.0f GB: out-of-core path "
+                    "(chunk %d rows)",
+                    total / 1e9, est / 1e9, budget_gb, ooc_rows,
                 )
         if ooc_rows:
             return _run_out_of_core(args, task, imap, shard_cfg, ooc_rows,

@@ -262,6 +262,10 @@ class OutOfCoreLBFGS:
     l2_weight: float = 0.0
     reg_mask: Optional[Array] = None
     config: OptimizerConfig = OptimizerConfig()
+    # Called after every iteration with (it, value, grad_norm, passes).
+    # Streamed passes can take minutes each at scale; liveness signals
+    # (driver logs, autopilot stall detection) hang off this.
+    progress: Optional[object] = None
 
     # -- jitted per-chunk kernels -----------------------------------------
 
@@ -348,6 +352,7 @@ class OutOfCoreLBFGS:
             # Armijo backtracking over RESIDENT margins (no data pass per
             # probe) — same constants as optim/lbfgs.py armijo_backtrack.
             t, ft, accept = 1.0, f, False
+            t_last = 0.0  # the step size the CURRENT ft was evaluated at
             c1, shrink = 1e-4, 0.5
             for _ in range(cfg.max_line_search_iterations):
                 wt = w + t * d
@@ -359,9 +364,13 @@ class OutOfCoreLBFGS:
                 ):
                     accept = True
                     break
+                t_last = t
                 t *= shrink
             if not accept and bool(jnp.isfinite(ft)) and float(ft) < float(f):
-                accept = True  # smallest probe still decreases f
+                # Smallest PROBED step still decreases f: apply that exact
+                # step, not the once-more-shrunk t that was never evaluated.
+                t = t_last
+                accept = t > 0.0
             if not accept:
                 # No further progress possible — same terminal behavior as
                 # the in-core loop (next dual test fires on |Δf| = 0).
@@ -378,6 +387,8 @@ class OutOfCoreLBFGS:
             it += 1
             values[it] = float(f)
             grad_norms[it] = float(jnp.linalg.norm(g))
+            if self.progress is not None:
+                self.progress(it, values[it], grad_norms[it], passes)
 
         return OptimizerResult(
             x=w,
@@ -393,14 +404,10 @@ class OutOfCoreLBFGS:
 
 def scores_out_of_core(data: ChunkedGLMData, w) -> np.ndarray:
     """Streamed scores z = Xw + offsets for every (true) row — the chunked
-    analogue of ``GeneralizedLinearModel.compute_score``."""
+    analogue of ``GeneralizedLinearModel.compute_score``. Reuses the cached
+    matvec kernel, so a λ-sweep scoring after each fit never recompiles."""
     w = jnp.asarray(w, jnp.float32)
-
-    @jax.jit
-    def k_matvec(wv, idx, val, offsets):
-        sf = SparseFeatures(idx=idx, val=val, dim=data.dim)
-        return sf.matvec(wv) + offsets
-
+    k_matvec = _matvec_for(data.dim)
     outs = [
         np.asarray(k_matvec(w, c.idx, c.val, data.offsets[i]))
         for i, c in enumerate(data.chunks)
@@ -408,7 +415,8 @@ def scores_out_of_core(data: ChunkedGLMData, w) -> np.ndarray:
     return np.concatenate(outs)[: data.n_rows]
 
 
-def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None):
+def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
+                    progress=None):
     """Problem-level entry mirroring ``GLMOptimizationProblem.run`` for the
     out-of-core path: same task→loss mapping, L2/reg-mask semantics, and
     ``(GLMModel, OptimizerResult)`` return. Variance NONE only (SIMPLE/FULL
@@ -435,6 +443,7 @@ def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None):
         l2_weight=problem.regularization.l2_weight(float(problem.reg_weight)),
         reg_mask=reg_mask,
         config=problem.optimizer_config,
+        progress=progress,
     )
     if w0 is None:
         w0 = jnp.zeros((data.dim,), jnp.float32)

@@ -170,6 +170,11 @@ def main() -> None:
                     help="run the data path at full shape (write, index, "
                          "stream every row) without the solve — host-side "
                          "proof while the accelerator is unavailable")
+    ap.add_argument("--game-rows", type=int, default=25_000_000,
+                    help="row cap for the GAME (fixed+RE) phase — the RE "
+                         "buckets are device-resident, so GAME caps at what "
+                         "HBM holds; the full-shape fixed solve runs "
+                         "out-of-core at --rows regardless")
     ap.add_argument("--keep-data", action="store_true")
     args = ap.parse_args()
     if not args.tpu:
@@ -265,21 +270,72 @@ def main() -> None:
         print(json.dumps(REPORT, indent=1), flush=True)
         return
 
+    # Record which backend ACTUALLY serves the solves: under the axon
+    # sitecustomize (jax_platforms="axon,cpu") a tunnel that dies between
+    # the claim check and jax init silently falls back to CPU, and a CPU
+    # solve must never read as a chip result.
+    import jax
+
+    REPORT["backend"] = jax.devices()[0].platform
+    _flush(args.out)
+
+    # Phase A — the FULL-SHAPE solve: a single chip's HBM cannot hold the
+    # 100M x 32 ELL (25.6 GB vs 16 GB), so this runs the out-of-core route
+    # (optim/out_of_core.py): host-resident row chunks streamed per L-BFGS
+    # pass. This is the end-to-end config-5-scale fixed-effect fit, on the
+    # accelerator, at the full row count.
+    with phase("train_full_scale_out_of_core", args.out):
+        from photon_tpu.cli import glm_training_driver
+
+        t0 = time.perf_counter()
+        s = glm_training_driver.run([
+            "--train-data", data,
+            "--output-dir", os.path.join(args.out, "model_full_ooc"),
+            "--task", "LOGISTIC_REGRESSION",
+            "--feature-shard", "global:features",
+            "--reg-weights", "1.0",
+            "--max-iterations", "10",
+            "--normalization", "NONE", "--variance", "NONE", "--no-report",
+            "--row-chunk-rows", str(1 << 21),
+        ])
+        took = time.perf_counter() - t0
+        ent = REPORT["phases"]["train_full_scale_out_of_core"]
+        ent["summary"] = {
+            k: v for k, v in s.items()
+            if isinstance(v, (int, float, str, bool, type(None)))
+        }
+        ent["rows_per_sec_end_to_end"] = round(args.rows / took, 1)
+
+    # Phase B — GAME semantics (fixed + per-user random effect) at a
+    # device-feasible row count: the RE buckets are device-materialized, so
+    # the GAME coordinates cap at what HBM holds (quarter scale by default;
+    # the full-shape solve above carries the scale claim).
+    game_rows = min(args.rows, args.game_rows)
+    game_data_path = data
+    if game_rows < args.rows:
+        game_data_path = os.path.join(args.out, "train_game.avro")
+        with phase("write_game_subset", args.out):
+            # Same never-reuse-at-a-different-shape guard as the main file.
+            gshape = {"rows": game_rows, "features": args.features,
+                      "users": args.users, "unique_rows": args.unique_rows}
+            gmeta = game_data_path + ".meta.json"
+            cached_ok = False
+            if os.path.exists(game_data_path) and os.path.exists(gmeta):
+                with open(gmeta) as f:
+                    cached_ok = json.load(f) == gshape
+            if not cached_ok:
+                write_tiled_avro(game_data_path, game_rows, args.features,
+                                 args.users, args.unique_rows)
+                with open(gmeta, "w") as f:
+                    json.dump(gshape, f)
+            REPORT["phases"]["write_game_subset"]["rows"] = game_rows
+
     with phase("train", args.out):
-        # Record which backend ACTUALLY serves the solve: under the axon
-        # sitecustomize (jax_platforms="axon,cpu") a tunnel that dies
-        # between the claim check and jax init silently falls back to CPU,
-        # and a CPU solve must never read as a chip result.
-        import jax
-
-        REPORT["backend"] = jax.devices()[0].platform
-        _flush(args.out)
-
         from photon_tpu.cli import game_training_driver
 
         t0 = time.perf_counter()
         summary = game_training_driver.run([
-            "--train-data", data,
+            "--train-data", game_data_path,
             "--output-dir", os.path.join(args.out, "model"),
             "--task", "LOGISTIC_REGRESSION",
             "--feature-shard", "global:features",
@@ -296,8 +352,9 @@ def main() -> None:
             k: v for k, v in summary.items()
             if isinstance(v, (int, float, str, bool, type(None)))
         }
+        REPORT["phases"]["train"]["rows"] = game_rows
         REPORT["phases"]["train"]["rows_per_sec_end_to_end"] = round(
-            args.rows / took, 1
+            game_rows / took, 1
         )
 
     if not args.keep_data:

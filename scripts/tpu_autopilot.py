@@ -149,10 +149,12 @@ def rehearsal_complete() -> bool:
             d = json.load(f)
     except (OSError, ValueError):
         return False
-    train = d.get("phases", {}).get("train", {})
+    phases = d.get("phases", {})
+    full = phases.get("train_full_scale_out_of_core", {})
+    game = phases.get("train", {})
     return (
-        "summary" in train
-        and not train.get("error")
+        "summary" in full and not full.get("error")
+        and "summary" in game and not game.get("error")
         and d.get("backend") not in (None, "cpu")
         and d.get("config", {}).get("rows", 0) >= 100_000_000
     )
