@@ -151,6 +151,26 @@ def _save_best(args, imap, shard_cfg, best, logger) -> None:
             build_mmap_index(imap, idir)
 
 
+def _ooc_unsupported_flag(args):
+    """``(flag, wanted, got)`` for the first flag the out-of-core route
+    cannot honor, else None. ONE source of truth shared by the auto-router
+    (which must fall back in-core, never error, on a config that worked
+    before OOC existed) and by ``_run_out_of_core`` (which fails loudly on
+    an EXPLICIT --row-chunk-rows request it cannot honor)."""
+    for flag, want, got in (
+        ("--optimizer", "LBFGS", args.optimizer),
+        ("--regularization", "L2", args.regularization),
+        ("--normalization", "NONE", args.normalization),
+        ("--variance", "NONE", args.variance),
+        ("--dtype", "float32", args.dtype),
+    ):
+        if got != want:
+            return flag, want, got
+    if args.bootstrap_replicates:
+        return "--bootstrap-replicates", "0", str(args.bootstrap_replicates)
+    return None
+
+
 def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
     """Out-of-core fixed-effect route (optim/out_of_core.py): host-resident
     row chunks streamed per pass — for datasets a single device's memory
@@ -167,21 +187,13 @@ def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
         scores_out_of_core,
     )
 
-    for flag, want, got in (
-        ("--optimizer", "LBFGS", args.optimizer),
-        ("--regularization", "L2", args.regularization),
-        ("--normalization", "NONE", args.normalization),
-        ("--variance", "NONE", args.variance),
-        ("--dtype", "float32", args.dtype),
-    ):
-        if got != want:
-            raise ValueError(
-                f"out-of-core training supports {flag}={want} only "
-                f"(got {got}); pass --row-chunk-rows 0 to force in-core"
-            )
-    if args.bootstrap_replicates:
-        raise ValueError("bootstrap CIs need in-core refits; drop "
-                         "--bootstrap-replicates or force in-core")
+    bad = _ooc_unsupported_flag(args)
+    if bad is not None:
+        flag, want, got = bad
+        raise ValueError(
+            f"out-of-core training supports {flag}={want} only "
+            f"(got {got}); pass --row-chunk-rows 0 to force in-core"
+        )
 
     columns = InputColumnNames(
         uid=args.uid_column,
@@ -196,33 +208,49 @@ def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
     value_dtype = os.environ.get("PHOTON_VALUE_DTYPE")
     validation = DataValidationType[args.data_validation]
 
-    with Timed("stream training data (host-resident chunks)", logger):
+    # Same --data-validation contract as the in-core path, applied to each
+    # ASSEMBLED fixed-shape chunk THE MOMENT it exists (fail fast: a NaN in
+    # the first chunk of a 100M-row stream raises within seconds, not after
+    # the whole dataset is decoded into host RAM). Chunks share one shape,
+    # so the jitted violation counts compile once per ELL width (the width
+    # can grow a few times mid-stream). Padding rows carry weight 0 / ghost
+    # columns, the same convention the in-core bundle batch is validated
+    # under. SAMPLE mode slices HOST-side so only the sampled rows cross to
+    # the device; DISABLED transfers nothing.
+    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+    from photon_tpu.data.validators import SAMPLE_ROWS_DEFAULT
+
+    def _validate_chunk(i, c, lab, off, wgt):
+        if validation is DataValidationType.VALIDATE_SAMPLE:
+            idx, val = c.idx[:SAMPLE_ROWS_DEFAULT], c.val[:SAMPLE_ROWS_DEFAULT]
+            lab = lab[:SAMPLE_ROWS_DEFAULT]
+            off = off[:SAMPLE_ROWS_DEFAULT]
+            wgt = wgt[:SAMPLE_ROWS_DEFAULT]
+        else:
+            idx, val = c.idx, c.val
+        sanity_check_data(
+            LabeledBatch(
+                features=SparseFeatures(idx=jnp.asarray(idx),
+                                        val=jnp.asarray(val),
+                                        dim=len(imap)),
+                labels=lab,
+                offsets=off,
+                weights=wgt,
+            ),
+            task, validation,
+        )
+
+    on_chunk = (
+        None if validation is DataValidationType.VALIDATE_DISABLED
+        else _validate_chunk
+    )
+    with Timed("stream training data (host chunks, validated)", logger):
         data = ChunkedGLMData.from_stream(
             sreader.iter_chunks(args.train_data), SHARD, len(imap),
             chunk_rows=chunk_rows,
             value_dtype=jnp.dtype(value_dtype) if value_dtype else None,
+            on_chunk=on_chunk,
         )
-    with Timed("data validation (per chunk)", logger):
-        # Same --data-validation contract as the in-core path, applied to
-        # the ASSEMBLED fixed-shape chunks: every chunk shares one shape,
-        # so the jitted violation counts compile once (streamed chunks vary
-        # in rows and ELL width — validating those would recompile per
-        # chunk). Padding rows carry weight 0 / ghost columns, the same
-        # convention the in-core bundle batch is validated under.
-        from photon_tpu.data.batch import LabeledBatch, SparseFeatures
-
-        for i, c in enumerate(data.chunks):
-            sanity_check_data(
-                LabeledBatch(
-                    features=SparseFeatures(idx=jnp.asarray(c.idx),
-                                            val=jnp.asarray(c.val),
-                                            dim=data.dim),
-                    labels=data.labels[i],
-                    offsets=data.offsets[i],
-                    weights=data.weights[i],
-                ),
-                task, validation,
-            )
     logger.info(
         "out-of-core: %d rows in %d chunks, %.2f GB streamed per pass",
         data.n_rows, data.n_chunks, data.streamed_bytes_per_pass() / 1e9,
@@ -367,6 +395,21 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             ooc_rows = (1 << 20) if (
                 on_accel and est > budget_gb * 1e9
             ) else 0
+            bad = _ooc_unsupported_flag(args) if ooc_rows else None
+            if bad is not None:
+                # Auto-routing must never turn a formerly working in-core
+                # run into a hard ValueError: any flag the OOC loop cannot
+                # honor keeps the run in-core (the pre-OOC behavior — it may
+                # OOM if the estimate was right, which is the same failure
+                # the user had before) and says why.
+                logger.warning(
+                    "train data est. %.1f GB decoded exceeds device budget "
+                    "%.0f GB but %s=%s requires the in-core path; staying "
+                    "in-core (set %s=%s to enable out-of-core streaming, or "
+                    "--row-chunk-rows N to force)",
+                    est / 1e9, budget_gb, bad[0], bad[2], bad[0], bad[1],
+                )
+                ooc_rows = 0
             if ooc_rows:
                 logger.info(
                     "train data %.1f GB on disk (est. %.1f GB decoded) "

@@ -27,6 +27,11 @@ from photon_tpu.types import TaskType
 
 Array = jax.Array
 
+# Rows checked under VALIDATE_SAMPLE. Shared with callers that pre-slice
+# host-side before the device transfer (the out-of-core driver) so the two
+# --data-validation contracts cannot silently diverge.
+SAMPLE_ROWS_DEFAULT = 1024
+
 
 class DataValidationType(enum.Enum):
     """Reference ⟦DataValidationType⟧."""
@@ -101,7 +106,7 @@ def sanity_check_data(
     batch: LabeledBatch,
     task: TaskType,
     validation_type: DataValidationType = DataValidationType.VALIDATE_FULL,
-    sample_rows: int = 1024,
+    sample_rows: int = SAMPLE_ROWS_DEFAULT,
 ) -> None:
     """Raise ``DataValidationError`` listing every failed check.
 

@@ -127,13 +127,23 @@ class ChunkedGLMData:
         dim: int,
         chunk_rows: int = 1 << 20,
         value_dtype=None,
+        on_chunk=None,
     ) -> "ChunkedGLMData":
         """Build from ``StreamingAvroReader.iter_chunks`` output WITHOUT
         ever materializing the dataset as one device array — the whole point
         of this path (streamed chunks hold host numpy ELL; see
         ``io/streaming.py`` chunk construction). Streamed chunk widths (K)
         may vary; the OOC chunks use the global max so one kernel compile
-        serves every chunk."""
+        serves every chunk.
+
+        ``on_chunk(i, host_chunk, labels, offsets, weights)``, when given, is
+        invoked the moment chunk ``i`` is assembled — streaming callers use
+        it to FAIL FAST on invalid data (a NaN in the first chunk of a 100M
+        row stream must raise within seconds, not after the whole dataset is
+        decoded into host RAM). An exception from the callback aborts the
+        stream. Note the ELL width may still grow after a chunk is handed
+        out (``regrow`` ghost-pads flushed chunks in place); ghost padding
+        never changes a chunk's validity."""
         # Streamed chunks are consumed ONE AT A TIME (peak extra memory:
         # one assembly buffer) — materializing the iterator first would
         # double host RAM at exactly the scale this path exists for. The
@@ -176,6 +186,9 @@ class ChunkedGLMData:
             out.labels.append(jnp.asarray(lab.copy()))
             out.offsets.append(jnp.asarray(off.copy()))
             out.weights.append(jnp.asarray(wgt.copy()))
+            if on_chunk is not None:
+                on_chunk(len(out.chunks) - 1, out.chunks[-1],
+                         out.labels[-1], out.offsets[-1], out.weights[-1])
             idx[:] = dim
             val[:] = 0.0
             lab[:] = 0.0

@@ -31,6 +31,21 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPORT: dict = {"phases": {}}
 
+# Data files to delete unless --keep-data. Registered the moment each path
+# is chosen and removed in a ``finally`` at the entry point, so a phase
+# failure (tunnel death mid-solve) cannot leak the multi-GB inputs into
+# --out across rounds.
+_DOOMED: list = []
+
+
+def _cleanup() -> None:
+    while _DOOMED:
+        p = _DOOMED.pop()
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+
 
 def _report_path(out_dir: str) -> str:
     return os.path.join(out_dir, "rehearsal.json")
@@ -209,6 +224,8 @@ def main() -> None:
     shape = {"rows": args.rows, "features": args.features,
              "users": args.users, "unique_rows": args.unique_rows}
     meta_path = data + ".meta.json"
+    if not args.keep_data:
+        _DOOMED.extend([data, meta_path])
     with phase("write_tiled_avro", args.out):
         cached_ok = False
         if os.path.exists(data) and os.path.exists(meta_path):
@@ -261,11 +278,7 @@ def main() -> None:
             entry["rows"] = rows
             entry["rows_per_sec"] = round(rows / took, 1)
             entry["nnz_slots"] = nnz
-        if not args.keep_data:
-            try:
-                os.remove(data)
-            except OSError:
-                pass
+        _cleanup()
         _flush(args.out)
         print(json.dumps(REPORT, indent=1), flush=True)
         return
@@ -319,6 +332,8 @@ def main() -> None:
             gshape = {"rows": game_rows, "features": args.features,
                       "users": args.users, "unique_rows": args.unique_rows}
             gmeta = game_data_path + ".meta.json"
+            if not args.keep_data:
+                _DOOMED.extend([game_data_path, gmeta])
             cached_ok = False
             if os.path.exists(game_data_path) and os.path.exists(gmeta):
                 with open(gmeta) as f:
@@ -357,14 +372,13 @@ def main() -> None:
             game_rows / took, 1
         )
 
-    if not args.keep_data:
-        try:
-            os.remove(data)
-        except OSError:
-            pass
+    _cleanup()
     _flush(args.out)
     print(json.dumps(REPORT, indent=1), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    finally:
+        _cleanup()

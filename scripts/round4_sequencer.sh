@@ -1,0 +1,12 @@
+#!/bin/bash
+# One-shot: wait for the in-flight profile_sparse run to release the tunnel,
+# then hand control to the (patched) autopilot, which runs the fresh
+# full-hardware bench first, skips the already-complete profile, and moves on
+# to the config-5 on-chip rehearsal. Exists because the first autopilot launch
+# of the 07:10Z recovery window skipped the bench (stale banked artifact
+# satisfied its completeness check) and had to be replaced mid-window.
+while pgrep -f 'profile_sparse.py' >/dev/null 2>&1; do
+  sleep 15
+done
+echo "[sequencer] profile_sparse done at $(date -u +%H:%M:%SZ); launching autopilot"
+exec python /root/repo/scripts/tpu_autopilot.py

@@ -270,3 +270,47 @@ def test_glm_driver_out_of_core_validates_chunks(tmp_path):
             "--normalization", "NONE", "--variance", "NONE",
             "--no-report", "--row-chunk-rows", "8",
         ])
+
+
+def test_from_stream_on_chunk_fails_fast():
+    """``on_chunk`` fires as each chunk is assembled, so a validation error
+    in early data aborts the stream without consuming (or decoding) the
+    rest — the fail-fast contract the OOC driver's --data-validation relies
+    on at 100M-row scale."""
+    class _Chunk:
+        def __init__(self, idx, val, dim):
+            n = idx.shape[0]
+            self.features = {"s": SparseFeatures(idx=idx, val=val, dim=dim)}
+            self.labels = np.zeros(n, np.float32)
+            self.offsets = np.zeros(n, np.float32)
+            self.weights = np.ones(n, np.float32)
+            self.n_rows = n
+
+    dim = 16
+    rng = np.random.default_rng(7)
+
+    def mk():
+        return _Chunk(rng.integers(0, dim, (10, 2)).astype(np.int32),
+                      rng.normal(size=(10, 2)).astype(np.float32), dim)
+
+    consumed = []
+
+    def stream():
+        for i in range(10):
+            consumed.append(i)
+            yield mk()
+
+    seen = []
+
+    def on_chunk(i, c, lab, off, wgt):
+        seen.append(i)
+        assert c.idx.shape == (10, 2)
+        if i == 1:
+            raise ValueError("bad chunk")
+
+    with pytest.raises(ValueError, match="bad chunk"):
+        ChunkedGLMData.from_stream(stream(), "s", dim, chunk_rows=10,
+                                   on_chunk=on_chunk)
+    assert seen == [0, 1]
+    # The stream stopped at the failing chunk; the tail was never decoded.
+    assert len(consumed) <= 3
