@@ -32,6 +32,7 @@ from photon_tpu.cli.params import parse_feature_shard
 from photon_tpu.data.normalization import NormalizationType, context_from_statistics
 from photon_tpu.data.statistics import compute_feature_statistics
 from photon_tpu.data.validators import DataValidationType, sanity_check_data
+from photon_tpu.types import REAL_ACCELERATOR_BACKENDS
 from photon_tpu.evaluation import EvaluationSuite
 from photon_tpu.functions.problem import (
     GLMOptimizationProblem,
@@ -391,7 +392,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 os.environ.get("PHOTON_AVRO_EXPANSION_FACTOR", "4")
             )
             est = total * expand
-            on_accel = jax.default_backend() in ("tpu", "axon")
+            on_accel = jax.default_backend() in REAL_ACCELERATOR_BACKENDS
             ooc_rows = (1 << 20) if (
                 on_accel and est > budget_gb * 1e9
             ) else 0
@@ -405,8 +406,8 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 logger.warning(
                     "train data est. %.1f GB decoded exceeds device budget "
                     "%.0f GB but %s=%s requires the in-core path; staying "
-                    "in-core (set %s=%s to enable out-of-core streaming, or "
-                    "--row-chunk-rows N to force)",
+                    "in-core (set %s=%s to enable out-of-core streaming — "
+                    "forcing with --row-chunk-rows N also needs that flag)",
                     est / 1e9, budget_gb, bad[0], bad[2], bad[0], bad[1],
                 )
                 ooc_rows = 0

@@ -7,6 +7,13 @@ from __future__ import annotations
 
 import enum
 
+# jax.default_backend() names that mean "a real accelerator serves this
+# process" (the axon tunnel registers as "axon"). ONE source of truth for
+# every consumer — bench artifact stamping/diversion, the autopilot's
+# completeness gate, and the drivers' device-budget auto-routing — so the
+# allowlist cannot silently diverge between writer and reader.
+REAL_ACCELERATOR_BACKENDS = ("tpu", "axon")
+
 # Type aliases mirroring the reference's Types.scala
 CoordinateId = str
 REId = str          # random-effect entity id (e.g. a userId value)

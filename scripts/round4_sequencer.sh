@@ -5,8 +5,14 @@
 # to the config-5 on-chip rehearsal. Exists because the first autopilot launch
 # of the 07:10Z recovery window skipped the bench (stale banked artifact
 # satisfied its completeness check) and had to be replaced mid-window.
-while pgrep -f 'profile_sparse.py' >/dev/null 2>&1; do
+# Wait for EVERY phase program, not just profile_sparse: phase children are
+# started in their own sessions and survive their autopilot, so exec-ing a
+# replacement while one runs would put two clients on the single-client
+# tunnel — the documented wedge mode.
+while pgrep -f 'profile_sparse.py|/root/repo/bench.py|dress_rehearsal.py' >/dev/null 2>&1; do
   sleep 15
 done
+# Replace, never duplicate.
+pkill -TERM -f 'tpu_autopilot.py' 2>/dev/null && sleep 5
 echo "[sequencer] profile_sparse done at $(date -u +%H:%M:%SZ); launching autopilot"
 exec python /root/repo/scripts/tpu_autopilot.py

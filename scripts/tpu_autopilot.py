@@ -26,6 +26,9 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from photon_tpu.types import REAL_ACCELERATOR_BACKENDS  # noqa: E402
 FLAG = "/tmp/tpu_up.flag"
 LOG = os.path.join(REPO, "AUTOPILOT.jsonl")
 BENCH_DETAILS = os.path.join(REPO, "BENCH_DETAILS.json")
@@ -125,13 +128,47 @@ def run_phase(name: str, argv: list[str], timeout_s: float,
     return rc == 0
 
 
-def bench_complete(attempts: int = 0) -> bool:
-    """Real-hardware BENCH_DETAILS.json, ideally with no skipped stages.
+STATE = f"/tmp/tpu_autopilot_state.{os.getuid()}.json"
 
-    After 2 real-backend attempts a budget-limited artifact (skipped
-    stages) is accepted — a deterministically slow chip must not trap the
-    loop into rerunning an identical bench forever.
+
+def _attempts(key: str) -> int:
+    """Attempt counts persist ACROSS autopilot restarts (rotation restarts
+    and sequencer replacements are routine) — process-local counters would
+    reset and re-burn recovery windows on work already tried."""
+    try:
+        with open(STATE) as f:
+            return int(json.load(f).get(key, 0))
+    except (OSError, ValueError):
+        return 0
+
+
+def _bump_attempts(key: str) -> int:
+    try:
+        with open(STATE) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        d = {}
+    d[key] = int(d.get(key, 0)) + 1
+    tmp = STATE + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(d, f)
+    os.replace(tmp, STATE)
+    return d[key]
+
+
+def bench_complete(attempts: int = 0) -> bool:
+    """Real-hardware BENCH_DETAILS.json that ran to completion.
+
+    Attempt policy (the tunnel has twice died inside the fast path's heavy
+    one-hot MXU remote compile): attempt 2 reruns with
+    PHOTON_BENCH_SKIP_FAST=1 so a compile-killing tunnel still yields a
+    COMPLETE gather-path bench; after 3 attempts whatever partial artifact
+    exists is accepted so the loop cannot rerun an identical bench forever.
     """
+    if attempts >= 3:
+        # Give up unconditionally — even a stale artifact must not trap the
+        # loop into burning every remaining recovery window on the bench.
+        return True
     try:
         with open(BENCH_DETAILS) as f:
             d = json.load(f)
@@ -139,12 +176,12 @@ def bench_complete(attempts: int = 0) -> bool:
         return False
     if "backend_fallback_reason" in d:
         return False
-    if d.get("backend") not in ("tpu", "axon"):
+    if d.get("backend") not in REAL_ACCELERATOR_BACKENDS:
         # Banked artifacts from before bench.py stamped the real backend
         # name (early r3) must not satisfy the round's #1 deliverable — the
         # bench has to re-run on chip so the numbers cover current code.
         return False
-    return not d.get("skipped_stages") or attempts >= 2
+    return bool(d.get("completed")) and not d.get("skipped_stages")
 
 
 def rehearsal_complete() -> bool:
@@ -183,9 +220,9 @@ def profile_complete() -> bool:
 
 
 def main() -> None:
-    log({"phase": "autopilot", "event": "watching"})
-    bench_attempts = 0
-    rehearsal_attempts = 0
+    log({"phase": "autopilot", "event": "watching",
+         "bench_attempts": _attempts("bench"),
+         "rehearsal_attempts": _attempts("rehearsal")})
     ensure_daemon()  # without a rotating claimant the flag never appears
     while True:
         while not os.path.exists(FLAG):
@@ -199,13 +236,18 @@ def main() -> None:
             pass
         log({"phase": "autopilot", "event": "chip-up, starting sequence"})
 
-        if not bench_complete(bench_attempts):
-            bench_attempts += 1
+        if not bench_complete(_attempts("bench")):
+            n = _bump_attempts("bench")
+            env = {"PHOTON_BENCH_FORCE_PROBE": "1",
+                   "PHOTON_BENCH_BUDGET": "2400"}
+            if n >= 2:
+                # The risky paths (one-hot MXU fast compile, Pallas) killed a
+                # previous attempt's window; a complete gather-path bench
+                # beats another crash-partial artifact.
+                env["PHOTON_BENCH_SKIP_FAST"] = "1"
             run_phase("bench", [sys.executable,
                                 os.path.join(REPO, "bench.py")],
-                      timeout_s=5400,
-                      extra_env={"PHOTON_BENCH_FORCE_PROBE": "1",
-                                 "PHOTON_BENCH_BUDGET": "2400"})
+                      timeout_s=5400, extra_env=env)
         if not profile_complete():
             # worst healthy case: 11 variants x (jax init + tunnel compile)
             run_phase("profile_sparse",
@@ -213,19 +255,19 @@ def main() -> None:
                        os.path.join(REPO, "scripts", "profile_sparse.py")],
                       timeout_s=8400)
 
-        if bench_complete(bench_attempts) and profile_complete():
-            if not rehearsal_complete() and rehearsal_attempts < 2:
+        if bench_complete(_attempts("bench")) and profile_complete():
+            if not rehearsal_complete() and _attempts("rehearsal") < 2:
                 # Config-5 dress rehearsal, full shape, on chip. Long host
                 # phases (31 GB tiled write, 100M-row streaming) print only
                 # per-phase banners, so the stall threshold is generous.
-                rehearsal_attempts += 1
+                _bump_attempts("rehearsal")
                 run_phase(
                     "rehearsal",
                     [sys.executable,
                      os.path.join(REPO, "scripts", "dress_rehearsal.py"),
                      "--tpu", "--keep-data"],
                     timeout_s=14400, stall_s=3600)
-            if rehearsal_complete() or rehearsal_attempts >= 2:
+            if rehearsal_complete() or _attempts("rehearsal") >= 2:
                 log({"phase": "autopilot", "event": "sequence complete",
                      "rehearsal_ok": rehearsal_complete()})
                 return
