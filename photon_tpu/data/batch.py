@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 
 from photon_tpu.ops import pass_counter
+from photon_tpu.types import REAL_ACCELERATOR_BACKENDS
 
 Array = jax.Array
 
@@ -162,7 +163,17 @@ class SparseFeatures:
 
         import jax
 
-        if jax.default_backend() not in ("tpu", "axon"):
+        if jax.default_backend() not in REAL_ACCELERATOR_BACKENDS:
+            return self
+        if os.environ.get("PHOTON_DISABLE_ACCEL_PATHS") == "1":
+            # Operator kill switch: the fast path's one-hot MXU program is
+            # a heavy compile, and on a degraded tunnel heavy remote
+            # compiles have wedged the device grant (2026-07-31, 2-for-2).
+            # Disables every AUTO-attach (drivers/estimators route through
+            # here); code that calls with_fast_path()/with_pallas_path()
+            # explicitly — e.g. bench.py's sparse race — honors the same
+            # variable at its own call site, keeping explicit requests
+            # explicit.
             return self
         # HBM guard: the layouts cost ~20 bytes/entry on device on top of
         # the 8 bytes/entry ELL data. At config-5 scale (1.3e9 entries)
@@ -244,7 +255,8 @@ class SparseFeatures:
             return None
         if os.environ.get("PHOTON_PALLAS_INTERPRET") == "1":
             return True
-        return False if jax.default_backend() in ("tpu", "axon") else None
+        return (False if jax.default_backend() in REAL_ACCELERATOR_BACKENDS
+                else None)
 
     def _use_pallas(self, dtype) -> bool:
         return self._pallas_mode(dtype) is not None

@@ -49,12 +49,26 @@ def _load() -> dict:
         with open(OUT) as f:
             return json.load(f)
     except (OSError, ValueError):
-        return {"n": N, "dim": D, "k": K}
+        # Fresh state starts with the fast family's REMOTE compile already
+        # at the skip threshold: its one-hot MXU program is the documented
+        # 2-for-2 tunnel killer (2026-07-31T03:47Z and 07:10Z windows), and
+        # a fresh /tmp must not re-earn that knowledge by wedging two more
+        # windows. Local-compile attempts start unpenalized.
+        return {"n": N, "dim": D, "k": K,
+                "_hangs": {"fast": 2},
+                "_hangs_note": ("fast=2 pre-seeded from the two observed "
+                                "matvec_fast remote-compile wedges "
+                                "(2026-07-31T03:47Z, 07:10Z)")}
 
 
 def _save(results: dict) -> None:
-    with open(OUT, "w") as f:
+    # Atomic replace: OUT is the persistent safety ledger (_hangs counters
+    # plus every banked measurement) and the runner dies by SIGTERM mid-run
+    # as a matter of protocol — a truncated write must never reset it.
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(results, f, indent=1)
+    os.replace(tmp, OUT)
 
 
 # ----------------------------------------------------------------- variants
@@ -237,31 +251,36 @@ def _finalize(results: dict) -> None:
 
 
 def runner() -> int:
-    results = _load()
     for key in VARIANTS:
+        # Re-load EVERY iteration, before the cached check: a child (e.g.
+        # the pallas aux builder) may have resolved sibling keys in OUT,
+        # and a stale in-memory dict would re-run work a scarce recovery
+        # window already paid for.
+        results = _load()
         if key in results or f"{key}_error" in results:
             print(f"[runner] {key}: cached ({results.get(key, 'error')})",
                   flush=True)
             continue
         fam = _family(key)
-        results = _load()
         hangs = results.get("_hangs", {})
-        hang_n = hangs.get(fam, 0)
+        # Local and remote hangs are charged SEPARATELY: a >deadline local
+        # 1-core XLA compile is slow, not a tunnel wedge, and must never
+        # ban the (healthy ~20-40s when the tunnel lives) remote path.
+        remote_hangs = hangs.get(fam, 0)
+        local_hangs = hangs.get(f"{fam}_local", 0)
         # Heavy-compile families try LOCAL compile first
         # (PALLAS_AXON_REMOTE_COMPILE=0): the observed wedges happen inside
         # the tunnel's remote-compile POST, and a locally-compiled binary
         # runs at identical speed on the same chip. Fast local failure
-        # (unsupported) falls back to the remote compile attempt. Hang
-        # budget: remote attempts stop after HANG_SKIP_AFTER family hangs,
-        # local attempts after twice that.
+        # (unsupported) falls back to the remote compile attempt.
         if fam in ("fast", "pallas"):
             attempts = []
-            if hang_n < 2 * HANG_SKIP_AFTER:
+            if local_hangs < HANG_SKIP_AFTER:
                 attempts.append((
                     {"PALLAS_AXON_REMOTE_COMPILE": "0"},
                     LOCAL_COMPILE_DEADLINE_S,
                 ))
-            if hang_n < HANG_SKIP_AFTER:
+            if remote_hangs < HANG_SKIP_AFTER:
                 # Explicit "1": the sitecustomize checks the literal value,
                 # and inheriting an unset var would silently make this a
                 # duplicate local-compile run charged to the wrong mode.
@@ -269,17 +288,18 @@ def runner() -> int:
                     {"PALLAS_AXON_REMOTE_COMPILE": "1"}, VARIANT_DEADLINE_S
                 ))
         else:
-            attempts = [] if hang_n >= HANG_SKIP_AFTER else [
+            attempts = [] if remote_hangs >= HANG_SKIP_AFTER else [
                 (None, VARIANT_DEADLINE_S)
             ]
         if not attempts:
             results[f"{key}_error"] = (
                 f"compile family '{fam}' hung the tunnel in "
-                f"{hang_n} recovery windows; skipped"
+                f"{remote_hangs} remote + {local_hangs} local-compile "
+                "windows; skipped"
             )
             _save(results)
             print(f"[runner] {key}: skipped ({fam} family hung "
-                  f"{hang_n}x)", flush=True)
+                  f"{remote_hangs}r/{local_hangs}l)", flush=True)
             continue
         for ai, (extra_env, deadline) in enumerate(attempts):
             local = bool(extra_env) and extra_env.get(
@@ -307,10 +327,11 @@ def runner() -> int:
                     pass
                 results = _load()
                 h = results.setdefault("_hangs", {})
-                h[fam] = h.get(fam, 0) + 1
+                hk = f"{fam}_local" if local else fam
+                h[hk] = h.get(hk, 0) + 1
                 _save(results)
                 print(f"[runner] {key}: HUNG > {deadline:.0f}s ({mode}; "
-                      f"family '{fam}' hang #{h[fam]}) — aborting (grant "
+                      f"'{hk}' hang #{h[hk]}) — aborting (grant "
                       "likely wedged; resume next window)", flush=True)
                 _finalize(_load())
                 return 1

@@ -1,18 +1,31 @@
 #!/bin/bash
-# One-shot: wait for the in-flight profile_sparse run to release the tunnel,
-# then hand control to the (patched) autopilot, which runs the fresh
-# full-hardware bench first, skips the already-complete profile, and moves on
-# to the config-5 on-chip rehearsal. Exists because the first autopilot launch
-# of the 07:10Z recovery window skipped the bench (stale banked artifact
-# satisfied its completeness check) and had to be replaced mid-window.
-# Wait for EVERY phase program, not just profile_sparse: phase children are
-# started in their own sessions and survive their autopilot, so exec-ing a
-# replacement while one runs would put two clients on the single-client
-# tunnel — the documented wedge mode.
-while pgrep -f 'profile_sparse.py|/root/repo/bench.py|dress_rehearsal.py' >/dev/null 2>&1; do
-  sleep 15
-done
-# Replace, never duplicate.
+# One-shot: replace any running autopilot with a freshly-coded one without
+# ever putting two clients on the single-client tunnel. Exists because the
+# first autopilot launch of the 07:10Z recovery window skipped the bench (a
+# stale banked artifact satisfied its completeness check) and had to be
+# replaced mid-window.
+#
+# Order matters: kill the autopilot FIRST so it cannot spawn a new phase
+# child after our drain check, THEN drain phase children (they live in
+# their own sessions and survive the parent). Because killing the autopilot
+# also removes its stall/timeout supervision, the drain is BOUNDED: after
+# DRAIN_DEADLINE_S any lingering phase child gets SIGTERM + grace (never
+# SIGKILL — wedge protocol), mirroring the autopilot's own policy.
+PHASES='profile_sparse.py|/root/repo/bench.py|dress_rehearsal.py'
+DRAIN_DEADLINE_S=${DRAIN_DEADLINE_S:-1200}
+
 pkill -TERM -f 'tpu_autopilot.py' 2>/dev/null && sleep 5
-echo "[sequencer] profile_sparse done at $(date -u +%H:%M:%SZ); launching autopilot"
+
+waited=0
+while pgrep -f "$PHASES" >/dev/null 2>&1; do
+  if [ "$waited" -ge "$DRAIN_DEADLINE_S" ]; then
+    echo "[sequencer] phase children still alive after ${waited}s; SIGTERM"
+    pkill -TERM -f "$PHASES" 2>/dev/null
+    sleep 60
+    break
+  fi
+  sleep 15
+  waited=$((waited + 15))
+done
+echo "[sequencer] drained at $(date -u +%H:%M:%SZ); launching autopilot"
 exec python /root/repo/scripts/tpu_autopilot.py

@@ -131,23 +131,42 @@ def run_phase(name: str, argv: list[str], timeout_s: float,
 STATE = f"/tmp/tpu_autopilot_state.{os.getuid()}.json"
 
 
-def _attempts(key: str) -> int:
+def _git_head() -> str:
+    try:
+        return subprocess.run(
+            ["git", "-C", REPO, "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except (OSError, subprocess.TimeoutExpired):
+        return "unknown"
+
+
+def _read_state() -> dict:
     """Attempt counts persist ACROSS autopilot restarts (rotation restarts
     and sequencer replacements are routine) — process-local counters would
-    reset and re-burn recovery windows on work already tried."""
-    try:
-        with open(STATE) as f:
-            return int(json.load(f).get(key, 0))
-    except (OSError, ValueError):
-        return 0
-
-
-def _bump_attempts(key: str) -> int:
+    reset and re-burn recovery windows on work already tried. Counts are
+    keyed to the repo HEAD: new code resets them, so a give-up from an old
+    build can never permanently skip the bench for builds that came after."""
     try:
         with open(STATE) as f:
             d = json.load(f)
     except (OSError, ValueError):
         d = {}
+    cur = _git_head()
+    if cur != "unknown" and d.get("head") != cur:
+        # New code resets the counters. A TRANSIENT git failure ("unknown")
+        # must NOT — wiping earned counts would re-arm the risky fast-path
+        # race the counters exist to suppress.
+        d = {"head": cur}
+    return d
+
+
+def _attempts(key: str) -> int:
+    return int(_read_state().get(key, 0))
+
+
+def _bump_attempts(key: str) -> int:
+    d = _read_state()
     d[key] = int(d.get(key, 0)) + 1
     tmp = STATE + ".tmp"
     with open(tmp, "w") as f:
@@ -243,8 +262,12 @@ def main() -> None:
             if n >= 2:
                 # The risky paths (one-hot MXU fast compile, Pallas) killed a
                 # previous attempt's window; a complete gather-path bench
-                # beats another crash-partial artifact.
+                # beats another crash-partial artifact. DISABLE_ACCEL_PATHS
+                # also keeps the GAME/game_scale stages' auto-attached MXU
+                # layouts off — any heavy compile can kill the window, not
+                # just the headline race.
                 env["PHOTON_BENCH_SKIP_FAST"] = "1"
+                env["PHOTON_DISABLE_ACCEL_PATHS"] = "1"
             run_phase("bench", [sys.executable,
                                 os.path.join(REPO, "bench.py")],
                       timeout_s=5400, extra_env=env)
