@@ -506,10 +506,17 @@ def bench_fixed_effect_lbfgs():
     dt, result = solve(base)
     timings["xla_gather_seconds"] = round(dt, 3)
     state = {"best": (dt, result), "path": "xla_gather"}
+    del base  # free ~128 MB of device memory before the middle stages run
 
     def race(on_better):
         """Fast + Pallas solves; calls ``on_better(head)`` after each path
-        so a tunnel death mid-race still leaves the faster-so-far banked."""
+        so a tunnel death mid-race still leaves the faster-so-far banked.
+        Device arrays are (re)built HERE from the host arrays, not captured:
+        the closure outlives every intermediate stage (game_scale is sized
+        to device-feasible capacity), so holding the ~128 MB base arrays
+        across them risks OOM and skewed stage measurements."""
+        base = SparseFeatures(idx=jnp.asarray(idx), val=jnp.asarray(val),
+                              dim=DIM)
         dtf, resf = solve(base.with_fast_path())
         timings["xla_fast_seconds"] = round(dtf, 3)
         if dtf < state["best"][0]:

@@ -631,8 +631,16 @@ class NativeDecoder:
             )
         return r
 
-    def take_chunk(self) -> dict:
-        """Snapshot current buffers as numpy arrays and reset row state."""
+    def take_chunk(self, ell: Optional[dict] = None,
+                   ell_dtype=np.float32) -> dict:
+        """Snapshot current buffers as numpy arrays and reset row state.
+
+        ``ell`` maps shard name -> ``(dim, intercept_index_or_None)``: those
+        shards come back as ASSEMBLED ELL arrays (``"ell"`` key, built by
+        one native pass that writes entries and ghost padding directly —
+        no triples copy, no bincount, no fill pass). Shards not in ``ell``
+        come back as triples, as before.
+        """
         lib, st = self.lib, self.state
         n = lib.ph_chunk_rows(st)
         p = self.program
@@ -648,8 +656,30 @@ class NativeDecoder:
             if n:
                 lib.ph_get_str_codes(st, c, _np_ptr(a, ctypes.c_int32))
             codes[name] = a
+        if ell is not None:
+            dt = np.dtype(ell_dtype)
+            fill = (lib.ph_shard_ell_f32 if dt == np.float32
+                    else lib.ph_shard_ell_f64 if dt == np.float64 else None)
+            if fill is None:
+                ell = None  # exotic dtype: triples fallback below
         triples = {}
+        ells = {}
         for si, shard in enumerate(p.shard_order):
+            if ell is not None and shard in ell:
+                dim, icol = ell[shard]
+                base = 1 if (icol is not None and icol >= 0) else 0
+                k = max(int(lib.ph_shard_max_run(st, si)) + base, 1)
+                iarr = np.empty((n, k), np.int32)
+                varr = np.empty((n, k), dt)
+                out_ct = (ctypes.c_float if dt == np.float32
+                          else ctypes.c_double)
+                if n:
+                    fill(st, si, n, k,
+                         icol if base else -1, dim,
+                         _np_ptr(iarr, ctypes.c_int32),
+                         _np_ptr(varr, out_ct))
+                ells[shard] = SparseFeatures(idx=iarr, val=varr, dim=dim)
+                continue
             m = lib.ph_shard_nnz(st, si)
             rows = np.empty(m, np.int32)
             idx = np.empty(m, np.int32)
@@ -661,7 +691,8 @@ class NativeDecoder:
                 )
             triples[shard] = (rows, idx, val)
         lib.ph_reset_chunk(st)
-        return {"n": n, "num": num, "codes": codes, "triples": triples}
+        return {"n": n, "num": num, "codes": codes, "triples": triples,
+                "ell": ells}
 
     def dictionaries(self) -> dict:
         """Current per-column unique-string arrays. Dictionaries only grow,
@@ -901,7 +932,14 @@ class StreamingAvroReader:
             yield self._finish_chunk(dec, dtype, require_labels)
 
     def _finish_chunk(self, dec: NativeDecoder, dtype, require_labels) -> GameDataChunk:
-        raw = dec.take_chunk()
+        raw = dec.take_chunk(
+            ell={
+                shard: (len(self.index_maps[shard]),
+                        self._intercepts.get(shard))
+                for shard in dec.program.shard_order
+            },
+            ell_dtype=dtype,
+        )
         p = dec.program
         n = raw["n"]
         labels = raw["num"][p.num_names[0]]
@@ -930,6 +968,9 @@ class StreamingAvroReader:
             tag_cols[t] = DictColumn(codes, resolver(t))
         features = {}
         for shard in p.shard_order:
+            if shard in raw["ell"]:  # native direct assembly
+                features[shard] = raw["ell"][shard]
+                continue
             rows, idx, val = raw["triples"][shard]
             features[shard] = ell_from_triples(
                 rows, idx, val, n, dim=len(self.index_maps[shard]),

@@ -104,6 +104,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         P(i32), P(i32), P(f64), i64, i64, i64, P(i32), P(f64)
     ]
     lib.ph_ell_scatter_f64.restype = None
+    lib.ph_shard_max_run.argtypes = [ctypes.c_void_p, i32]
+    lib.ph_shard_max_run.restype = i64
+    lib.ph_shard_ell_f32.argtypes = [
+        ctypes.c_void_p, i32, i64, i64, i64, i64, P(i32), P(f32)
+    ]
+    lib.ph_shard_ell_f32.restype = None
+    lib.ph_shard_ell_f64.argtypes = [
+        ctypes.c_void_p, i32, i64, i64, i64, i64, P(i32), P(f64)
+    ]
+    lib.ph_shard_ell_f64.restype = None
     return lib
 
 

@@ -22,6 +22,14 @@ while pgrep -f "$PHASES" >/dev/null 2>&1; do
     echo "[sequencer] phase children still alive after ${waited}s; SIGTERM"
     pkill -TERM -f "$PHASES" 2>/dev/null
     sleep 60
+    if pgrep -f "$PHASES" >/dev/null 2>&1; then
+      # A child stuck past SIGTERM (blocked in a C extension, e.g. the
+      # remote-compile POST) still owns the tunnel; launching a second
+      # client alongside it is the documented wedge mode. Abort and let
+      # the operator (or the next scheduled run) retry.
+      echo "[sequencer] child survived SIGTERM + grace; ABORTING (no second client)"
+      exit 1
+    fi
     break
   fi
   sleep 15
