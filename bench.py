@@ -434,7 +434,7 @@ def _pallas_kernels_work() -> bool:
         return False
 
 
-def bench_fixed_effect_lbfgs():
+def bench_fixed_effect_lbfgs(resume_head=None):
     import jax
     import jax.numpy as jnp
 
@@ -472,15 +472,15 @@ def bench_fixed_effect_lbfgs():
         model, result = run(batch, w0)
         np.asarray(model.coefficients.means)
         np.asarray(result.value)
-        return time.perf_counter() - t0, result
-
-    def head(dt, result, path, timings):
-        iters = int(result.iterations)
+        dt = time.perf_counter() - t0
         # data_passes is the optimizer's on-device instrumented counter (see
         # OptimizerResult.data_passes) — measured, not derived from a
         # formula; tests/test_optimizers.py cross-checks it against a
-        # host-callback counter at the feature-op level on CPU.
-        passes = int(result.data_passes)
+        # host-callback counter at the feature-op level on CPU. Plain ints
+        # so resumed runs can reconstruct state from the JSON artifact.
+        return dt, int(result.iterations), int(result.data_passes)
+
+    def head(dt, iters, passes, path, timings):
         return {
             "seconds": dt,
             "iterations": iters,
@@ -501,12 +501,26 @@ def bench_fixed_effect_lbfgs():
     # place, not win by compiling. PHOTON_BENCH_SKIP_FAST=1 skips the race
     # entirely (operator escape hatch for a tunnel that dies on big
     # compiles).
-    base = SparseFeatures(idx=jnp.asarray(idx), val=jnp.asarray(val), dim=DIM)
     timings = {}
-    dt, result = solve(base)
-    timings["xla_gather_seconds"] = round(dt, 3)
-    state = {"best": (dt, result), "path": "xla_gather"}
-    del base  # free ~128 MB of device memory before the middle stages run
+    if resume_head is not None:
+        # Banked gather solve from a dead window: reconstruct the race
+        # state from the artifact ints instead of re-solving.
+        state = {
+            "best": (resume_head["seconds"], resume_head["iterations"],
+                     resume_head["data_passes"]),
+            "path": resume_head["sparse_path"],
+        }
+        timings.update({
+            k: v for k, v in resume_head.items() if k.endswith("_seconds")
+        })
+    else:
+        base = SparseFeatures(
+            idx=jnp.asarray(idx), val=jnp.asarray(val), dim=DIM
+        )
+        dt, iters, passes = solve(base)
+        timings["xla_gather_seconds"] = round(dt, 3)
+        state = {"best": (dt, iters, passes), "path": "xla_gather"}
+        del base  # free ~128 MB of device memory before the middle stages
 
     def race(on_better):
         """Fast + Pallas solves; calls ``on_better(head)`` after each path
@@ -517,22 +531,23 @@ def bench_fixed_effect_lbfgs():
         across them risks OOM and skewed stage measurements."""
         base = SparseFeatures(idx=jnp.asarray(idx), val=jnp.asarray(val),
                               dim=DIM)
-        dtf, resf = solve(base.with_fast_path())
-        timings["xla_fast_seconds"] = round(dtf, 3)
-        if dtf < state["best"][0]:
-            state["best"], state["path"] = (dtf, resf), "xla_fast"
-        on_better(head(*state["best"], state["path"], timings))
-        if _pallas_kernels_work():
+        if "xla_fast_seconds" not in timings:
+            dtf, itf, paf = solve(base.with_fast_path())
+            timings["xla_fast_seconds"] = round(dtf, 3)
+            if dtf < state["best"][0]:
+                state["best"], state["path"] = (dtf, itf, paf), "xla_fast"
+            on_better(head(*state["best"], state["path"], timings))
+        if _pallas_kernels_work() and "pallas_seconds" not in timings:
             sf = base.with_pallas_path()
             if sf.pallas is not None:  # attach can no-op over table budget
-                dtp, resp = solve(sf)
+                dtp, itp, pap = solve(sf)
                 timings["pallas_seconds"] = round(dtp, 3)
                 if dtp < state["best"][0]:
-                    state["best"], state["path"] = (dtp, resp), "pallas"
+                    state["best"], state["path"] = (dtp, itp, pap), "pallas"
                 on_better(head(*state["best"], state["path"], timings))
 
     return (
-        head(dt, result, state["path"], timings),
+        head(*state["best"], state["path"], timings),
         (idx, val, labels),
         race,
     )
@@ -1005,6 +1020,56 @@ def bench_ingest():
     return out
 
 
+_GIT_HEAD = None
+
+
+def _git_head() -> str:
+    global _GIT_HEAD
+    if _GIT_HEAD is None:
+        import subprocess
+
+        try:
+            _GIT_HEAD = subprocess.run(
+                ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+                 "rev-parse", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or "unknown"
+        except Exception:  # noqa: BLE001
+            _GIT_HEAD = "unknown"
+    return _GIT_HEAD
+
+
+def _load_resume(path: str) -> dict:
+    """Prior real-hardware artifact to RESUME from, else {}.
+
+    The flaky tunnel's recovery windows (2026-07-31: ~4 and ~10 minutes)
+    are shorter than a full bench, so stages bank incrementally and a rerun
+    picks up where the dead window left off — same code (git head) and a
+    real-backend stamp required, PHOTON_BENCH_NO_RESUME=1 forces fresh.
+    """
+    if os.environ.get("PHOTON_BENCH_NO_RESUME") == "1":
+        return {}
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if d.get("backend") not in REAL_ACCELERATOR_BACKENDS:
+        return {}
+    if d.get("git_head") != _git_head() or _git_head() == "unknown":
+        return {}
+    # Budget-skipped stages rerun this invocation; completed re-stamps at
+    # the end if everything is still banked. Stale per-stage errors clear
+    # (a rerun that succeeds must not carry last window's failure note),
+    # and the SKIP_FAST marker is an operator toggle, not a banked
+    # measurement — only the CURRENT env decides whether the race runs.
+    d.pop("skipped_stages", None)
+    d.pop("completed", None)
+    d.pop("stage_errors", None)
+    d.pop("sparse_race_skipped", None)
+    return d
+
+
 def main():
     import sys
 
@@ -1090,6 +1155,25 @@ def main():
         else "BENCH_DETAILS.cpu-fallback.json" if BACKEND_FALLBACK is not None
         else "BENCH_DETAILS.json"
     )
+
+    # Real-backend runs RESUME banked same-code stages (see _load_resume):
+    # windows die in minutes, a fresh run per window would never finish.
+    if not SMOKE and BACKEND_FALLBACK is None:
+        resumed = _load_resume(os.path.join(here, details_name))
+        if resumed:
+            details.update(resumed)
+            details["resumed_from_written_at"] = resumed.get(
+                "written_at", "unknown")
+            stage_seconds.update({
+                k: float(v)
+                for k, v in resumed.get("stage_seconds", {}).items()
+            })
+            print(
+                "bench: resuming banked real-hardware stages "
+                f"({sorted(k for k in resumed if not k.startswith('_'))[:8]}"
+                " ...)",
+                file=sys.stderr, flush=True,
+            )
     details_path = os.path.join(here, details_name)
 
     def flush():
@@ -1116,6 +1200,7 @@ def main():
         details["written_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         )
+        details["git_head"] = _git_head()  # resume requires same-code match
         details["stage_seconds"] = {k: round(v, 1) for k, v in stage_seconds.items()}
         with open(target, "w") as f:
             json.dump(details, f, indent=2)
@@ -1161,19 +1246,37 @@ def main():
         _refresh_derived()
         flush()
 
-    head, (idx, val, labels), sparse_race = bench_fixed_effect_lbfgs()
-    stage_seconds["fixed_effect_lbfgs"] = time.perf_counter() - t0
+    # Resume seeds for the derived-metric raw inputs (rounded values from
+    # the artifact; ≤0.1% drift vs the originals).
+    if "baseline_model" in details:
+        bm = details["baseline_model"]
+        raw["np_percore"] = bm["numpy_percore_samples_per_sec"]
+        raw["modeled_cluster"] = bm["modeled_cluster_samples_per_sec"]
+    if "roofline" in details:
+        raw["hbm_gbps"] = details["roofline"]["measured_hbm_gbps"]
+        raw["bytes_per_pass"] = details["roofline"]["bytes_per_pass"]
+
+    resume_head = details.get("fixed_effect_lbfgs")
+    head, (idx, val, labels), sparse_race = bench_fixed_effect_lbfgs(
+        resume_head
+    )
+    if resume_head is None:
+        stage_seconds["fixed_effect_lbfgs"] = time.perf_counter() - t0
     _bank_fixed_effect(dict(head))
 
-    t0 = time.perf_counter()
-    np_dt, nproc = numpy_multicore_pass_time(idx, val, labels)
-    stage_seconds["numpy_baseline"] = time.perf_counter() - t0
-    np_samples_per_sec = N_ROWS / np_dt
-    details["numpy_multicore_baseline"] = {
-        "processes": nproc,
-        "pass_seconds": round(np_dt, 3),
-        "samples_per_sec": round(np_samples_per_sec, 1),
-    }
+    if "numpy_multicore_baseline" in details:
+        np_samples_per_sec = details[
+            "numpy_multicore_baseline"]["samples_per_sec"]
+    else:
+        t0 = time.perf_counter()
+        np_dt, nproc = numpy_multicore_pass_time(idx, val, labels)
+        stage_seconds["numpy_baseline"] = time.perf_counter() - t0
+        np_samples_per_sec = N_ROWS / np_dt
+        details["numpy_multicore_baseline"] = {
+            "processes": nproc,
+            "pass_seconds": round(np_dt, 3),
+            "samples_per_sec": round(np_samples_per_sec, 1),
+        }
     # North-star baseline model (VERDICT round-3 ask #4; arithmetic and
     # assumption provenance in BASELINE.md §"Baseline model"): the reference
     # publishes no numbers, so the Spark-cluster comparison point is MODELED
@@ -1182,21 +1285,23 @@ def main():
     # ``vs_baseline`` (headline) stays measured-vs-measured against the
     # local multi-process NumPy run; ``vs_modeled_spark_cluster`` is the
     # north-star ratio against the modeled 64-core cluster.
-    raw["np_percore"] = np_samples_per_sec / max(nproc, 1)
-    raw["modeled_cluster"] = (
-        raw["np_percore"]
-        * SPARK_MODEL_CORES
-        * SPARK_MODEL_SCALING_EFF
-        * SPARK_MODEL_PERCORE_FACTOR
-    )
-    details["baseline_model"] = {
-        "numpy_percore_samples_per_sec": round(raw["np_percore"], 1),
-        "modeled_cluster_cores": SPARK_MODEL_CORES,
-        "modeled_scaling_efficiency": SPARK_MODEL_SCALING_EFF,
-        "modeled_spark_percore_factor": SPARK_MODEL_PERCORE_FACTOR,
-        "modeled_cluster_samples_per_sec": round(raw["modeled_cluster"], 1),
-        "note": "model + arithmetic documented in BASELINE.md",
-    }
+    if "baseline_model" not in details:  # resume reuses the banked model
+        raw["np_percore"] = np_samples_per_sec / max(nproc, 1)
+        raw["modeled_cluster"] = (
+            raw["np_percore"]
+            * SPARK_MODEL_CORES
+            * SPARK_MODEL_SCALING_EFF
+            * SPARK_MODEL_PERCORE_FACTOR
+        )
+        details["baseline_model"] = {
+            "numpy_percore_samples_per_sec": round(raw["np_percore"], 1),
+            "modeled_cluster_cores": SPARK_MODEL_CORES,
+            "modeled_scaling_efficiency": SPARK_MODEL_SCALING_EFF,
+            "modeled_spark_percore_factor": SPARK_MODEL_PERCORE_FACTOR,
+            "modeled_cluster_samples_per_sec": round(
+                raw["modeled_cluster"], 1),
+            "note": "model + arithmetic documented in BASELINE.md",
+        }
     _refresh_derived()
     flush()
 
@@ -1226,8 +1331,24 @@ def main():
                    "PHOTON_BENCH_SKIP_FAST / PHOTON_DISABLE_ACCEL_PATHS"})
          if (os.environ.get("PHOTON_BENCH_SKIP_FAST") == "1"
              or os.environ.get("PHOTON_DISABLE_ACCEL_PATHS") == "1")
-         else lambda: (sparse_race(_bank_fixed_effect), {})[1]),
+         else lambda: (sparse_race(_bank_fixed_effect),
+                       {"sparse_race_done": True})[1]),
     ):
+        done_key = {
+            "roofline": "roofline",
+            "owlqn_tron": "owlqn_linear_l1_samples_per_sec",
+            "game": "game_samples_per_sec",
+            "ingest": "ingest_rows_per_sec",
+            "game_scale": "game_scale_total_seconds",
+            "tuner": "tuner_trials",
+            "sparse_race": "sparse_race_done",
+        }[name]
+        if details.get(done_key) is not None or (
+                name == "sparse_race" and "sparse_race_skipped" in details):
+            # Banked by a previous window's run (resume). ``is not None``:
+            # a null sentinel (e.g. ingest with no native lib) is a recorded
+            # absence, not a measurement — re-try it.
+            continue
         if time.perf_counter() - t_start > budget:
             details.setdefault("skipped_stages", []).append(name)
             print(f"bench: budget exhausted, skipping {name}",

@@ -216,6 +216,7 @@ struct ShardOut {
   std::vector<uint64_t> pend_h;
   std::vector<int32_t> pend_row;
   std::vector<double> pend_val;
+  size_t pend_mark = 0;  // pend size at current record start (error rollback)
   // Index-build ("collect") mode: no table; every decoded feature key
   // (name\x01term) interns here in first-seen order, no triples emitted.
   bool collect = false;
@@ -704,8 +705,19 @@ int64_t ph_decode_block(void* p, const uint8_t* payload, int64_t size, int64_t c
   State& st = *(State*)p;
   Reader r{payload, size};
   for (int64_t i = 0; i < count; i++) {
+    for (ShardOut& sh : st.shards) sh.pend_mark = sh.pend_h.size();
     if (!decode_record(st, r)) {
-      flush_pending(st);  // completed rows' features stay valid on error
+      // Roll the failed record's partially-queued features back BEFORE the
+      // flush: they carry row id == n_rows, which is never incremented for
+      // the failed record — emitting them would alias the next decoded
+      // record's row (and can index past a caller's (n, k) ELL arrays).
+      // Completed rows' features stay valid.
+      for (ShardOut& sh : st.shards) {
+        sh.pend_h.resize(sh.pend_mark);
+        sh.pend_row.resize(sh.pend_mark);
+        sh.pend_val.resize(sh.pend_mark);
+      }
+      flush_pending(st);
       return r.err ? r.err : E_TRUNCATED;
     }
   }

@@ -197,3 +197,36 @@ def test_wait_claim_lock_bounded(bench):
     assert 0.25 <= time.perf_counter() - t0 < 3.0
     holder.close()  # releases the flock
     assert bench._wait_claim_lock(0.3, poll_s=0.1) is True
+
+
+def test_load_resume_same_code_real_backend(bench, tmp_path, monkeypatch):
+    """Resume accepts only a same-git-head real-backend artifact, strips the
+    completion markers, and honors PHOTON_BENCH_NO_RESUME (the flaky tunnel's
+    windows are shorter than a full bench, so stages must bank across runs)."""
+    art = tmp_path / "BENCH_DETAILS.json"
+    monkeypatch.setattr(bench, "_GIT_HEAD", "abc123")
+    good = {
+        "backend": "axon", "git_head": "abc123",
+        "fixed_effect_lbfgs": {"seconds": 1.0},
+        "skipped_stages": ["tuner"], "completed": True,
+    }
+    art.write_text(json.dumps(good))
+    got = bench._load_resume(str(art))
+    assert got["fixed_effect_lbfgs"] == {"seconds": 1.0}
+    # budget-skips rerun and completion is re-earned on resume
+    assert "skipped_stages" not in got and "completed" not in got
+
+    # different code -> fresh run
+    art.write_text(json.dumps({**good, "git_head": "other"}))
+    assert bench._load_resume(str(art)) == {}
+    # cpu-contaminated or fallback artifacts never seed a resume
+    art.write_text(json.dumps({**good, "backend": "cpu"}))
+    assert bench._load_resume(str(art)) == {}
+    art.write_text(json.dumps(good))
+    monkeypatch.setenv("PHOTON_BENCH_NO_RESUME", "1")
+    assert bench._load_resume(str(art)) == {}
+    monkeypatch.delenv("PHOTON_BENCH_NO_RESUME")
+    # unknown local head (transient git failure) must not resume blindly
+    monkeypatch.setattr(bench, "_GIT_HEAD", "unknown")
+    art.write_text(json.dumps({**good, "git_head": "unknown"}))
+    assert bench._load_resume(str(art)) == {}

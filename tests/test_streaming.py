@@ -605,3 +605,54 @@ class TestParallelIngest:
             n_workers=8,   # more workers than files -> clamps, stays simple
         )
         assert b.n_rows == 40
+
+
+class TestErrorRollback:
+    """A record that fails mid-decode must contribute NOTHING: its
+    partially-queued features carry row == n_rows (never incremented for
+    the failed record), and emitting them would alias the next row or index
+    past a caller's (n, k) ELL arrays (avro_block.cc pend_mark rollback)."""
+
+    def test_failed_record_features_rolled_back(self, tmp_path, rng):
+        from photon_tpu.io.avro import SchemaError
+        from photon_tpu.io.streaming import iter_container_blocks
+
+        feat_names, records = _make_records(rng, n=8)
+        path = str(tmp_path / "x.avro")
+        write_container(path, SCHEMA, records, block_records=8)
+        imap = _index(feat_names)
+        sr = StreamingAvroReader(
+            {"g": imap}, columns=InputColumnNames(),
+            id_tag_columns=("userId",), chunk_rows=1 << 20,
+        )
+        schema, _, blocks = iter_container_blocks(path)
+        (payload, count), = list(blocks)
+        dec = sr._decoder_for(schema)
+        # Clean reference decode of the full block.
+        dec.decode_block(payload, count)
+        ref = dec.take_chunk()
+        rrows, ridx, rval = ref["triples"]["g"]
+
+        # Truncate the payload at MANY cut points: every failing decode must
+        # leave only triples of fully-decoded rows (rows < n), never a
+        # dangling row == n from the record the cut landed in.
+        dec2 = sr._decoder_for(schema)
+        checked = 0
+        for cut in range(1, len(payload), 13):
+            try:
+                dec2.decode_block(payload[:cut], count)
+            except SchemaError:
+                raw = dec2.take_chunk()
+                n = raw["n"]
+                rows, idx, val = raw["triples"]["g"]
+                assert (rows < n).all() if len(rows) else True
+                if len(rows):
+                    # and they are a prefix of the clean decode's triples
+                    m = len(rows)
+                    np.testing.assert_array_equal(rows, rrows[:m])
+                    np.testing.assert_array_equal(idx, ridx[:m])
+                    np.testing.assert_array_equal(val, rval[:m])
+                checked += 1
+            else:
+                dec2.take_chunk()  # clean boundary: reset for next cut
+        assert checked > 20
