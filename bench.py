@@ -1164,6 +1164,10 @@ def main():
             details.update(resumed)
             details["resumed_from_written_at"] = resumed.get(
                 "written_at", "unknown")
+            # Provenance for the (theoretical, single-accelerator host)
+            # cross-backend resume: flush() re-stamps the LIVE backend, so
+            # record which backend the banked stages were measured on.
+            details["resumed_from_backend"] = resumed.get("backend")
             stage_seconds.update({
                 k: float(v)
                 for k, v in resumed.get("stage_seconds", {}).items()

@@ -286,12 +286,19 @@ def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
                 regularization=reg,
                 reg_weight=lam,
             )
+            # Per-λ per-iteration checkpoint: a config-5-scale solve
+            # outlives a flaky-tunnel recovery window, so a killed driver
+            # rerun resumes at iteration k (the state fingerprint guards
+            # against data/config drift; λ rides the filename).
+            ck_dir = os.path.join(args.output_dir, "ooc_checkpoints")
+            os.makedirs(ck_dir, exist_ok=True)
             model, result = run_out_of_core(
                 problem, data,
                 progress=lambda it, f, gn, p: logger.info(
                     "λ=%g iter %d: f=%.6g |g|=%.3g passes=%d", lam, it, f,
                     gn, p,
                 ),
+                checkpoint_path=os.path.join(ck_dir, f"lam_{lam:g}.npz"),
             )
             if val_batch is not None:
                 scores = model.compute_score(

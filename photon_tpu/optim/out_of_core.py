@@ -33,6 +33,8 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
+import os
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -49,6 +51,7 @@ from photon_tpu.optim.base import (
     check_convergence,
 )
 from photon_tpu.optim.lbfgs import (
+    LBFGSHistory,
     empty_history,
     two_loop_direction,
     update_history,
@@ -279,6 +282,16 @@ class OutOfCoreLBFGS:
     # Streamed passes can take minutes each at scale; liveness signals
     # (driver logs, autopilot stall detection) hang off this.
     progress: Optional[object] = None
+    # Per-iteration checkpoint/resume (.npz written atomically after an
+    # accepted step). A config-5-scale solve outlives the flaky tunnel's
+    # recovery windows (~minutes, 2026-07-31), so a killed solve must
+    # restart at iteration k, not 0. Scores (n_rows floats) are NOT stored
+    # — they rebuild from w in one streamed pass on resume. Saves throttle
+    # to one per ``checkpoint_min_interval_s`` (after the first): at 10M+
+    # features a save is ~0.9 GB of npz, and losing <interval of work is
+    # the same accepted trade as the scores-rebuild pass.
+    checkpoint_path: Optional[str] = None
+    checkpoint_min_interval_s: float = 60.0
 
     # -- jitted per-chunk kernels -----------------------------------------
 
@@ -292,6 +305,46 @@ class OutOfCoreLBFGS:
         if self.reg_mask is None:
             return jnp.full_like(w, self.l2_weight)
         return self.l2_weight * self.reg_mask.astype(w.dtype)
+
+    # -- checkpoint/resume -------------------------------------------------
+
+    def _load_checkpoint(self, tag: str, dim: int):
+        if self.checkpoint_path is None:
+            return None
+        try:
+            state = np.load(self.checkpoint_path, allow_pickle=False)
+            # Validate inside the try: a corrupt zip can raise lazily
+            # (BadZipFile / EOFError / KeyError on member access), and a
+            # bad checkpoint must mean "start fresh", never a crashed solve
+            # that dies identically every retry window.
+            if str(state.get("tag", "")) != tag or state["w"].shape != (dim,):
+                return None  # different problem/data: never cross-resume
+            return state
+        except Exception:  # noqa: BLE001 - any unreadable state = fresh run
+            return None
+
+    def _save_checkpoint(self, tag: str, w, g, hist, it, passes, f, f_prev,
+                         gnorm0, values, grad_norms) -> None:
+        if self.checkpoint_path is None:
+            return
+        tmp = self.checkpoint_path + ".tmp"
+        try:
+            with open(tmp, "wb") as fh:
+                np.savez(
+                    fh, tag=tag,
+                    w=np.asarray(w), g=np.asarray(g),
+                    hist_s=np.asarray(hist.s), hist_y=np.asarray(hist.y),
+                    hist_rho=np.asarray(hist.rho),
+                    hist_count=np.asarray(hist.count),
+                    hist_pos=np.asarray(hist.pos),
+                    it=it, passes=passes,
+                    f=np.asarray(f), f_prev=np.asarray(f_prev),
+                    gnorm0=np.asarray(gnorm0),
+                    values=values, grad_norms=grad_norms,
+                )
+            os.replace(tmp, self.checkpoint_path)
+        except OSError:
+            pass  # best-effort: a failed save must never kill the solve
 
     def optimize(self, data: ChunkedGLMData, x0: Array) -> OptimizerResult:
         cfg = self.config
@@ -327,21 +380,58 @@ class OutOfCoreLBFGS:
             fd, gd = stream_grad(z_chunks)
             return (fd + 0.5 * jnp.sum(l2v * wv * wv), gd + l2v * wv)
 
-        # init: one scores pass + one grad pass
-        z = stream_scores(w)
-        f, g = full_fg(w, z)
-        passes = 2
-        gnorm0 = jnp.linalg.norm(g)
-        hist = empty_history(cfg.history_length, dim, jnp.float32)
         max_it = cfg.max_iterations
-        values = np.full(max_it + 1, np.inf, np.float32)
-        grad_norms = np.full(max_it + 1, np.inf, np.float32)
-        values[0] = float(f)
-        grad_norms[0] = float(gnorm0)
+        # Fingerprint guards a checkpoint against a DIFFERENT problem/data
+        # resuming from it: loss (task), shape, chunking, regularization
+        # (weight AND mask), iteration cap, plus a cheap content probe
+        # (first-chunk label sum) so same-shaped different data never
+        # cross-resumes.
+        label_probe = float(np.asarray(data.labels[0], np.float64).sum())
+        mask_probe = (
+            "none" if self.reg_mask is None
+            else repr(float(np.asarray(self.reg_mask, np.float64).sum()))
+        )
+        ckpt_tag = (
+            f"ooc-v1:{type(self.loss).__name__}:{data.n_rows}:{dim}:"
+            f"{data.n_chunks}:{data.chunk_rows}:{self.l2_weight}:"
+            f"{mask_probe}:{cfg.history_length}:{max_it}:{label_probe!r}"
+        )
+        state = self._load_checkpoint(ckpt_tag, dim)
+        if state is not None:
+            w = jnp.asarray(state["w"])
+            g = jnp.asarray(state["g"])
+            hist = LBFGSHistory(
+                s=jnp.asarray(state["hist_s"]),
+                y=jnp.asarray(state["hist_y"]),
+                rho=jnp.asarray(state["hist_rho"]),
+                count=jnp.asarray(state["hist_count"]),
+                pos=jnp.asarray(state["hist_pos"]),
+            )
+            it = int(state["it"])
+            passes = int(state["passes"])
+            f = jnp.asarray(state["f"])
+            f_prev = jnp.asarray(state["f_prev"])
+            gnorm0 = jnp.asarray(state["gnorm0"])
+            values = np.asarray(state["values"]).copy()
+            grad_norms = np.asarray(state["grad_norms"]).copy()
+            z = stream_scores(w)  # scores rebuild from w: one pass
+            passes += 1
+        else:
+            # init: one scores pass + one grad pass
+            z = stream_scores(w)
+            f, g = full_fg(w, z)
+            passes = 2
+            gnorm0 = jnp.linalg.norm(g)
+            hist = empty_history(cfg.history_length, dim, jnp.float32)
+            values = np.full(max_it + 1, np.inf, np.float32)
+            grad_norms = np.full(max_it + 1, np.inf, np.float32)
+            values[0] = float(f)
+            grad_norms[0] = float(gnorm0)
+            it = 0
+            f_prev = jnp.asarray(jnp.inf, jnp.float32)
 
         reason = NOT_CONVERGED
-        it = 0
-        f_prev = jnp.asarray(jnp.inf, jnp.float32)
+        last_save = float("-inf")
         while True:
             # Convergence test BEFORE the max-iteration cut (and so also
             # after the final update) — same ordering as the in-core loop,
@@ -400,9 +490,20 @@ class OutOfCoreLBFGS:
             it += 1
             values[it] = float(f)
             grad_norms[it] = float(jnp.linalg.norm(g))
+            # Save BEFORE the progress callback: the checkpoint must bank
+            # the just-finished iteration even if logging (or a supervisor
+            # signal delivered inside it) kills the process. Throttled
+            # after the first save (see checkpoint_min_interval_s).
+            now = time.monotonic()
+            if it == 1 or now - last_save >= self.checkpoint_min_interval_s:
+                self._save_checkpoint(ckpt_tag, w, g, hist, it, passes, f,
+                                      f_prev, gnorm0, values, grad_norms)
+                last_save = now
             if self.progress is not None:
                 self.progress(it, values[it], grad_norms[it], passes)
 
+        self._save_checkpoint(ckpt_tag, w, g, hist, it, passes, f,
+                              f_prev, gnorm0, values, grad_norms)
         return OptimizerResult(
             x=w,
             value=f,
@@ -429,7 +530,7 @@ def scores_out_of_core(data: ChunkedGLMData, w) -> np.ndarray:
 
 
 def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
-                    progress=None):
+                    progress=None, checkpoint_path=None):
     """Problem-level entry mirroring ``GLMOptimizationProblem.run`` for the
     out-of-core path: same task→loss mapping, L2/reg-mask semantics, and
     ``(GLMModel, OptimizerResult)`` return. Variance NONE only (SIMPLE/FULL
@@ -457,6 +558,7 @@ def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
         reg_mask=reg_mask,
         config=problem.optimizer_config,
         progress=progress,
+        checkpoint_path=checkpoint_path,
     )
     if w0 is None:
         w0 = jnp.zeros((data.dim,), jnp.float32)

@@ -1,5 +1,7 @@
 """Out-of-core fixed-effect training (optim/out_of_core.py): host-resident
 row chunks streamed per pass must reproduce the in-core solve."""
+import dataclasses
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -314,3 +316,57 @@ def test_from_stream_on_chunk_fails_fast():
     assert seen == [0, 1]
     # The stream stopped at the failing chunk; the tail was never decoded.
     assert len(consumed) <= 3
+
+
+def test_checkpoint_resume_matches_uninterrupted(tmp_path):
+    """A solve killed after iteration k and resumed from its checkpoint
+    reaches the same optimum as an uninterrupted run (flaky-tunnel recovery
+    windows are shorter than a config-5 solve; VERDICT r3 ask #6)."""
+    from photon_tpu.ops.losses import loss_for_task
+    from photon_tpu.optim.out_of_core import OutOfCoreLBFGS
+
+    idx, val, labels = _data(n=400, seed=11)
+    data = ChunkedGLMData.from_arrays(idx, val, labels, 150, chunk_rows=128)
+    ck = str(tmp_path / "ck.npz")
+
+    def solver(path=None, max_it=30):
+        return OutOfCoreLBFGS(
+            loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+            l2_weight=0.5,
+            config=OptimizerConfig(max_iterations=max_it, tolerance=1e-7),
+            checkpoint_path=path,
+            checkpoint_min_interval_s=0.0,  # every iteration (test speed)
+        )
+
+    w0 = jnp.zeros((150,), jnp.float32)
+    ref = solver().optimize(data, w0)
+
+    # "Killed" run: stop after 3 iterations by raising from progress.
+    class _Stop(Exception):
+        pass
+
+    s1 = solver(ck)
+
+    def bomb(it, f, gn, p):
+        if it >= 3:
+            raise _Stop
+
+    s1 = dataclasses.replace(s1, progress=bomb)
+    with pytest.raises(_Stop):
+        s1.optimize(data, w0)
+    import numpy as _np
+    st = _np.load(ck, allow_pickle=False)
+    assert int(st["it"]) == 3  # checkpoint BEFORE the kill point survived
+
+    # Resume completes and matches the uninterrupted optimum.
+    res = solver(ck).optimize(data, w0)
+    assert int(res.converged_reason) == int(ref.converged_reason)
+    _np.testing.assert_allclose(
+        _np.asarray(res.x), _np.asarray(ref.x), rtol=2e-4, atol=2e-5
+    )
+    assert abs(float(res.value) - float(ref.value)) < 1e-3
+
+    # A different problem (other λ) must NOT resume from this file.
+    other = dataclasses.replace(solver(ck), l2_weight=2.0)
+    res2 = other.optimize(data, w0)
+    assert int(res2.iterations) > 0  # solved fresh, not a stale resume
