@@ -1146,6 +1146,24 @@ def main():
                 details["last_real_hardware"] = lrh
         except (OSError, ValueError):
             pass
+        # The sparse microprofile banks real-chip op timings per recovery
+        # window (scripts/profile_sparse.py mirrors its ledger into the
+        # repo); surface them too — a wedged round-end must not hide them.
+        # Same backend gate as the artifact embed above (variants refuse to
+        # record off-accelerator, so a present stamp says tpu/axon; ledgers
+        # predating the stamp are known-real), and internal bookkeeping
+        # keys (_hangs etc.) stay out of the published artifact.
+        try:
+            with open(os.path.join(here, "PROFILE_SPARSE.json")) as f:
+                prof = json.load(f)
+            if prof.get("backend", "axon") in REAL_ACCELERATOR_BACKENDS:
+                details.setdefault("last_real_hardware", {})[
+                    "sparse_microprofile"] = {
+                        k: v for k, v in prof.items()
+                        if not k.startswith("_")
+                    }
+        except (OSError, ValueError):
+            pass
     stage_seconds = {}
 
     # Smoke runs exercise the code path only, and a CPU fallback is not the

@@ -308,18 +308,23 @@ class OutOfCoreLBFGS:
 
     # -- checkpoint/resume -------------------------------------------------
 
+    _STATE_KEYS = ("w", "g", "hist_s", "hist_y", "hist_rho", "hist_count",
+                   "hist_pos", "it", "passes", "f", "f_prev", "gnorm0",
+                   "values", "grad_norms")
+
     def _load_checkpoint(self, tag: str, dim: int):
         if self.checkpoint_path is None:
             return None
         try:
             state = np.load(self.checkpoint_path, allow_pickle=False)
-            # Validate inside the try: a corrupt zip can raise lazily
-            # (BadZipFile / EOFError / KeyError on member access), and a
-            # bad checkpoint must mean "start fresh", never a crashed solve
-            # that dies identically every retry window.
+            # Validate AND materialize every member inside the try: a
+            # corrupt zip can raise lazily on member access (BadZipFile /
+            # EOFError / KeyError), and a bad checkpoint must mean "start
+            # fresh", never a crashed solve that dies identically every
+            # retry window.
             if str(state.get("tag", "")) != tag or state["w"].shape != (dim,):
                 return None  # different problem/data: never cross-resume
-            return state
+            return {k: np.asarray(state[k]) for k in self._STATE_KEYS}
         except Exception:  # noqa: BLE001 - any unreadable state = fresh run
             return None
 
@@ -383,10 +388,19 @@ class OutOfCoreLBFGS:
         max_it = cfg.max_iterations
         # Fingerprint guards a checkpoint against a DIFFERENT problem/data
         # resuming from it: loss (task), shape, chunking, regularization
-        # (weight AND mask), iteration cap, plus a cheap content probe
-        # (first-chunk label sum) so same-shaped different data never
-        # cross-resumes.
-        label_probe = float(np.asarray(data.labels[0], np.float64).sum())
+        # (weight AND mask), iteration cap, plus cheap content probes over
+        # EVERY data component (labels, weights, offsets, features of the
+        # first chunk) so same-shaped different data never cross-resumes —
+        # regenerated features or reweighted rows change the tag even when
+        # labels don't.
+        c0 = data.chunks[0]
+        data_probe = (
+            float(np.asarray(data.labels[0], np.float64).sum()),
+            float(np.asarray(data.weights[0], np.float64).sum()),
+            float(np.asarray(data.offsets[0], np.float64).sum()),
+            int(np.asarray(c0.idx, np.int64).sum()),
+            float(np.asarray(c0.val, np.float64).sum()),
+        )
         mask_probe = (
             "none" if self.reg_mask is None
             else repr(float(np.asarray(self.reg_mask, np.float64).sum()))
@@ -394,7 +408,7 @@ class OutOfCoreLBFGS:
         ckpt_tag = (
             f"ooc-v1:{type(self.loss).__name__}:{data.n_rows}:{dim}:"
             f"{data.n_chunks}:{data.chunk_rows}:{self.l2_weight}:"
-            f"{mask_probe}:{cfg.history_length}:{max_it}:{label_probe!r}"
+            f"{mask_probe}:{cfg.history_length}:{max_it}:{data_probe!r}"
         )
         state = self._load_checkpoint(ckpt_tag, dim)
         if state is not None:
