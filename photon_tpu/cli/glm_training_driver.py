@@ -104,6 +104,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--response-column", default="response")
     p.add_argument("--uid-column", default="uid")
     p.add_argument("--dtype", default="float32", choices=["float32", "float64"])
+    p.add_argument("--devices", type=int, default=1,
+                   help="out-of-core route only: stream row chunks sharded "
+                        "over this many devices (0 = all visible, 1 = single "
+                        "device; the device count must divide "
+                        "--row-chunk-rows) — P1 data parallelism x "
+                        "out-of-core")
     p.add_argument("--row-chunk-rows", type=int, default=-1,
                    help="out-of-core training: keep the ELL arrays "
                         "host-resident in row chunks of this size and stream "
@@ -208,6 +214,22 @@ def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
     )
     value_dtype = os.environ.get("PHOTON_VALUE_DTYPE")
     validation = DataValidationType[args.data_validation]
+    # P1 x out-of-core: chunks stream row-sharded over a data mesh
+    # (--devices N / 0 = all); the device count must divide chunk_rows.
+    # Checked HERE, before hours of streaming decode — the solver's own
+    # check would only fire after the whole dataset is in host RAM.
+    from photon_tpu.cli.params import mesh_from_flags
+
+    mesh = mesh_from_flags(getattr(args, "devices", 1))
+    if mesh is not None:
+        if chunk_rows % mesh.devices.size != 0:
+            raise ValueError(
+                f"--row-chunk-rows {chunk_rows} must be divisible by the "
+                f"{mesh.devices.size}-device data mesh (--devices) for "
+                "row-sharded streaming"
+            )
+        logger.info("out-of-core streaming over %d-device data mesh",
+                    mesh.devices.size)
 
     # Same --data-validation contract as the in-core path, applied to each
     # ASSEMBLED fixed-shape chunk THE MOMENT it exists (fail fast: a NaN in
@@ -299,6 +321,7 @@ def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
                     gn, p,
                 ),
                 checkpoint_path=os.path.join(ck_dir, f"lam_{lam:g}.npz"),
+                mesh=mesh,
             )
             if val_batch is not None:
                 scores = model.compute_score(

@@ -292,6 +292,17 @@ class OutOfCoreLBFGS:
     # the same accepted trade as the scores-rebuild pass.
     checkpoint_path: Optional[str] = None
     checkpoint_min_interval_s: float = 60.0
+    # Data-parallel streaming (SURVEY.md §2.6 P1 × out-of-core): with a
+    # Mesh, every streamed chunk is device_put ROW-SHARDED over
+    # ``data_axis`` while w/direction stay replicated — GSPMD partitions
+    # the per-chunk kernels and inserts the cross-device reductions
+    # (value/grad all-reduce), so a pod streams each pass at aggregate
+    # H2D + HBM bandwidth. This is how the config-5 shape maps to a
+    # v5e-256: host-resident chunks per process, rows sharded over the
+    # mesh, one collective per pass — the reference's treeAggregate
+    # re-cast as GSPMD (SURVEY.md §2.2 "Distributed objective").
+    mesh: Optional[object] = None
+    data_axis: str = "data"
 
     # -- jitted per-chunk kernels -----------------------------------------
 
@@ -355,20 +366,57 @@ class OutOfCoreLBFGS:
         cfg = self.config
         dim = data.dim
         k_matvec, k_probe, k_grad = self._kernels(dim)
-        w = jnp.asarray(x0, jnp.float32)
+
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            nsh = self.mesh.shape[self.data_axis]
+            if data.chunk_rows % nsh != 0:
+                raise ValueError(
+                    f"chunk_rows={data.chunk_rows} must divide evenly over "
+                    f"mesh axis {self.data_axis!r} ({nsh} devices) for "
+                    "row-sharded streaming"
+                )
+            _row = NamedSharding(self.mesh, PartitionSpec(self.data_axis))
+            _ell = NamedSharding(
+                self.mesh, PartitionSpec(self.data_axis, None)
+            )
+            _rep = NamedSharding(self.mesh, PartitionSpec())
+
+            def put_row(a):
+                return jax.device_put(a, _row)
+
+            def put_ell(a):
+                return jax.device_put(a, _ell)
+
+            def put_rep(a):
+                return jax.device_put(a, _rep)
+        else:
+            def put_row(a):
+                return a
+
+            put_ell = put_rep = put_row
+
+        # Resident row vectors shard ONCE; streamed ELL chunks shard at
+        # each use (that device_put IS the H2D stream of the pass).
+        labels = [put_row(x) for x in data.labels]
+        offsets = [put_row(x) for x in data.offsets]
+        weights = [put_row(x) for x in data.weights]
+
+        w = put_rep(jnp.asarray(x0, jnp.float32))
         l2v = self._l2_vec(w)
 
         def stream_scores(wv, with_offsets=True):
-            zero = jnp.zeros_like(data.offsets[0])
+            zero = jnp.zeros_like(offsets[0])
             return [
-                k_matvec(wv, c.idx, c.val,
-                         data.offsets[i] if with_offsets else zero)
+                k_matvec(wv, put_ell(c.idx), put_ell(c.val),
+                         offsets[i] if with_offsets else zero)
                 for i, c in enumerate(data.chunks)
             ]
 
         def data_value(z_chunks):
             return sum(
-                k_probe(z, data.labels[i], data.weights[i])
+                k_probe(z, labels[i], weights[i])
                 for i, z in enumerate(z_chunks)
             )
 
@@ -376,8 +424,8 @@ class OutOfCoreLBFGS:
             f = jnp.zeros((), jnp.float32)
             g = jnp.zeros((dim,), jnp.float32)
             for i, (z, c) in enumerate(zip(z_chunks, data.chunks)):
-                fc, gc = k_grad(z, data.labels[i], data.weights[i],
-                                c.idx, c.val)
+                fc, gc = k_grad(z, labels[i], weights[i],
+                                put_ell(c.idx), put_ell(c.val))
                 f, g = f + fc, g + gc
             return f, g
 
@@ -544,7 +592,8 @@ def scores_out_of_core(data: ChunkedGLMData, w) -> np.ndarray:
 
 
 def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
-                    progress=None, checkpoint_path=None):
+                    progress=None, checkpoint_path=None, mesh=None,
+                    data_axis="data"):
     """Problem-level entry mirroring ``GLMOptimizationProblem.run`` for the
     out-of-core path: same task→loss mapping, L2/reg-mask semantics, and
     ``(GLMModel, OptimizerResult)`` return. Variance NONE only (SIMPLE/FULL
@@ -573,6 +622,8 @@ def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
         config=problem.optimizer_config,
         progress=progress,
         checkpoint_path=checkpoint_path,
+        mesh=mesh,
+        data_axis=data_axis,
     )
     if w0 is None:
         w0 = jnp.zeros((data.dim,), jnp.float32)

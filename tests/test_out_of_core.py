@@ -374,3 +374,37 @@ def test_checkpoint_resume_matches_uninterrupted(tmp_path):
         _np.asarray(res2.x), _np.asarray(fresh2.x), rtol=2e-4, atol=2e-5
     )
     assert abs(float(res2.value) - float(ref.value)) > 1e-2  # not λ=0.5's
+
+
+def test_mesh_streaming_matches_single_device():
+    """P1 x out-of-core: row-sharded chunk streaming over an 8-device mesh
+    produces the same solve as single-device OOC (GSPMD inserts the
+    value/grad all-reduces; SURVEY.md §2.6 P1, §2.2 distributed objective)."""
+    from photon_tpu.ops.losses import loss_for_task
+    from photon_tpu.parallel.mesh import make_mesh
+
+    idx, val, labels = _data(n=512, seed=21)
+    data = ChunkedGLMData.from_arrays(idx, val, labels, 150, chunk_rows=128)
+    cfg = OptimizerConfig(max_iterations=25, tolerance=1e-7)
+
+    def solve(mesh=None):
+        return OutOfCoreLBFGS(
+            loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+            l2_weight=0.3, config=cfg, mesh=mesh,
+        ).optimize(data, jnp.zeros((150,), jnp.float32))
+
+    ref = solve()
+    res = solve(make_mesh({"data": 8}))
+    assert int(res.converged_reason) == int(ref.converged_reason)
+    assert int(res.iterations) == int(ref.iterations)
+    np.testing.assert_allclose(
+        np.asarray(res.x), np.asarray(ref.x), rtol=2e-4, atol=2e-5
+    )
+
+    # chunk_rows that don't divide the mesh axis fail loudly, not wrongly
+    bad = ChunkedGLMData.from_arrays(idx, val, labels, 150, chunk_rows=100)
+    with pytest.raises(ValueError, match="divide evenly"):
+        OutOfCoreLBFGS(
+            loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+            config=cfg, mesh=make_mesh({"data": 8}),
+        ).optimize(bad, jnp.zeros((150,), jnp.float32))
