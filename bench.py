@@ -1334,9 +1334,32 @@ def main():
         _refresh_derived()
         return {}
 
+    # ALL heavy compiles are corralled into the final race: the GAME /
+    # game_scale / tuner stages auto-attach MXU/Pallas layouts at call time
+    # (with_accelerator_paths reads the env), and those compiles are the
+    # same hazard class that has twice killed a recovery window. The middle
+    # stages therefore run light-compile formulations unconditionally; the
+    # race re-enables the risky paths at the very end, unless the operator
+    # (or autopilot attempt >= 2) disabled them for the whole run.
+    user_disabled_fast = (
+        os.environ.get("PHOTON_BENCH_SKIP_FAST") == "1"
+        or os.environ.get("PHOTON_DISABLE_ACCEL_PATHS") == "1"
+    )
+    os.environ["PHOTON_DISABLE_ACCEL_PATHS"] = "1"
+
+    def stage_sparse_race():
+        if user_disabled_fast:
+            return {"sparse_race_skipped":
+                    "PHOTON_BENCH_SKIP_FAST / PHOTON_DISABLE_ACCEL_PATHS"}
+        os.environ.pop("PHOTON_DISABLE_ACCEL_PATHS", None)
+        sparse_race(_bank_fixed_effect)
+        return {"sparse_race_done": True}
+
     # Optional stages, most important first; each is timed, persisted as it
     # lands, and isolated (one stage failing or the budget running out must
-    # not cost the stages before it or the headline line).
+    # not cost the stages before it or the headline line). sparse_race is
+    # LAST on purpose (see above); it updates the headline in place when a
+    # risky path beats the gather solve.
     for name, fn in (
         ("roofline", stage_roofline),
         ("owlqn_tron", bench_owlqn_tron),
@@ -1344,17 +1367,7 @@ def main():
         ("ingest", bench_ingest),
         ("game_scale", bench_game_scale),
         ("tuner", bench_tuner),
-        # LAST on purpose: the fast/Pallas compiles are the only programs
-        # that have ever wedged the tunnel (twice, 2026-07-31), so they run
-        # after every other stage's numbers are banked. The race updates the
-        # headline in place when a risky path beats the gather solve.
-        ("sparse_race",
-         (lambda: {"sparse_race_skipped":
-                   "PHOTON_BENCH_SKIP_FAST / PHOTON_DISABLE_ACCEL_PATHS"})
-         if (os.environ.get("PHOTON_BENCH_SKIP_FAST") == "1"
-             or os.environ.get("PHOTON_DISABLE_ACCEL_PATHS") == "1")
-         else lambda: (sparse_race(_bank_fixed_effect),
-                       {"sparse_race_done": True})[1]),
+        ("sparse_race", stage_sparse_race),
     ):
         done_key = {
             "roofline": "roofline",

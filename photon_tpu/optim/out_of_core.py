@@ -398,10 +398,14 @@ class OutOfCoreLBFGS:
             put_ell = put_rep = put_row
 
         # Resident row vectors shard ONCE; streamed ELL chunks shard at
-        # each use (that device_put IS the H2D stream of the pass).
-        labels = [put_row(x) for x in data.labels]
-        offsets = [put_row(x) for x in data.offsets]
-        weights = [put_row(x) for x in data.weights]
+        # each use (that device_put IS the H2D stream of the pass). The
+        # sharded copies REBIND onto ``data`` so the original unsharded
+        # device arrays drop — at config-5 scale they are ~1.2 GB of HBM
+        # that must not sit next to their own sharded copies, and a driver
+        # λ-sweep then re-enters with already-sharded arrays (no-op puts).
+        labels = data.labels = [put_row(x) for x in data.labels]
+        offsets = data.offsets = [put_row(x) for x in data.offsets]
+        weights = data.weights = [put_row(x) for x in data.weights]
 
         w = put_rep(jnp.asarray(x0, jnp.float32))
         l2v = self._l2_vec(w)
@@ -460,14 +464,19 @@ class OutOfCoreLBFGS:
         )
         state = self._load_checkpoint(ckpt_tag, dim)
         if state is not None:
-            w = jnp.asarray(state["w"])
-            g = jnp.asarray(state["g"])
+            # Restored coefficient-space state takes the SAME replicated
+            # sharding the fresh path gives it — resuming a mesh solve with
+            # default-device arrays would recompile every kernel under
+            # different input shardings (and fail outright on a multi-host
+            # mesh with non-addressable devices).
+            w = put_rep(jnp.asarray(state["w"]))
+            g = put_rep(jnp.asarray(state["g"]))
             hist = LBFGSHistory(
-                s=jnp.asarray(state["hist_s"]),
-                y=jnp.asarray(state["hist_y"]),
-                rho=jnp.asarray(state["hist_rho"]),
-                count=jnp.asarray(state["hist_count"]),
-                pos=jnp.asarray(state["hist_pos"]),
+                s=put_rep(jnp.asarray(state["hist_s"])),
+                y=put_rep(jnp.asarray(state["hist_y"])),
+                rho=put_rep(jnp.asarray(state["hist_rho"])),
+                count=put_rep(jnp.asarray(state["hist_count"])),
+                pos=put_rep(jnp.asarray(state["hist_pos"])),
             )
             it = int(state["it"])
             passes = int(state["passes"])
