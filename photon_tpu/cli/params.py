@@ -58,6 +58,20 @@ class CoordinateSpec:
 _BOOL = {"true": True, "false": False}
 
 
+def _parse_bool(cid: str, key: str, raw: str) -> bool:
+    """Strict DSL booleans: silent False on a typo would quietly disable the
+    scale knob and OOM at exactly the scale it exists for."""
+    low = raw.strip().lower()
+    if low in ("1", "true", "yes"):
+        return True
+    if low in ("0", "false", "no"):
+        return False
+    raise ValueError(
+        f"coordinate {cid!r}: {key} must be one of 1/0/true/false/yes/no, "
+        f"got {raw!r}"
+    )
+
+
 def parse_coordinate_spec(spec: str) -> CoordinateSpec:
     cid, sep, body = spec.partition(":")
     cid = cid.strip()
@@ -79,6 +93,7 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
         "type", "shard", "re_type", "active_bound", "min_rows", "max_features", "optimizer",
         "max_iter", "tol", "reg", "alpha", "reg_weights", "downsample",
         "variance", "incremental", "latent", "alternations",
+        "max_bucket_entities", "host_resident",
     }
     unknown = set(kv) - known
     if unknown:
@@ -93,7 +108,8 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
     shard = kv.get("shard", "global")
     if ctype == "fixed":
         for k in ("re_type", "active_bound", "min_rows", "max_features",
-                  "latent", "alternations"):
+                  "latent", "alternations", "max_bucket_entities",
+                  "host_resident"):
             if k in kv:
                 raise ValueError(f"coordinate {cid!r}: {k} is random-effect only")
         data: CoordinateDataConfig = FixedEffectDataConfig(feature_shard=shard)
@@ -108,6 +124,12 @@ def parse_coordinate_spec(spec: str) -> CoordinateSpec:
             max_features_per_entity=(
                 int(kv["max_features"]) if "max_features" in kv else None
             ),
+            max_bucket_entities=(
+                int(kv["max_bucket_entities"])
+                if "max_bucket_entities" in kv else None
+            ),
+            host_resident=_parse_bool(cid, "host_resident",
+                                      kv.get("host_resident", "0")),
         )
         if ctype == "factored":
             data = FactoredRandomEffectDataConfig(

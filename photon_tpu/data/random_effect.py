@@ -195,6 +195,8 @@ def build_random_effect_dataset(
     intercept_index: Optional[int] = None,
     dtype=np.float32,
     max_features_per_entity: Optional[int] = None,
+    max_bucket_entities: Optional[int] = None,
+    host_resident: bool = False,
 ) -> RandomEffectDataset:
     """Host-side builder: group rows by entity, project features, bucket+pad.
 
@@ -217,7 +219,18 @@ def build_random_effect_dataset(
     global ``bincount``s, and bucket packing is flat fancy-index writes —
     no per-entity Python. ``_build_reference_loop`` keeps the original
     entity-at-a-time implementation as the oracle for the equivalence test.
+
+    Scale controls (SURVEY.md §2.6 P6): ``max_bucket_entities`` splits each
+    size-class bucket into slices of at most that many entities, and
+    ``host_resident=True`` keeps bucket arrays as host numpy — the RE
+    trainer then transfers ONE bucket at a time, so peak device residency
+    is a single bucket instead of the whole grouped dataset (the knob that
+    bounds HBM for config-5-scale GAME).
     """
+    if max_bucket_entities is not None and max_bucket_entities < 1:
+        raise ValueError(
+            f"max_bucket_entities must be >= 1, got {max_bucket_entities}"
+        )
     n, k = idx.shape
     idx = np.asarray(idx)
     val = np.asarray(val)
@@ -349,15 +362,23 @@ def build_random_effect_dataset(
         centries = col_order[csl]
         b_proj[col_dense[centries] - mb, within_col[csl]] = cols_flat[centries]
 
-        for lane in range(ecount):
-            entity_to_slot[int(mb + lane)] = (b, lane)
-        buckets.append(EntityBucket(
-            idx=jnp.asarray(b_idx), val=jnp.asarray(b_val),
-            labels=jnp.asarray(b_lab), weights=jnp.asarray(b_w),
-            train_weights=jnp.asarray(b_tw), row_ids=jnp.asarray(b_rows),
-            proj=jnp.asarray(b_proj),
-            entity_ids=jnp.asarray(np.arange(mb, me, dtype=np.int32)),
-        ))
+        conv = (lambda a: a) if host_resident else jnp.asarray
+        cap = max_bucket_entities or ecount
+        for lo in range(0, ecount, cap):
+            hi = min(lo + cap, ecount)
+            bi = len(buckets)
+            for lane in range(lo, hi):
+                entity_to_slot[int(mb + lane)] = (bi, lane - lo)
+            buckets.append(EntityBucket(
+                idx=conv(b_idx[lo:hi]), val=conv(b_val[lo:hi]),
+                labels=conv(b_lab[lo:hi]), weights=conv(b_w[lo:hi]),
+                train_weights=conv(b_tw[lo:hi]),
+                row_ids=conv(b_rows[lo:hi]),
+                proj=conv(b_proj[lo:hi]),
+                entity_ids=conv(
+                    np.arange(mb + lo, mb + hi, dtype=np.int32)
+                ),
+            ))
 
     return RandomEffectDataset(
         re_type=re_type,

@@ -53,6 +53,12 @@ class RandomEffectDataConfig:
     active_bound: Optional[int] = None
     min_entity_rows: int = 1
     max_features_per_entity: Optional[int] = None
+    # Scale controls (no reference equivalent — Spark partitions replace
+    # them there): cap entities per bucket, and keep bucket arrays host-
+    # resident so the trainer streams ONE bucket at a time through the
+    # device (peak HBM = one bucket).
+    max_bucket_entities: Optional[int] = None
+    host_resident: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

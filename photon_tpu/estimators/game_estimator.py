@@ -108,6 +108,8 @@ def build_re_dataset_from_bundle(
         max_features_per_entity=(
             None if for_scoring else cfg.max_features_per_entity
         ),
+        max_bucket_entities=cfg.max_bucket_entities,
+        host_resident=cfg.host_resident,
         # Follow the bundle's feature precision (float64 under --dtype
         # float64) so random effects train at the same precision as the
         # fixed effect.

@@ -185,11 +185,12 @@ def main() -> None:
                     help="run the data path at full shape (write, index, "
                          "stream every row) without the solve — host-side "
                          "proof while the accelerator is unavailable")
-    ap.add_argument("--game-rows", type=int, default=25_000_000,
-                    help="row cap for the GAME (fixed+RE) phase — the RE "
-                         "buckets are device-resident, so GAME caps at what "
-                         "HBM holds; the full-shape fixed solve runs "
-                         "out-of-core at --rows regardless")
+    ap.add_argument("--game-rows", type=int, default=50_000_000,
+                    help="row cap for the GAME (fixed+RE) phase; RE buckets "
+                         "stream host->device one bucket at a time "
+                         "(host_resident + max_bucket_entities), so the cap "
+                         "is host-RAM-bound, not HBM-bound; the full-shape "
+                         "fixed solve runs out-of-core at --rows regardless")
     ap.add_argument("--keep-data", action="store_true")
     args = ap.parse_args()
     if not args.tpu:
@@ -319,10 +320,11 @@ def main() -> None:
         }
         ent["rows_per_sec_end_to_end"] = round(args.rows / took, 1)
 
-    # Phase B — GAME semantics (fixed + per-user random effect) at a
-    # device-feasible row count: the RE buckets are device-materialized, so
-    # the GAME coordinates cap at what HBM holds (quarter scale by default;
-    # the full-shape solve above carries the scale claim).
+    # Phase B — GAME semantics (fixed + per-user random effect) at half
+    # scale by default: RE buckets are built host-resident and stream
+    # through the device one capped bucket at a time, so the limit is the
+    # builder's host RSS, not HBM (the full-shape fixed solve above carries
+    # the full --rows scale claim).
     game_rows = min(args.rows, args.game_rows)
     game_data_path = data
     if game_rows < args.rows:
@@ -358,7 +360,8 @@ def main() -> None:
             "fixed:type=fixed,shard=global,reg=L2,max_iter=10,reg_weights=1",
             "--coordinate",
             "perUser:type=random,re_type=userId,shard=global,reg=L2,"
-            "max_iter=10,reg_weights=1",
+            "max_iter=10,reg_weights=1,max_bucket_entities=16384,"
+            "host_resident=1",
             "--checkpoint-dir", os.path.join(args.out, "ck"),
             "--mesh", "data=1,model=1",
         ])

@@ -430,3 +430,49 @@ def test_multislice_entity_sharding_matches_single_device(rng, problem):
         problem, ds, offsets, mesh=mesh, entity_axis=("dcn", "data"))
     for a, b in zip(m_single.bucket_coefs, m_ms.bucket_coefs):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-8)
+
+
+class TestScaleControls:
+    """max_bucket_entities + host_resident (SURVEY §2.6 P6 scale knobs):
+    split, host-resident buckets must train to the same per-entity optima
+    and score identically — peak device residency becomes one bucket."""
+
+    def test_split_host_buckets_match(self, problem):
+        rng = np.random.default_rng(31)
+        idx, val, labels, keys = _make_entity_data(rng, n_entities=11)
+        n = len(labels)
+        kwargs = dict(global_dim=50, intercept_index=0)
+        ref = build_random_effect_dataset("user", keys, idx, val, labels,
+                                          **kwargs)
+        split = build_random_effect_dataset(
+            "user", keys, idx, val, labels, **kwargs,
+            max_bucket_entities=2, host_resident=True,
+        )
+        assert len(split.buckets) > len(ref.buckets)
+        assert all(b.idx.shape[0] <= 2 for b in split.buckets)
+        assert all(isinstance(b.idx, np.ndarray) for b in split.buckets)
+
+        offsets = jnp.zeros((n,), jnp.float32)
+        m_ref, _ = train_random_effects(problem, ref, offsets)
+        m_split, _ = train_random_effects(problem, split, offsets)
+        # Same per-row scores regardless of bucket layout.
+        np.testing.assert_allclose(
+            np.asarray(m_ref.score_dataset(ref)),
+            np.asarray(m_split.score_dataset(split)),
+            rtol=1e-4, atol=1e-5,
+        )
+        # Per-entity coefficient export agrees too.
+        for e in range(3):
+            ca, _ = m_ref.coefficients_for(f"user_{e}")
+            cb, _ = m_split.coefficients_for(f"user_{e}")
+            np.testing.assert_allclose(ca, cb, rtol=1e-4, atol=1e-5)
+
+    def test_estimator_dsl_plumbs_scale_controls(self):
+        from photon_tpu.cli.params import parse_coordinate_spec
+
+        spec = parse_coordinate_spec(
+            "perUser:type=random,re_type=userId,shard=global,reg=L2,"
+            "reg_weights=1,max_bucket_entities=4096,host_resident=1"
+        )
+        assert spec.data.max_bucket_entities == 4096
+        assert spec.data.host_resident is True
