@@ -476,3 +476,24 @@ class TestScaleControls:
         )
         assert spec.data.max_bucket_entities == 4096
         assert spec.data.host_resident is True
+
+    def test_factored_re_trains_on_host_buckets(self, problem):
+        """The factored coordinate consumes the same bucket structure; host-
+        resident split buckets must train and score without surprises."""
+        from photon_tpu.game.factored_random_effect import (
+            train_factored_random_effects,
+        )
+
+        rng = np.random.default_rng(33)
+        idx, val, labels, keys = _make_entity_data(rng, n_entities=7)
+        n = len(labels)
+        ds = build_random_effect_dataset(
+            "user", keys, idx, val, labels, global_dim=50,
+            intercept_index=0, max_bucket_entities=3, host_resident=True,
+        )
+        model, _ = train_factored_random_effects(
+            problem, ds, jnp.zeros((n,), jnp.float32),
+            latent_dim=4, n_alternations=1,
+        )
+        s = np.asarray(model.score_dataset(ds))
+        assert s.shape == (n,) and np.isfinite(s).all()
