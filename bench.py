@@ -1024,16 +1024,22 @@ _GIT_HEAD = None
 
 
 def _git_head() -> str:
+    """CODE fingerprint, not the commit sha: the committed tree of the
+    package plus this file. Log-only commits (the rotation daemon appends
+    to git-tracked TPU_RECOVERY.jsonl, and the round driver auto-commits
+    them) must not invalidate a banked artifact's resume — a fresh
+    budget-truncated rerun would overwrite a complete one."""
     global _GIT_HEAD
     if _GIT_HEAD is None:
         import subprocess
 
         try:
-            _GIT_HEAD = subprocess.run(
+            out = subprocess.run(
                 ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
-                 "rev-parse", "HEAD"],
+                 "rev-parse", "HEAD:photon_tpu", "HEAD:bench.py"],
                 capture_output=True, text=True, timeout=10,
-            ).stdout.strip() or "unknown"
+            ).stdout.split()
+            _GIT_HEAD = ":".join(out) if len(out) == 2 else "unknown"
         except Exception:  # noqa: BLE001
             _GIT_HEAD = "unknown"
     return _GIT_HEAD
