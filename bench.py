@@ -1034,12 +1034,19 @@ def _git_head() -> str:
         import subprocess
 
         try:
-            out = subprocess.run(
+            p = subprocess.run(
                 ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
                  "rev-parse", "HEAD:photon_tpu", "HEAD:bench.py"],
                 capture_output=True, text=True, timeout=10,
-            ).stdout.split()
-            _GIT_HEAD = ":".join(out) if len(out) == 2 else "unknown"
+            )
+            out = p.stdout.split()
+            # returncode check matters: rev-parse ECHOES an unresolvable
+            # arg to stdout (exit 128), which would otherwise parse as a
+            # plausible — and permanently stale — fingerprint.
+            _GIT_HEAD = (
+                ":".join(out)
+                if p.returncode == 0 and len(out) == 2 else "unknown"
+            )
         except Exception:  # noqa: BLE001
             _GIT_HEAD = "unknown"
     return _GIT_HEAD

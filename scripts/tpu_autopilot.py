@@ -132,21 +132,21 @@ STATE = f"/tmp/tpu_autopilot_state.{os.getuid()}.json"
 
 
 def _git_head() -> str:
-    try:
-        return subprocess.run(
-            ["git", "-C", REPO, "rev-parse", "HEAD"],
-            capture_output=True, text=True, timeout=10,
-        ).stdout.strip() or "unknown"
-    except (OSError, subprocess.TimeoutExpired):
-        return "unknown"
+    """Shares bench.py's CODE fingerprint (tree of photon_tpu + bench.py
+    blob): log-only commits (rotation-daemon appends to TPU_RECOVERY.jsonl,
+    auto-committed by the round driver) must not wipe earned attempt
+    counters any more than they may invalidate a banked bench artifact."""
+    import bench
+
+    return bench._git_head()
 
 
 def _read_state() -> dict:
     """Attempt counts persist ACROSS autopilot restarts (rotation restarts
     and sequencer replacements are routine) — process-local counters would
     reset and re-burn recovery windows on work already tried. Counts are
-    keyed to the repo HEAD: new code resets them, so a give-up from an old
-    build can never permanently skip the bench for builds that came after."""
+    keyed to the CODE fingerprint: new code resets them, so a give-up from
+    an old build can never permanently skip the bench for newer builds."""
     try:
         with open(STATE) as f:
             d = json.load(f)
