@@ -213,7 +213,11 @@ def bench_attempt_env(n: int) -> dict:
        compile path;
     3. give-up completion — no risky compiles at all.
     """
-    env = {"PHOTON_BENCH_FORCE_PROBE": "1", "PHOTON_BENCH_BUDGET": "2400"}
+    # 3600s budget: an autopilot run has no driver window to fit inside,
+    # and with possibly ONE late recovery window the bench must not budget-
+    # skip tuner/race work it could have finished (stall/timeout still
+    # guard a wedge).
+    env = {"PHOTON_BENCH_FORCE_PROBE": "1", "PHOTON_BENCH_BUDGET": "3600"}
     if n == 2:
         env["PALLAS_AXON_REMOTE_COMPILE"] = "0"
     elif n >= 3:
