@@ -745,9 +745,56 @@ def iter_container_blocks(path: str):
         sync = f.read(avro.SYNC_SIZE)
         data_start = 4 + pos + avro.SYNC_SIZE
 
+    def _mm_varint(mm, pos, end):
+        # Shared wire-format decode (avro._read_long) with container-level
+        # error mapping; bounds violations surface as IndexError there.
+        try:
+            v, pos = avro._read_long(mm, pos)
+        except IndexError:
+            raise SchemaError(f"{path}: truncated avro container") from None
+        if pos > end:
+            raise SchemaError(f"{path}: truncated avro container")
+        return v, pos
+
     def blocks():
         import zlib
 
+        if codec == "null":
+            # Zero-copy: the payload slices are memoryviews over the mmap
+            # (the native decoder reads them in place via np.frombuffer) —
+            # no kernel read()+copy per block. The mmap stays alive through
+            # each yielded slice's refcount.
+            import mmap as _mmap
+
+            with open(path, "rb") as f:
+                try:
+                    mm = _mmap.mmap(f.fileno(), 0, access=_mmap.ACCESS_READ)
+                except (ValueError, OSError):
+                    mm = None  # empty file / no-mmap fs: buffered fallback
+            if mm is not None:
+                # No explicit close: a consumer may legitimately hold the
+                # last yielded slice past exhaustion, and mmap.close() with
+                # exported buffers raises BufferError — refcounting closes
+                # the map once every slice drops.
+                view = memoryview(mm)
+                pos, end = data_start, len(mm)
+                while pos < end:
+                    count, pos = _mm_varint(mm, pos, end)
+                    size, pos = _mm_varint(mm, pos, end)
+                    # Negative zigzag decodes would slice from the END of
+                    # the map and walk pos backward (hang/garbage) — corrupt
+                    # input must fail loud instead.
+                    if count < 0 or size < 0 or pos + size > end:
+                        raise SchemaError(
+                            f"{path}: corrupt avro block header "
+                            f"(count={count}, size={size})"
+                        )
+                    yield view[pos:pos + size], count
+                    pos += size
+                    if bytes(mm[pos:pos + avro.SYNC_SIZE]) != sync:
+                        raise SchemaError(f"{path}: sync marker mismatch")
+                    pos += avro.SYNC_SIZE
+                return
         with open(path, "rb") as f:
             f.seek(data_start)
             while True:
