@@ -656,3 +656,40 @@ class TestErrorRollback:
             else:
                 dec2.take_chunk()  # clean boundary: reset for next cut
         assert checked > 20
+
+
+class TestMmapBlockReader:
+    """Null-codec containers stream through the zero-copy mmap path; corrupt
+    block headers must fail loud (a negative zigzag size would otherwise
+    slice from the END of the map and walk the cursor backward)."""
+
+    def test_negative_block_size_raises(self, tmp_path, rng):
+        from photon_tpu.io.avro import SchemaError
+        from photon_tpu.io.streaming import iter_container_blocks
+
+        feat_names, records = _make_records(rng, n=30)
+        path = str(tmp_path / "x.avro")
+        write_container(path, SCHEMA, records, block_records=10)
+        raw = bytearray(open(path, "rb").read())
+
+        _, _, blocks = iter_container_blocks(path)
+        clean = list(blocks)
+        assert len(clean) == 3
+        # Payloads come back as zero-copy memoryviews over the mmap.
+        assert isinstance(clean[0][0], memoryview)
+
+        # Find the second block header (after payload 1 + sync) and replace
+        # its size varint with 0x03 (zigzag -> -2).
+        from photon_tpu.io.avro import SYNC_SIZE
+        hdr = raw.index(bytes(clean[0][0]))  # start of payload 1
+        pos = hdr + len(clean[0][0]) + SYNC_SIZE
+        # skip count varint of block 2
+        while raw[pos] & 0x80:
+            pos += 1
+        pos += 1
+        raw[pos] = 0x03  # size = -2 (single-byte varint)
+        bad = tmp_path / "bad.avro"
+        bad.write_bytes(bytes(raw))
+        _, _, blocks = iter_container_blocks(str(bad))
+        with pytest.raises(SchemaError, match="corrupt avro block header"):
+            list(blocks)
