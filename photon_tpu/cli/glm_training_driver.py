@@ -164,9 +164,20 @@ def _ooc_unsupported_flag(args):
     (which must fall back in-core, never error, on a config that worked
     before OOC existed) and by ``_run_out_of_core`` (which fails loudly on
     an EXPLICIT --row-chunk-rows request it cannot honor)."""
+    # Optimizer↔regularization pairing mirrors the in-core rules: smooth
+    # L-BFGS takes L2; orthant-wise OWL-QN takes any L1 component (or pure
+    # L2). TRON stays in-core (trust-region Hessian passes).
+    ok_pairs = {
+        ("LBFGS", "L2"), ("OWLQN", "L1"), ("OWLQN", "ELASTIC_NET"),
+        ("OWLQN", "L2"),
+    }
+    if (args.optimizer, args.regularization) not in ok_pairs:
+        if args.optimizer not in ("LBFGS", "OWLQN"):
+            return "--optimizer", "LBFGS|OWLQN", args.optimizer
+        return ("--regularization",
+                "L2" if args.optimizer == "LBFGS" else "L1|ELASTIC_NET|L2",
+                args.regularization)
     for flag, want, got in (
-        ("--optimizer", "LBFGS", args.optimizer),
-        ("--regularization", "L2", args.regularization),
         ("--normalization", "NONE", args.normalization),
         ("--variance", "NONE", args.variance),
         ("--dtype", "float32", args.dtype),
@@ -181,10 +192,10 @@ def _ooc_unsupported_flag(args):
 def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
     """Out-of-core fixed-effect route (optim/out_of_core.py): host-resident
     row chunks streamed per pass — for datasets a single device's memory
-    cannot hold. Supports the smooth L2/LBFGS configuration (the config-5
-    scale shape); anything needing in-core data (normalization, variances,
-    bootstrap, other optimizers) raises loudly instead of silently
-    degrading."""
+    cannot hold. Supports L2/LBFGS (the config-5 scale shape) and
+    L1/elastic-net/OWLQN (config 2 at scale); anything needing in-core
+    data (normalization, variances, bootstrap, TRON) raises loudly instead
+    of silently degrading."""
     import jax.numpy as jnp
 
     from photon_tpu.io.streaming import StreamingAvroReader
@@ -282,7 +293,10 @@ def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
     suite = EvaluationSuite.parse(
         list(args.evaluators or _default_evaluators(task))
     )
-    reg = RegularizationContext(RegularizationType[args.regularization])
+    reg = RegularizationContext(
+        RegularizationType[args.regularization],
+        elastic_net_alpha=args.elastic_net_alpha,
+    )
 
     # Evaluation labels/weights: validation set in-core if given (it is
     # normally far smaller than train), else streamed train scores.

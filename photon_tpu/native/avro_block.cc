@@ -823,9 +823,16 @@ static void ell_direct(const ShardOut& sh, int64_t n_rows, int64_t k,
       vp[0] = (T)1.0;
       c = 1;
     }
-    for (; t < nnz && sh.rows[t] == (int32_t)r; t++, c++) {
-      ip[c] = sh.idx[t];
-      vp[c] = (T)sh.val[t];
+    // Bounded by c < k: callers derive k from ph_shard_max_run, but that
+    // invariant crosses a ctypes boundary — a mismatched k must truncate
+    // the row, never write past the caller's (n, k) slot. The scan still
+    // consumes the whole run so row alignment survives truncation.
+    for (; t < nnz && sh.rows[t] == (int32_t)r; t++) {
+      if (c < k) {
+        ip[c] = sh.idx[t];
+        vp[c] = (T)sh.val[t];
+        c++;
+      }
     }
     for (; c < k; c++) {
       ip[c] = (int32_t)pad_col;

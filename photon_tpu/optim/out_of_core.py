@@ -23,9 +23,14 @@ capacity, not cluster size:
   ``update_history``, ``check_convergence`` semantics, Armijo constants),
   so out-of-core and in-core solves agree to numerical noise — tested.
 
-Scope: smooth L2 GLM objectives (all four pointwise losses), NONE variance.
-L1/OWL-QN, TRON, priors and normalization remain in-core features; the
-driver auto-routes only fixed-effect L2 solves here when the dataset would
+Scope: smooth L2 GLM objectives (all four pointwise losses) via
+:class:`OutOfCoreLBFGS`, and L1/elastic-net via :class:`OutOfCoreOWLQN`
+(the orthant machinery — pseudo-gradient, alignment, projection — is
+elementwise in coefficient space, so it streams exactly like the smooth
+solver; only the line search costs one extra pass per probe because the
+orthant projection invalidates the resident direction margins). TRON,
+priors, SIMPLE/FULL variance and normalization remain in-core features;
+the driver auto-routes fixed-effect solves here when the dataset would
 blow the device-data budget.
 """
 from __future__ import annotations
@@ -56,6 +61,7 @@ from photon_tpu.optim.lbfgs import (
     two_loop_direction,
     update_history,
 )
+from photon_tpu.optim.owlqn import orthant, pseudo_gradient
 
 Array = jax.Array
 
@@ -76,6 +82,17 @@ class ChunkedGLMData:
     carry 0 on padding rows, so padded rows contribute nothing — same ghost
     convention as ``LabeledBatch``). ``n_rows`` is the true (unpadded) row
     count.
+
+    Sharding contract: a MESH solve rebinds ``labels``/``offsets``/
+    ``weights`` IN PLACE to mesh-sharded device arrays (deliberate: at
+    config-5 scale the unsharded originals are ~1.2 GB of HBM that must not
+    sit next to their own sharded copies, and a λ-sweep re-enters with
+    already-sharded arrays as no-op puts). The object is therefore bound to
+    that mesh afterwards: reusing it under a DIFFERENT mesh re-shards it to
+    the new mesh (one extra put per array), while host-side consumers
+    (``labels_np``/``scores_out_of_core``) read sharded arrays fine on a
+    single process. Don't interleave two meshes' solves over one instance
+    in a tight loop — put churn, not correctness, is the cost.
     """
 
     chunks: list
@@ -270,6 +287,41 @@ def _kernels_for(loss, dim: int):
     return _matvec_for(dim), k_probe, k_grad
 
 
+def _mesh_puts(mesh, data_axis: str, chunk_rows: int):
+    """``(put_row, put_ell, put_rep)`` placement helpers shared by every
+    streamed solver: row-sharded resident vectors, row-sharded ELL chunk
+    streams, replicated coefficient-space state (SURVEY.md §2.6 P1 × OOC).
+    With no mesh all three are the identity."""
+    if mesh is None:
+        def ident(a):
+            return a
+
+        return ident, ident, ident
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    nsh = mesh.shape[data_axis]
+    if chunk_rows % nsh != 0:
+        raise ValueError(
+            f"chunk_rows={chunk_rows} must divide evenly over "
+            f"mesh axis {data_axis!r} ({nsh} devices) for "
+            "row-sharded streaming"
+        )
+    _row = NamedSharding(mesh, PartitionSpec(data_axis))
+    _ell = NamedSharding(mesh, PartitionSpec(data_axis, None))
+    _rep = NamedSharding(mesh, PartitionSpec())
+
+    def put_row(a):
+        return jax.device_put(a, _row)
+
+    def put_ell(a):
+        return jax.device_put(a, _ell)
+
+    def put_rep(a):
+        return jax.device_put(a, _rep)
+
+    return put_row, put_ell, put_rep
+
+
 @dataclasses.dataclass(frozen=True)
 class OutOfCoreLBFGS:
     """Host-loop L-BFGS over a :class:`ChunkedGLMData` (see module doc)."""
@@ -312,6 +364,103 @@ class OutOfCoreLBFGS:
         # whole grid (the in-core sweep makes the same guarantee).
         return _kernels_for(self.loss, dim)
 
+    # -- scaffolding shared with OutOfCoreOWLQN ---------------------------
+
+    def _streams(self, data: ChunkedGLMData):
+        """Shard the resident row vectors (REBINDING onto ``data`` — see
+        the class doc's sharding contract) and return the streamed-pass
+        closures ``(put_rep, stream_scores, data_value, stream_grad)``
+        every out-of-core solver loop is built from."""
+        k_matvec, k_probe, k_grad = self._kernels(data.dim)
+        put_row, put_ell, put_rep = _mesh_puts(
+            self.mesh, self.data_axis, data.chunk_rows
+        )
+        labels = data.labels = [put_row(x) for x in data.labels]
+        offsets = data.offsets = [put_row(x) for x in data.offsets]
+        weights = data.weights = [put_row(x) for x in data.weights]
+
+        def stream_scores(wv, with_offsets=True):
+            zero = jnp.zeros_like(offsets[0])
+            return [
+                k_matvec(wv, put_ell(c.idx), put_ell(c.val),
+                         offsets[i] if with_offsets else zero)
+                for i, c in enumerate(data.chunks)
+            ]
+
+        def data_value(z_chunks):
+            return sum(
+                k_probe(z, labels[i], weights[i])
+                for i, z in enumerate(z_chunks)
+            )
+
+        def stream_grad(z_chunks):
+            f = jnp.zeros((), jnp.float32)
+            g = jnp.zeros((data.dim,), jnp.float32)
+            for i, (z, c) in enumerate(zip(z_chunks, data.chunks)):
+                fc, gc = k_grad(z, labels[i], weights[i],
+                                put_ell(c.idx), put_ell(c.val))
+                f, g = f + fc, g + gc
+            return f, g
+
+        return put_rep, stream_scores, data_value, stream_grad
+
+    def _ckpt_tag(self, data: ChunkedGLMData, prefix: str,
+                  extra: str = "") -> str:
+        """Fingerprint guarding a checkpoint against a DIFFERENT problem or
+        data resuming from it: loss (task), shape, chunking, regularization
+        (weights AND mask, ``extra`` carries solver-specific terms like the
+        L1 weight), iteration cap, plus cheap content probes over EVERY
+        data component (labels, weights, offsets, features of the first
+        chunk) so same-shaped different data never cross-resumes —
+        regenerated features or reweighted rows change the tag even when
+        labels don't."""
+        cfg = self.config
+        c0 = data.chunks[0]
+        data_probe = (
+            float(np.asarray(data.labels[0], np.float64).sum()),
+            float(np.asarray(data.weights[0], np.float64).sum()),
+            float(np.asarray(data.offsets[0], np.float64).sum()),
+            int(np.asarray(c0.idx, np.int64).sum()),
+            float(np.asarray(c0.val, np.float64).sum()),
+        )
+        mask_probe = (
+            "none" if self.reg_mask is None
+            else repr(float(np.asarray(self.reg_mask, np.float64).sum()))
+        )
+        return (
+            f"{prefix}:{type(self.loss).__name__}:{data.n_rows}:{data.dim}:"
+            f"{data.n_chunks}:{data.chunk_rows}:{self.l2_weight}:{extra}"
+            f"{mask_probe}:{cfg.history_length}:{cfg.max_iterations}:"
+            f"{data_probe!r}"
+        )
+
+    @staticmethod
+    def _restore(state, put_rep):
+        """Checkpointed coefficient-space state, re-placed under the SAME
+        replicated sharding the fresh path gives it — resuming a mesh solve
+        with default-device arrays would recompile every kernel under
+        different input shardings (and fail outright on a multi-host mesh
+        with non-addressable devices)."""
+        hist = LBFGSHistory(
+            s=put_rep(jnp.asarray(state["hist_s"])),
+            y=put_rep(jnp.asarray(state["hist_y"])),
+            rho=put_rep(jnp.asarray(state["hist_rho"])),
+            count=put_rep(jnp.asarray(state["hist_count"])),
+            pos=put_rep(jnp.asarray(state["hist_pos"])),
+        )
+        return (
+            put_rep(jnp.asarray(state["w"])),
+            put_rep(jnp.asarray(state["g"])),
+            hist,
+            int(state["it"]),
+            int(state["passes"]),
+            jnp.asarray(state["f"]),
+            jnp.asarray(state["f_prev"]),
+            jnp.asarray(state["gnorm0"]),
+            np.asarray(state["values"]).copy(),
+            np.asarray(state["grad_norms"]).copy(),
+        )
+
     def _l2_vec(self, w: Array) -> Array:
         if self.reg_mask is None:
             return jnp.full_like(w, self.l2_weight)
@@ -336,7 +485,20 @@ class OutOfCoreLBFGS:
             if str(state.get("tag", "")) != tag or state["w"].shape != (dim,):
                 return None  # different problem/data: never cross-resume
             return {k: np.asarray(state[k]) for k in self._STATE_KEYS}
-        except Exception:  # noqa: BLE001 - any unreadable state = fresh run
+        except FileNotFoundError:
+            return None  # no checkpoint yet: the normal first-run case
+        except Exception as e:  # noqa: BLE001 - any unreadable state = fresh run
+            # WARN, don't raise: a corrupt checkpoint means "start fresh".
+            # But silence would make a RECURRING failure (e.g. permissions
+            # on checkpoint_path) look like "no checkpoint" forever — every
+            # recovery window would restart at iteration 0 with no signal.
+            import logging
+
+            logging.getLogger("photon_tpu.ooc").warning(
+                "checkpoint %s unreadable (%s: %s) — starting fresh; if "
+                "this repeats, resume is broken, not absent",
+                self.checkpoint_path, type(e).__name__, e,
+            )
             return None
 
     def _save_checkpoint(self, tag: str, w, g, hist, it, passes, f, f_prev,
@@ -365,126 +527,21 @@ class OutOfCoreLBFGS:
     def optimize(self, data: ChunkedGLMData, x0: Array) -> OptimizerResult:
         cfg = self.config
         dim = data.dim
-        k_matvec, k_probe, k_grad = self._kernels(dim)
-
-        if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            nsh = self.mesh.shape[self.data_axis]
-            if data.chunk_rows % nsh != 0:
-                raise ValueError(
-                    f"chunk_rows={data.chunk_rows} must divide evenly over "
-                    f"mesh axis {self.data_axis!r} ({nsh} devices) for "
-                    "row-sharded streaming"
-                )
-            _row = NamedSharding(self.mesh, PartitionSpec(self.data_axis))
-            _ell = NamedSharding(
-                self.mesh, PartitionSpec(self.data_axis, None)
-            )
-            _rep = NamedSharding(self.mesh, PartitionSpec())
-
-            def put_row(a):
-                return jax.device_put(a, _row)
-
-            def put_ell(a):
-                return jax.device_put(a, _ell)
-
-            def put_rep(a):
-                return jax.device_put(a, _rep)
-        else:
-            def put_row(a):
-                return a
-
-            put_ell = put_rep = put_row
-
-        # Resident row vectors shard ONCE; streamed ELL chunks shard at
-        # each use (that device_put IS the H2D stream of the pass). The
-        # sharded copies REBIND onto ``data`` so the original unsharded
-        # device arrays drop — at config-5 scale they are ~1.2 GB of HBM
-        # that must not sit next to their own sharded copies, and a driver
-        # λ-sweep then re-enters with already-sharded arrays (no-op puts).
-        labels = data.labels = [put_row(x) for x in data.labels]
-        offsets = data.offsets = [put_row(x) for x in data.offsets]
-        weights = data.weights = [put_row(x) for x in data.weights]
+        put_rep, stream_scores, data_value, stream_grad = self._streams(data)
 
         w = put_rep(jnp.asarray(x0, jnp.float32))
         l2v = self._l2_vec(w)
-
-        def stream_scores(wv, with_offsets=True):
-            zero = jnp.zeros_like(offsets[0])
-            return [
-                k_matvec(wv, put_ell(c.idx), put_ell(c.val),
-                         offsets[i] if with_offsets else zero)
-                for i, c in enumerate(data.chunks)
-            ]
-
-        def data_value(z_chunks):
-            return sum(
-                k_probe(z, labels[i], weights[i])
-                for i, z in enumerate(z_chunks)
-            )
-
-        def stream_grad(z_chunks):
-            f = jnp.zeros((), jnp.float32)
-            g = jnp.zeros((dim,), jnp.float32)
-            for i, (z, c) in enumerate(zip(z_chunks, data.chunks)):
-                fc, gc = k_grad(z, labels[i], weights[i],
-                                put_ell(c.idx), put_ell(c.val))
-                f, g = f + fc, g + gc
-            return f, g
 
         def full_fg(wv, z_chunks):
             fd, gd = stream_grad(z_chunks)
             return (fd + 0.5 * jnp.sum(l2v * wv * wv), gd + l2v * wv)
 
         max_it = cfg.max_iterations
-        # Fingerprint guards a checkpoint against a DIFFERENT problem/data
-        # resuming from it: loss (task), shape, chunking, regularization
-        # (weight AND mask), iteration cap, plus cheap content probes over
-        # EVERY data component (labels, weights, offsets, features of the
-        # first chunk) so same-shaped different data never cross-resumes —
-        # regenerated features or reweighted rows change the tag even when
-        # labels don't.
-        c0 = data.chunks[0]
-        data_probe = (
-            float(np.asarray(data.labels[0], np.float64).sum()),
-            float(np.asarray(data.weights[0], np.float64).sum()),
-            float(np.asarray(data.offsets[0], np.float64).sum()),
-            int(np.asarray(c0.idx, np.int64).sum()),
-            float(np.asarray(c0.val, np.float64).sum()),
-        )
-        mask_probe = (
-            "none" if self.reg_mask is None
-            else repr(float(np.asarray(self.reg_mask, np.float64).sum()))
-        )
-        ckpt_tag = (
-            f"ooc-v1:{type(self.loss).__name__}:{data.n_rows}:{dim}:"
-            f"{data.n_chunks}:{data.chunk_rows}:{self.l2_weight}:"
-            f"{mask_probe}:{cfg.history_length}:{max_it}:{data_probe!r}"
-        )
+        ckpt_tag = self._ckpt_tag(data, "ooc-v1")
         state = self._load_checkpoint(ckpt_tag, dim)
         if state is not None:
-            # Restored coefficient-space state takes the SAME replicated
-            # sharding the fresh path gives it — resuming a mesh solve with
-            # default-device arrays would recompile every kernel under
-            # different input shardings (and fail outright on a multi-host
-            # mesh with non-addressable devices).
-            w = put_rep(jnp.asarray(state["w"]))
-            g = put_rep(jnp.asarray(state["g"]))
-            hist = LBFGSHistory(
-                s=put_rep(jnp.asarray(state["hist_s"])),
-                y=put_rep(jnp.asarray(state["hist_y"])),
-                rho=put_rep(jnp.asarray(state["hist_rho"])),
-                count=put_rep(jnp.asarray(state["hist_count"])),
-                pos=put_rep(jnp.asarray(state["hist_pos"])),
-            )
-            it = int(state["it"])
-            passes = int(state["passes"])
-            f = jnp.asarray(state["f"])
-            f_prev = jnp.asarray(state["f_prev"])
-            gnorm0 = jnp.asarray(state["gnorm0"])
-            values = np.asarray(state["values"]).copy()
-            grad_norms = np.asarray(state["grad_norms"]).copy()
+            (w, g, hist, it, passes, f, f_prev, gnorm0, values,
+             grad_norms) = self._restore(state, put_rep)
             z = stream_scores(w)  # scores rebuild from w: one pass
             passes += 1
         else:
@@ -587,6 +644,167 @@ class OutOfCoreLBFGS:
         )
 
 
+@dataclasses.dataclass(frozen=True)
+class OutOfCoreOWLQN(OutOfCoreLBFGS):
+    """Host-loop OWL-QN over a :class:`ChunkedGLMData` — L1/elastic-net at
+    beyond-HBM scale (BASELINE config 2; SURVEY.md §2.1 OWL-QN).
+
+    Same Andrew & Gao (2007) semantics as the in-core ``optim/owlqn.py``
+    (pseudo-gradient, smooth-gradient history, direction alignment, orthant
+    projection of trial points, Armijo on the total objective via the
+    projected displacement, same constants), so in-core and out-of-core
+    solves agree to numerical noise — tested.
+
+    The one structural difference from :class:`OutOfCoreLBFGS`: the orthant
+    projection makes a trial point a NONLINEAR function of the step size
+    (clipped coordinates pin to zero), so the resident direction margins
+    ``zd`` cannot price a probe — each line-search probe streams one scores
+    pass. Probes are value-only (the in-core path computes a fused
+    value+grad per probe = 2 passes), so a typical accept-at-t=1 iteration
+    costs probe + gradient = 2 streamed passes, identical to the smooth
+    solver. Everything else (mesh row-sharding, per-iteration checkpoints,
+    λ-sweep kernel reuse) is inherited.
+
+    ``l1_weight`` scales ``reg_mask`` (ones if absent) into the
+    per-coefficient L1 vector — the intercept stays unpenalized exactly as
+    in-core ``GLMOptimizationProblem.run`` builds ``l1 * mask``.
+    """
+
+    l1_weight: float = 0.0
+
+    def _l1_vec(self, w: Array) -> Array:
+        if self.reg_mask is None:
+            return jnp.full_like(w, self.l1_weight)
+        return self.l1_weight * self.reg_mask.astype(w.dtype)
+
+    def optimize(self, data: ChunkedGLMData, x0: Array) -> OptimizerResult:
+        cfg = self.config
+        dim = data.dim
+        put_rep, stream_scores, data_value, stream_grad = self._streams(data)
+
+        w = put_rep(jnp.asarray(x0, jnp.float32))
+        l2v = self._l2_vec(w)
+        l1v = self._l1_vec(w)
+
+        def total_at(wv, z_chunks):
+            """Total objective (data + L2 + L1) from resident margins."""
+            return (
+                data_value(z_chunks)
+                + 0.5 * jnp.sum(l2v * wv * wv)
+                + jnp.sum(l1v * jnp.abs(wv))
+            )
+
+        def smooth_fg(wv, z_chunks):
+            """Fused (total objective, SMOOTH gradient) — one streamed
+            pass. History and pseudo-gradient both want the smooth grad
+            (data + L2), per Andrew & Gao."""
+            fd, gd = stream_grad(z_chunks)
+            f = (fd + 0.5 * jnp.sum(l2v * wv * wv)
+                 + jnp.sum(l1v * jnp.abs(wv)))
+            return f, gd + l2v * wv
+
+        max_it = cfg.max_iterations
+        ckpt_tag = self._ckpt_tag(
+            data, "ooc-owlqn-v1", extra=f"{self.l1_weight}:"
+        )
+        state = self._load_checkpoint(ckpt_tag, dim)
+        if state is not None:
+            (w, g, hist, it, passes, f, f_prev, gnorm0, values,
+             grad_norms) = self._restore(state, put_rep)
+            z = stream_scores(w)  # scores rebuild from w: one pass
+            passes += 1
+        else:
+            z = stream_scores(w)
+            f, g = smooth_fg(w, z)
+            passes = 2
+            gnorm0 = jnp.linalg.norm(pseudo_gradient(w, g, l1v))
+            hist = empty_history(cfg.history_length, dim, jnp.float32)
+            values = np.full(max_it + 1, np.inf, np.float32)
+            grad_norms = np.full(max_it + 1, np.inf, np.float32)
+            values[0] = float(f)
+            grad_norms[0] = float(gnorm0)
+            it = 0
+            f_prev = jnp.asarray(jnp.inf, jnp.float32)
+
+        reason = NOT_CONVERGED
+        last_save = float("-inf")
+        while True:
+            pg = pseudo_gradient(w, g, l1v)
+            reason = int(check_convergence(
+                jnp.asarray(it), f_prev, f, jnp.linalg.norm(pg), gnorm0, cfg
+            ))
+            if reason != NOT_CONVERGED:
+                break
+            if it >= max_it:
+                reason = MAX_ITERATIONS
+                break
+            d = two_loop_direction(pg, hist)
+            # Orthant alignment: zero components disagreeing with -pg;
+            # steepest descent if alignment annihilated the direction.
+            d = jnp.where(d * (-pg) > 0.0, d, 0.0)
+            if float(jnp.dot(d, d)) == 0.0:
+                d = -pg
+            xi = orthant(w, pg)
+
+            # Backtracking Armijo on the TOTAL objective with orthant
+            # projection of each trial point — one streamed scores pass
+            # per probe (see class doc). Same constants as in-core.
+            t, accept = 1.0, False
+            xt = w
+            zt = z
+            ft = f
+            for _ in range(cfg.max_line_search_iterations):
+                xt = jnp.where((w + t * d) * xi >= 0.0, w + t * d, 0.0)
+                zt = stream_scores(xt)
+                passes += 1
+                ft = total_at(xt, zt)
+                decrease = jnp.dot(pg, xt - w)
+                if bool(jnp.isfinite(ft)) and float(ft) <= float(
+                    f + 1e-4 * decrease
+                ):
+                    accept = True
+                    break
+                t *= 0.5
+            if not accept and bool(jnp.isfinite(ft)) and float(ft) < float(f):
+                accept = True  # smallest probed step still decreases f
+            if not accept:
+                reason = FUNCTION_VALUES_CONVERGED
+                break
+            s = xt - w
+            w = xt
+            z = zt
+            f_prev = f
+            f, g_new = smooth_fg(w, z)
+            passes += 1
+            hist = update_history(hist, s, g_new - g)
+            g = g_new
+            it += 1
+            values[it] = float(f)
+            grad_norms[it] = float(
+                jnp.linalg.norm(pseudo_gradient(w, g, l1v))
+            )
+            now = time.monotonic()
+            if it == 1 or now - last_save >= self.checkpoint_min_interval_s:
+                self._save_checkpoint(ckpt_tag, w, g, hist, it, passes, f,
+                                      f_prev, gnorm0, values, grad_norms)
+                last_save = now
+            if self.progress is not None:
+                self.progress(it, values[it], grad_norms[it], passes)
+
+        self._save_checkpoint(ckpt_tag, w, g, hist, it, passes, f,
+                              f_prev, gnorm0, values, grad_norms)
+        return OptimizerResult(
+            x=w,
+            value=f,
+            grad_norm=jnp.linalg.norm(pseudo_gradient(w, g, l1v)),
+            iterations=jnp.asarray(it, jnp.int32),
+            converged_reason=jnp.asarray(reason, jnp.int32),
+            values=jnp.asarray(values),
+            grad_norms=jnp.asarray(grad_norms),
+            data_passes=jnp.asarray(passes, jnp.int32),
+        )
+
+
 def scores_out_of_core(data: ChunkedGLMData, w) -> np.ndarray:
     """Streamed scores z = Xw + offsets for every (true) row — the chunked
     analogue of ``GeneralizedLinearModel.compute_score``. Reuses the cached
@@ -604,27 +822,20 @@ def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
                     progress=None, checkpoint_path=None, mesh=None,
                     data_axis="data"):
     """Problem-level entry mirroring ``GLMOptimizationProblem.run`` for the
-    out-of-core path: same task→loss mapping, L2/reg-mask semantics, and
-    ``(GLMModel, OptimizerResult)`` return. Variance NONE only (SIMPLE/FULL
-    need in-core Hessian passes); any L1 component (L1/ELASTIC_NET) raises
-    — the in-core run() raises for smooth optimizers there too, and
-    silently training the L2 part alone would return wrong coefficients."""
+    out-of-core path: same task→loss mapping, regularization/reg-mask
+    semantics, and ``(GLMModel, OptimizerResult)`` return. LBFGS handles
+    smooth L2; OWLQN handles any L1 component (L1/ELASTIC_NET) — the same
+    optimizer↔regularization pairing rules as in-core run(): an L1
+    component under a smooth optimizer raises (silently training the L2
+    part alone would return wrong coefficients). Variance NONE only
+    (SIMPLE/FULL need in-core Hessian passes)."""
     from photon_tpu.models.coefficients import Coefficients
     from photon_tpu.models.glm import GeneralizedLinearModel
     from photon_tpu.ops.losses import loss_for_task
     from photon_tpu.optim import OptimizerType
 
-    if problem.optimizer_type != OptimizerType.LBFGS:
-        raise NotImplementedError(
-            "out-of-core training supports LBFGS (smooth L2) only; "
-            f"got {problem.optimizer_type}"
-        )
-    if problem.regularization.l1_weight(float(problem.reg_weight)) > 0.0:
-        raise NotImplementedError(
-            "out-of-core training is smooth-L2 only; "
-            f"{problem.regularization.reg_type.name} has an L1 component"
-        )
-    solver = OutOfCoreLBFGS(
+    l1 = problem.regularization.l1_weight(float(problem.reg_weight))
+    common = dict(
         loss=loss_for_task(problem.task),
         l2_weight=problem.regularization.l2_weight(float(problem.reg_weight)),
         reg_mask=reg_mask,
@@ -634,6 +845,21 @@ def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
         mesh=mesh,
         data_axis=data_axis,
     )
+    if problem.optimizer_type == OptimizerType.OWLQN:
+        solver = OutOfCoreOWLQN(l1_weight=l1, **common)
+    elif problem.optimizer_type != OptimizerType.LBFGS:
+        raise NotImplementedError(
+            "out-of-core training supports LBFGS (smooth L2) and OWLQN "
+            f"(L1/elastic-net) only; got {problem.optimizer_type}"
+        )
+    elif l1 > 0.0:
+        raise NotImplementedError(
+            "L1 components need an orthant-wise optimizer: use "
+            "OptimizerType.OWLQN out-of-core, same as the in-core rule; "
+            f"got LBFGS with {problem.regularization.reg_type.name}"
+        )
+    else:
+        solver = OutOfCoreLBFGS(**common)
     if w0 is None:
         w0 = jnp.zeros((data.dim,), jnp.float32)
     result = solver.optimize(data, w0)

@@ -124,14 +124,14 @@ def test_out_of_core_value_dtype_and_budget_helpers():
     assert np.isfinite(float(r.value))
 
 
-def test_out_of_core_rejects_non_lbfgs():
+def test_out_of_core_rejects_tron():
     idx, val, labels = _data(n=100, seed=7)
     data = ChunkedGLMData.from_arrays(idx, val, labels, 150)
     problem = GLMOptimizationProblem(
         task=TaskType.LOGISTIC_REGRESSION,
-        optimizer_type=OptimizerType.OWLQN,
+        optimizer_type=OptimizerType.TRON,
         optimizer_config=OptimizerConfig(max_iterations=10),
-        regularization=RegularizationContext(RegularizationType.L1),
+        regularization=RegularizationContext(RegularizationType.L2),
         reg_weight=1.0,
     )
     with pytest.raises(NotImplementedError):
@@ -452,3 +452,209 @@ def test_mesh_streaming_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(
         np.asarray(res.x), np.asarray(ref.x), rtol=2e-2, atol=5e-3
     )
+
+
+# -- OWL-QN out-of-core (L1/elastic-net at beyond-HBM scale) ----------------
+
+
+def _owlqn_problem(task, reg, reg_weight=0.05, max_iter=150, alpha=0.5):
+    from photon_tpu.optim.regularization import elastic_net_context
+
+    if reg == RegularizationType.ELASTIC_NET:
+        ctx = elastic_net_context(alpha)
+    else:
+        ctx = RegularizationContext(reg)
+    return GLMOptimizationProblem(
+        task=task,
+        optimizer_type=OptimizerType.OWLQN,
+        optimizer_config=OptimizerConfig(max_iterations=max_iter,
+                                         tolerance=1e-9),
+        regularization=ctx,
+        reg_weight=reg_weight,
+    )
+
+
+@pytest.mark.parametrize("task", [
+    TaskType.LOGISTIC_REGRESSION,
+    TaskType.LINEAR_REGRESSION,
+    TaskType.POISSON_REGRESSION,
+    TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM,
+])
+def test_owlqn_out_of_core_matches_in_core(task):
+    """OOC OWL-QN reproduces the in-core orthant-wise solve on all four
+    losses: same pseudo-gradient/alignment/projection semantics, the only
+    difference is streamed (value-only) line-search probes.
+
+    The hinge case runs under ELASTIC_NET and binary labels: with L1 only,
+    the piecewise-quadratic hinge objective has near-flat directions, so
+    two float-reassociated trajectories legitimately reach value-equal but
+    coefficient-different optima — the L2 component pins the optimum."""
+    svm = task == TaskType.SMOOTHED_HINGE_LOSS_LINEAR_SVM
+    idx, val, labels = _data(
+        n=600, task=TaskType.LOGISTIC_REGRESSION if svm else task, seed=31
+    )
+    dim = 150
+    problem = _owlqn_problem(
+        task,
+        RegularizationType.ELASTIC_NET if svm else RegularizationType.L1,
+    )
+
+    batch = LabeledBatch(
+        features=SparseFeatures(idx=jnp.asarray(idx), val=jnp.asarray(val),
+                                dim=dim),
+        labels=jnp.asarray(labels),
+        offsets=jnp.zeros((len(labels),), jnp.float32),
+        weights=jnp.ones((len(labels),), jnp.float32),
+    )
+    m_in, r_in = problem.run(batch, jnp.zeros((dim,), jnp.float32))
+    data = ChunkedGLMData.from_arrays(idx, val, labels, dim, chunk_rows=256)
+    m_out, r_out = run_out_of_core(problem, data)
+
+    # rel 5e-4, not 1e-4: the streamed per-chunk reduction reassociates
+    # float32 sums, and on a NON-smooth objective a 1-ulp line-search
+    # difference can flip a coordinate's orthant and legitimately land on a
+    # near-tied endpoint (observed: OOC ~1e-4 BELOW in-core on poisson and
+    # hinge). The zero-set agreement below is the real semantic check.
+    assert float(r_out.value) == pytest.approx(float(r_in.value), rel=5e-4)
+    np.testing.assert_allclose(np.asarray(m_out.coefficients.means),
+                               np.asarray(m_in.coefficients.means),
+                               rtol=1e-2, atol=1e-2)
+    # Both paths must agree on WHICH coefficients die (the orthant
+    # machinery's signature). λ=0.05 sparsifies the logistic/linear fits
+    # (asserted — a regression that stops zeroing coordinates must fail);
+    # the poisson/hinge gradients are larger and keep every coordinate
+    # alive at this λ, so only the agreement check binds there.
+    z_in = np.asarray(m_in.coefficients.means) == 0.0
+    z_out = np.asarray(m_out.coefficients.means) == 0.0
+    if task in (TaskType.LOGISTIC_REGRESSION, TaskType.LINEAR_REGRESSION):
+        assert z_in.sum() > 0
+    assert (z_in == z_out).mean() > 0.95
+
+
+def test_owlqn_out_of_core_elastic_net_and_mask():
+    """Elastic net splits λ into L1/L2 parts; a reg mask exempts column 0
+    from BOTH penalties (the intercept convention)."""
+    from photon_tpu.optim.out_of_core import OutOfCoreOWLQN
+
+    idx, val, labels = _data(n=500, seed=32)
+    dim = 150
+    problem = _owlqn_problem(
+        TaskType.LOGISTIC_REGRESSION, RegularizationType.ELASTIC_NET,
+        reg_weight=0.1,
+    )
+    mask = jnp.ones((dim,), jnp.float32).at[0].set(0.0)
+    batch = LabeledBatch(
+        features=SparseFeatures(idx=jnp.asarray(idx), val=jnp.asarray(val),
+                                dim=dim),
+        labels=jnp.asarray(labels),
+        offsets=jnp.zeros((len(labels),), jnp.float32),
+        weights=jnp.ones((len(labels),), jnp.float32),
+    )
+    m_in, r_in = problem.run(batch, jnp.zeros((dim,), jnp.float32),
+                             reg_mask=mask)
+    data = ChunkedGLMData.from_arrays(idx, val, labels, dim, chunk_rows=128)
+    m_out, r_out = run_out_of_core(problem, data, reg_mask=mask)
+    assert float(r_out.value) == pytest.approx(float(r_in.value), rel=1e-4)
+    np.testing.assert_allclose(np.asarray(m_out.coefficients.means),
+                               np.asarray(m_in.coefficients.means),
+                               rtol=1e-2, atol=1e-2)
+    # The solver facade agrees with the problem-level entry.
+    from photon_tpu.ops.losses import loss_for_task
+
+    direct = OutOfCoreOWLQN(
+        loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+        l2_weight=0.05, l1_weight=0.05, reg_mask=mask,
+        config=OptimizerConfig(max_iterations=150, tolerance=1e-9),
+    ).optimize(data, jnp.zeros((dim,), jnp.float32))
+    assert float(direct.value) == pytest.approx(float(r_out.value), rel=1e-6)
+
+
+def test_owlqn_out_of_core_checkpoint_resume(tmp_path):
+    """A killed OOC OWL-QN solve resumes at iteration k and reaches the
+    uninterrupted optimum; a different λ never cross-resumes."""
+    from photon_tpu.ops.losses import loss_for_task
+    from photon_tpu.optim.out_of_core import OutOfCoreOWLQN
+
+    idx, val, labels = _data(n=400, seed=33)
+    data = ChunkedGLMData.from_arrays(idx, val, labels, 150, chunk_rows=128)
+    ck = str(tmp_path / "ck.npz")
+
+    def solver(path=None, l1=0.05):
+        return OutOfCoreOWLQN(
+            loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+            l2_weight=0.1, l1_weight=l1,
+            config=OptimizerConfig(max_iterations=80, tolerance=1e-9),
+            checkpoint_path=path, checkpoint_min_interval_s=0.0,
+        )
+
+    w0 = jnp.zeros((150,), jnp.float32)
+    ref = solver().optimize(data, w0)
+
+    class _Stop(Exception):
+        pass
+
+    def bomb(it, f, gn, p):
+        if it >= 3:
+            raise _Stop
+
+    with pytest.raises(_Stop):
+        dataclasses.replace(solver(ck), progress=bomb).optimize(data, w0)
+    st = np.load(ck, allow_pickle=False)
+    assert int(st["it"]) == 3
+    res = solver(ck).optimize(data, w0)
+    assert float(res.value) == pytest.approx(float(ref.value), rel=1e-5)
+    np.testing.assert_allclose(np.asarray(res.x), np.asarray(ref.x),
+                               rtol=2e-3, atol=1e-4)
+    # Different l1 weight: fresh solve, not a stale resume.
+    other = solver(ck, l1=0.5).optimize(data, w0)
+    fresh = solver(l1=0.5).optimize(data, w0)
+    np.testing.assert_allclose(np.asarray(other.x), np.asarray(fresh.x),
+                               rtol=2e-3, atol=1e-4)
+
+
+def test_owlqn_out_of_core_mesh_matches_single_device():
+    """OWL-QN streams row-sharded over a data mesh exactly like the smooth
+    solver (orthant machinery is replicated coefficient-space math)."""
+    from photon_tpu.ops.losses import loss_for_task
+    from photon_tpu.optim.out_of_core import OutOfCoreOWLQN
+    from photon_tpu.parallel.mesh import make_mesh
+
+    idx, val, labels = _data(n=512, seed=34)
+    data = ChunkedGLMData.from_arrays(idx, val, labels, 150, chunk_rows=128)
+
+    def solve(mesh=None):
+        return OutOfCoreOWLQN(
+            loss=loss_for_task(TaskType.LOGISTIC_REGRESSION),
+            l2_weight=0.1, l1_weight=0.05,
+            config=OptimizerConfig(max_iterations=40, tolerance=1e-7),
+            mesh=mesh,
+        ).optimize(data, jnp.zeros((150,), jnp.float32))
+
+    ref = solve()
+    res = solve(make_mesh({"data": 8}))
+    assert float(res.value) == pytest.approx(float(ref.value), rel=1e-5)
+    np.testing.assert_allclose(np.asarray(res.x), np.asarray(ref.x),
+                               rtol=2e-2, atol=5e-3)
+
+
+def test_glm_driver_out_of_core_owlqn(tmp_path):
+    """--optimizer OWLQN --regularization L1 routes through the OOC path
+    (auto-router accepts the pairing) and trains a model that scores."""
+    from tests.test_drivers import _write_game_avro
+    from photon_tpu.cli import glm_training_driver
+
+    d = tmp_path / "data"
+    d.mkdir()
+    _write_game_avro(d / "train.avro", seed=35, n_users=6, rows_per_user=40)
+    s = glm_training_driver.run([
+        "--train-data", str(d / "train.avro"),
+        "--output-dir", str(tmp_path / "out"),
+        "--task", "LOGISTIC_REGRESSION",
+        "--optimizer", "OWLQN", "--regularization", "L1",
+        "--reg-weights", "0.1",
+        "--max-iterations", "60",
+        "--normalization", "NONE", "--variance", "NONE",
+        "--no-report", "--row-chunk-rows", "64",
+    ])
+    assert s["mode"] == "out_of_core"
+    assert s["evaluation"]["AUC"] > 0.5
