@@ -29,6 +29,19 @@ from photon_tpu.types import TaskType
 
 Array = jax.Array
 
+# Per-bucket record of the MOST RECENT train_random_effects call:
+# [{bucket, entities, entities_padded, rows, local_dim, solver,
+#   h2d_seconds, solve_seconds}]. Module-level on purpose — host_resident
+# streaming makes the H2D-vs-solve split the number that decides whether
+# bucket streaming is overhead-bound (VERDICT r4 ask #3's "per-bucket
+# H2D/solve timing"); the dress rehearsal and profiling scripts read it
+# after a fit without threading a collector through the estimator stack.
+# The TIMING fields are populated only under PHOTON_RE_TIMINGS=1: splitting
+# H2D from solve needs two blocking device syncs per bucket, which would
+# serialize the transfer/compute overlap of every production sweep — the
+# solver-choice fields cost nothing and are always recorded.
+LAST_BUCKET_TIMINGS: list = []
+
 
 @dataclasses.dataclass(frozen=True)
 class RandomEffectModel:
@@ -266,11 +279,17 @@ def train_random_effects(
     """
     from photon_tpu.data.normalization import project_context
 
+    import os as _os
+    import time as _time
+
     coefs_out, var_out, results = [], [], []
     want_var = problem.variance_type.name != "NONE"
+    LAST_BUCKET_TIMINGS.clear()
+    _want_timings = _os.environ.get("PHOTON_RE_TIMINGS") == "1"
 
     for b_i, bucket in enumerate(dataset.buckets):
         orig_e = bucket.n_entities
+        _t_start = _time.perf_counter()
         if mesh is not None:
             axis_size = axes_size(mesh, entity_axis)
             bucket = _pad_bucket(bucket, axis_size, dataset.n_rows, dataset.global_dim)
@@ -317,13 +336,76 @@ def train_random_effects(
             local_norm = jax.tree.map(shard, local_norm)
             local_prior = jax.tree.map(shard, local_prior)
 
-        models, result = _fit_bucket_jitted(
-            problem, batches, w0, local_mask, local_norm, local_prior
+        # Smooth solves take a history-free batched Newton fast path
+        # (game/newton_re.py): primal dense Newton for small local dims,
+        # span-reduced (dual) Newton for the canonical few-rows-in-a-wide-
+        # subspace regime. Both replace the vmapped L-BFGS while_loop whose
+        # O(E·m·P) history traffic dominates the RE step (VERDICT r4 weak
+        # #3; measured: halving m halves the step). Same optimum, same
+        # result pytree; the gates fall back for L1/normalization/etc.
+        from photon_tpu.game.newton_re import (
+            dual_eligible,
+            fit_bucket_newton,
+            fit_bucket_newton_dual,
+            newton_eligible,
         )
+
+        # H2D boundary: with host_resident buckets the arrays above are
+        # still host numpy; under PHOTON_RE_TIMINGS=1 force the transfer
+        # here (tiny D2H fetch as the sync — block_until_ready does not
+        # synchronize on the axon tunnel backend) to split per-bucket time
+        # into transfer vs solve. NOT default: the two syncs per bucket
+        # would serialize the async dispatcher's transfer/compute overlap.
+        if _want_timings:
+            batches = jax.tree.map(jnp.asarray, batches)
+            np.asarray(batches.features.val.ravel()[:1])
+        _t_h2d = _time.perf_counter()
+
+        if newton_eligible(problem, bucket, normalization):
+            solver_used = "newton_primal"
+            models, result = fit_bucket_newton(
+                problem, batches, w0, local_mask, local_prior
+            )
+        else:
+            # u_max (static for jit): shared penalty_terms definition so
+            # the gate's zero-count and the dual solver's D⁺ can never
+            # disagree on which columns are unpenalized.
+            from photon_tpu.game.newton_re import penalty_terms, u_max_for
+
+            u_max = u_max_for(
+                penalty_terms(problem, local_mask, local_prior)[3]
+            )
+            if dual_eligible(problem, bucket, normalization, u_max):
+                solver_used = "newton_dual"
+                models, result = fit_bucket_newton_dual(
+                    problem, batches, w0, local_mask, local_prior, u_max
+                )
+            else:
+                solver_used = "vmapped_lbfgs"
+                models, result = _fit_bucket_jitted(
+                    problem, batches, w0, local_mask, local_norm, local_prior
+                )
         coefs_out.append(models.coefficients.means[:orig_e])
         if want_var:
             var_out.append(models.coefficients.variances[:orig_e])
         results.append(jax.tree.map(lambda a: a[:orig_e], result))
+        if _want_timings:
+            np.asarray(coefs_out[-1][:1])  # completed-solve sync
+        _t_solve = _time.perf_counter()
+        LAST_BUCKET_TIMINGS.append({
+            "bucket": b_i,
+            "entities": orig_e,
+            "entities_padded": e,
+            "rows": int(bucket.max_samples) * orig_e,
+            "local_dim": p,
+            "solver": solver_used,
+            # Without the sync gate these splits would time async dispatch,
+            # not work — record them only when they mean something.
+            "h2d_seconds": round(_t_h2d - _t_start, 3)
+            if _want_timings else None,
+            "solve_seconds": round(_t_solve - _t_h2d, 3)
+            if _want_timings else None,
+        })
 
     model = RandomEffectModel(
         re_type=dataset.re_type,

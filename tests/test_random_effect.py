@@ -20,15 +20,19 @@ from photon_tpu.types import TaskType
 L2 = RegularizationContext(RegularizationType.L2)
 
 
-def _make_entity_data(rng, n_entities=9, global_dim=50, k=6):
+def _make_entity_data(rng, n_entities=9, global_dim=50, k=6,
+                      max_rows=40, min_support=4):
     """Rows with entity keys; per-entity sample counts vary to force several
-    buckets. Returns global ELL arrays + per-row entity keys."""
-    rows_per_entity = rng.integers(3, 40, size=n_entities)
+    buckets. Returns global ELL arrays + per-row entity keys.
+    ``max_rows``/``min_support`` shape the S-vs-P regime: small rows with
+    wide support puts every bucket in the dual-Newton (S < P) regime."""
+    rows_per_entity = rng.integers(3, max_rows, size=n_entities)
     idx_rows, val_rows, labels, keys = [], [], [], []
     true_w = rng.normal(size=(n_entities, global_dim))
     for e in range(n_entities):
         # each entity touches its own feature subset
-        support = rng.choice(global_dim, size=rng.integers(4, 12), replace=False)
+        support = rng.choice(
+            global_dim, size=rng.integers(min_support, 12), replace=False)
         for _ in range(rows_per_entity[e]):
             nnz = rng.integers(2, k + 1)
             cols = rng.choice(support, size=min(nnz, len(support)), replace=False)
@@ -95,18 +99,45 @@ def test_dataset_structure(rng):
             assert np.all(np.diff(cols) > 0)
 
 
-def test_vmapped_solves_match_individual(rng, problem):
-    idx, val, labels, keys = _make_entity_data(rng)
+@pytest.mark.parametrize("newton", ["0", "1", "dual"])
+def test_vmapped_solves_match_individual(rng, problem, monkeypatch, newton):
+    """Each entity's bucket solve matches fitting that entity alone.
+
+    newton=0 pins the general vmapped-L-BFGS path — SAME algorithm both
+    sides, so near-bit parity (atol 1e-6) guards the masked-lane semantics.
+    newton=1 exercises the primal dense-Newton fast path and newton=dual
+    the span-reduced dual path (game/newton_re.py) — different solvers for
+    the same strongly convex objective; both stop at the same
+    RELATIVE-gradient tolerance, so coefficients agree to ~tol·cond —
+    compared at optimizer tolerance (atol 2e-4), not parity."""
+    monkeypatch.setenv("PHOTON_RE_NEWTON", newton)
+    # The dual case gets few-rows/wide-support data so every entity sits
+    # in its S < P eligibility regime (wide-row buckets would silently
+    # fall back and the path would be tested by nothing).
+    data_kw = dict(max_rows=5, min_support=8) if newton == "dual" else {}
+    idx, val, labels, keys = _make_entity_data(rng, **data_kw)
     ds = build_random_effect_dataset(
         "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
     offsets = np.zeros(ds.n_rows)
     model, results = train_random_effects(problem, ds, jnp.asarray(offsets))
     assert len(model.bucket_coefs) == len(ds.buckets)
+    # The parametrization must actually exercise the intended solver — a
+    # silent eligibility fallback would leave a path tested by nothing.
+    from photon_tpu.game.random_effect import LAST_BUCKET_TIMINGS
+
+    solvers = {t["solver"] for t in LAST_BUCKET_TIMINGS}
+    assert solvers == {
+        "0": {"vmapped_lbfgs"},
+        "1": {"newton_primal"},
+        "dual": {"newton_dual"},
+    }[newton], solvers
     for dense_id in range(0, ds.n_entities, 3):  # spot-check a third
         b_i, lane = ds.entity_to_slot[dense_id]
         got = np.asarray(model.bucket_coefs[b_i][lane])
         want = _fit_single_entity(problem, ds, offsets, dense_id)
-        np.testing.assert_allclose(got, want, atol=1e-6)
+        np.testing.assert_allclose(
+            got, want, atol=1e-6 if newton == "0" else 2e-4
+        )
 
 
 def test_scores_match_manual(rng, problem):
@@ -125,7 +156,15 @@ def test_scores_match_manual(rng, problem):
         np.testing.assert_allclose(scores[r], expect, atol=1e-5)
 
 
-def test_mesh_sharded_matches_single_device(rng, problem):
+@pytest.mark.parametrize("newton", ["0", "1"])
+def test_mesh_sharded_matches_single_device(rng, problem, monkeypatch,
+                                            newton):
+    """newton=0: the vmapped path is lane-local, so sharding must reproduce
+    the single-device solve to 1e-8 (the sharding-semantics regression
+    check). newton=1: entity padding + GSPMD retile the batched f32
+    reductions, which can flip an Armijo boundary — runs agree at
+    convergence tolerance, same optimum."""
+    monkeypatch.setenv("PHOTON_RE_NEWTON", newton)
     idx, val, labels, keys = _make_entity_data(rng, n_entities=11)
     ds = build_random_effect_dataset(
         "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
@@ -134,7 +173,10 @@ def test_mesh_sharded_matches_single_device(rng, problem):
     mesh = make_mesh()
     m_mesh, _ = train_random_effects(problem, ds, offsets, mesh=mesh)
     for a, b in zip(m_single.bucket_coefs, m_mesh.bucket_coefs):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-8)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0,
+            atol=1e-8 if newton == "0" else 2e-4,
+        )
 
 
 def test_active_passive_split(rng, problem):
@@ -414,12 +456,19 @@ class TestVectorizedBuilderEquivalence:
                     np.asarray(getattr(ba, f)), np.asarray(getattr(bb, f)), err_msg=f)
 
 
-def test_multislice_entity_sharding_matches_single_device(rng, problem):
+@pytest.mark.parametrize("newton", ["0", "1"])
+def test_multislice_entity_sharding_matches_single_device(
+    rng, problem, monkeypatch, newton
+):
     """Entities spread over a 2-level (dcn x data) mesh — expert-style
-    sharding across slices x chips — must reproduce the single-device
-    per-entity solves exactly (SURVEY.md §2.6 P2/P6 at multi-slice scale)."""
+    sharding across slices x chips — reproduce the single-device per-entity
+    solves: exactly on the lane-local vmapped path (newton=0), at
+    convergence tolerance on the dense-Newton path (newton=1; padding +
+    GSPMD retile its batched f32 reductions — see the single-mesh test).
+    (SURVEY.md §2.6 P2/P6 at multi-slice scale.)"""
     from photon_tpu.parallel.mesh import make_multislice_mesh
 
+    monkeypatch.setenv("PHOTON_RE_NEWTON", newton)
     idx, val, labels, keys = _make_entity_data(rng, n_entities=13)
     ds = build_random_effect_dataset(
         "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
@@ -429,7 +478,10 @@ def test_multislice_entity_sharding_matches_single_device(rng, problem):
     m_ms, _ = train_random_effects(
         problem, ds, offsets, mesh=mesh, entity_axis=("dcn", "data"))
     for a, b in zip(m_single.bucket_coefs, m_ms.bucket_coefs):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-8)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0,
+            atol=1e-8 if newton == "0" else 2e-4,
+        )
 
 
 class TestScaleControls:
@@ -497,3 +549,52 @@ class TestScaleControls:
         )
         s = np.asarray(model.score_dataset(ds))
         assert s.shape == (n,) and np.isfinite(s).all()
+
+def test_newton_fast_path_priors_and_variances(rng):
+    """The dense-Newton bucket solver handles Gaussian priors and
+    SIMPLE/FULL variances with the same semantics as the general path
+    (priors are quadratic — exact in the Hessian; variances derive from
+    the final Hessian with GLMOptimizationProblem._variances' formulas)."""
+    import os
+
+    from photon_tpu.functions.problem import VarianceComputationType
+    from photon_tpu.game.random_effect import train_random_effects as fit
+
+    idx, val, labels, keys = _make_entity_data(rng, n_entities=7)
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=50, dtype=np.float64)
+    offsets = jnp.zeros(ds.n_rows)
+
+    for vtype in (VarianceComputationType.SIMPLE,
+                  VarianceComputationType.FULL):
+        p = GLMOptimizationProblem(
+            task=TaskType.LOGISTIC_REGRESSION,
+            optimizer_config=OptimizerConfig(max_iterations=60),
+            regularization=L2, reg_weight=0.5, variance_type=vtype,
+        )
+        m0, _ = fit(p, ds, offsets)
+        priors = m0.project_prior_to(ds, incremental_weight=2.0)
+
+        def both(env):
+            old = os.environ.get("PHOTON_RE_NEWTON")
+            os.environ["PHOTON_RE_NEWTON"] = env
+            try:
+                return fit(p, ds, offsets, priors=priors)
+            finally:
+                if old is None:
+                    os.environ.pop("PHOTON_RE_NEWTON", None)
+                else:
+                    os.environ["PHOTON_RE_NEWTON"] = old
+
+        m_newton, _ = both("1")
+        m_general, _ = both("0")
+        for a, b in zip(m_newton.bucket_coefs, m_general.bucket_coefs):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-4
+            )
+        assert m_newton.bucket_variances is not None
+        for a, b in zip(m_newton.bucket_variances,
+                        m_general.bucket_variances):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4
+            )
