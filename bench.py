@@ -1268,8 +1268,24 @@ def main():
                 details["backend"] = jax.default_backend()
             except Exception:
                 pass
-            if details.get("backend") not in (None, *REAL_ACCELERATOR_BACKENDS):
+            # HARD-CODED tuple, deliberately not REAL_ACCELERATOR_BACKENDS:
+            # the fake-window rehearsal widens that allowlist to include
+            # "cpu", and the one thing no flag may ever disable is the
+            # diversion that keeps CPU-contaminated numbers out of the
+            # banked real-chip artifact.
+            if details.get("backend") not in (None, "tpu", "axon"):
                 target = details_path + ".contaminated"
+        elif SMOKE:
+            # Smoke artifacts carry the live backend too: the fake-window
+            # automation rehearsal gates its bench_complete check on an
+            # honest stamp, and a smoke file can never be mistaken for the
+            # real artifact (its NAME is .smoke.json).
+            try:
+                import jax
+
+                details["backend"] = jax.default_backend()
+            except Exception:  # noqa: BLE001
+                pass
         details["written_at"] = time.strftime(
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         )

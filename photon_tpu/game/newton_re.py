@@ -133,18 +133,32 @@ def newton_eligible(problem, bucket, normalization) -> bool:
     return need <= _budget_bytes()
 
 
-def dual_eligible(problem, bucket, normalization, u_max: int) -> bool:
-    """True when this bucket may take the span-reduced Newton path."""
+def dual_precheck(problem, bucket, normalization) -> bool:
+    """The CHEAP dual-path gates — everything that does not need u_max.
+    Callers check this FIRST: computing u_max is a device reduction + D2H
+    sync per bucket, and paying it for a bucket that can never take the
+    dual path (L1 run, wide rows, FULL variance) would serialize the
+    streaming loop's transfer/compute overlap for nothing."""
     if not _smooth_ok(problem, normalization):
         return False
     from photon_tpu.functions.problem import VarianceComputationType
 
     if problem.variance_type == VarianceComputationType.FULL:
         return False  # diag(H^-1) needs the [P,P] primal Hessian
+    _, s, _ = bucket.idx.shape
+    p = bucket.local_dim
+    # s+0 lower-bounds s+u_max, so this never rejects an eligible bucket.
+    return s < p and s <= DUAL_MAX_T
+
+
+def dual_eligible(problem, bucket, normalization, u_max: int) -> bool:
+    """True when this bucket may take the span-reduced Newton path."""
+    if not dual_precheck(problem, bucket, normalization):
+        return False
     e, s, _ = bucket.idx.shape
     p = bucket.local_dim
-    if s + u_max > DUAL_MAX_T or s >= p:
-        return False  # wide-row buckets: primal shapes are no larger
+    if s + u_max > DUAL_MAX_T:
+        return False
     # Dominant buffers: dense X [E,S,P+1] f32 + G/J [E,S,S+U] + probe
     # margins [12,E,S]. The dense X dominates at wide P.
     need = 4.0 * (e * s * (p + 1) + 2 * e * s * (s + u_max) + 12 * e * s)

@@ -367,15 +367,25 @@ def train_random_effects(
                 problem, batches, w0, local_mask, local_prior
             )
         else:
-            # u_max (static for jit): shared penalty_terms definition so
-            # the gate's zero-count and the dual solver's D⁺ can never
-            # disagree on which columns are unpenalized.
-            from photon_tpu.game.newton_re import penalty_terms, u_max_for
-
-            u_max = u_max_for(
-                penalty_terms(problem, local_mask, local_prior)[3]
+            # Cheap static gates FIRST: u_max is a device reduction + D2H
+            # sync per bucket, only paid once a bucket could actually take
+            # the dual path. The count uses the shared penalty_terms
+            # definition so the gate's zeros and the dual solver's D⁺ can
+            # never disagree on which columns are unpenalized.
+            from photon_tpu.game.newton_re import (
+                dual_precheck,
+                penalty_terms,
+                u_max_for,
             )
-            if dual_eligible(problem, bucket, normalization, u_max):
+
+            u_max = -1
+            if dual_precheck(problem, bucket, normalization):
+                u_max = u_max_for(
+                    penalty_terms(problem, local_mask, local_prior)[3]
+                )
+            if u_max >= 0 and dual_eligible(
+                problem, bucket, normalization, u_max
+            ):
                 solver_used = "newton_dual"
                 models, result = fit_bucket_newton_dual(
                     problem, batches, w0, local_mask, local_prior, u_max
@@ -396,7 +406,14 @@ def train_random_effects(
             "bucket": b_i,
             "entities": orig_e,
             "entities_padded": e,
-            "rows": int(bucket.max_samples) * orig_e,
+            # SLOTS, not rows: [E, S] includes per-entity padding (weight-0
+            # rows). The true row count needs a reduction over weights, so
+            # it is computed only in sync-gated timing mode.
+            "row_slots": int(bucket.max_samples) * orig_e,
+            "rows": (
+                int(float(jnp.sum(bucket.weights[:orig_e] > 0)))
+                if _want_timings else None
+            ),
             "local_dim": p,
             "solver": solver_used,
             # Without the sync gate these splits would time async dispatch,

@@ -13,6 +13,19 @@ import enum
 # completeness gate, and the drivers' device-budget auto-routing — so the
 # allowlist cannot silently diverge between writer and reader.
 REAL_ACCELERATOR_BACKENDS = ("tpu", "axon")
+# TEST-ONLY escape hatch for the fake-window automation rehearsal
+# (scripts/fake_window_rehearsal.py): lets the CPU backend masquerade as a
+# recovery window so the whole window→autopilot→bench→race chain can be
+# exercised end-to-end without a chip. Leakage containment: BOTH flags must
+# be set (the rehearsal sets both; a stray single export does nothing),
+# every artifact stamps the LIVE backend ("cpu"), and bench.py's
+# contamination diversion for the real BENCH_DETAILS.json checks the
+# hard-coded tuple, not this widened one.
+_env = __import__("os").environ
+if (_env.get("PHOTON_ACCEPT_CPU_AS_REAL") == "1"
+        and _env.get("PHOTON_AUTOPILOT_FAKE") == "1"):
+    REAL_ACCELERATOR_BACKENDS = REAL_ACCELERATOR_BACKENDS + ("cpu",)
+del _env
 
 # Type aliases mirroring the reference's Types.scala
 CoordinateId = str

@@ -192,6 +192,12 @@ def main() -> None:
                          "is host-RAM-bound, not HBM-bound; the full-shape "
                          "fixed solve runs out-of-core at --rows regardless")
     ap.add_argument("--keep-data", action="store_true")
+    ap.add_argument("--game-only", action="store_true",
+                    help="skip the full-shape OOC fixed solve and run just "
+                         "the GAME (fixed+RE) phase — in a FRESH process, "
+                         "so peak_rss_gb is the RE-streaming path's own "
+                         "footprint (ru_maxrss is monotone; a combined run "
+                         "reports the OOC phase's host-chunk peak instead)")
     args = ap.parse_args()
     if not args.tpu:
         # This image's sitecustomize force-sets jax_platforms="axon,cpu";
@@ -225,25 +231,31 @@ def main() -> None:
     shape = {"rows": args.rows, "features": args.features,
              "users": args.users, "unique_rows": args.unique_rows}
     meta_path = data + ".meta.json"
-    if not args.keep_data:
-        _DOOMED.extend([data, meta_path])
-    with phase("write_tiled_avro", args.out):
-        cached_ok = False
-        if os.path.exists(data) and os.path.exists(meta_path):
-            with open(meta_path) as f:
-                cached_ok = json.load(f) == shape
-        if not cached_ok:
-            # Never reuse a file written at a different shape: the artifact
-            # would report rows/s against rows that were never in the file.
-            n = write_tiled_avro(data, args.rows, args.features, args.users,
-                                 args.unique_rows)
-            REPORT["phases"]["write_tiled_avro"]["rows_written"] = n
-            assert n == args.rows, (n, args.rows)
-            with open(meta_path, "w") as f:
-                json.dump(shape, f)
-        REPORT["phases"]["write_tiled_avro"]["file_gb"] = round(
-            os.path.getsize(data) / 1e9, 2
-        )
+    if args.game_only and args.game_rows < args.rows:
+        # The GAME phase reads only the subset file; don't spend minutes
+        # (and 31 GB of disk) tiling the full-shape file nobody reads.
+        shape = None
+    if shape is not None:
+        if not args.keep_data:
+            _DOOMED.extend([data, meta_path])
+        with phase("write_tiled_avro", args.out):
+            cached_ok = False
+            if os.path.exists(data) and os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    cached_ok = json.load(f) == shape
+            if not cached_ok:
+                # Never reuse a file written at a different shape: the
+                # artifact would report rows/s against rows that were
+                # never in the file.
+                n = write_tiled_avro(data, args.rows, args.features,
+                                     args.users, args.unique_rows)
+                REPORT["phases"]["write_tiled_avro"]["rows_written"] = n
+                assert n == args.rows, (n, args.rows)
+                with open(meta_path, "w") as f:
+                    json.dump(shape, f)
+            REPORT["phases"]["write_tiled_avro"]["file_gb"] = round(
+                os.path.getsize(data) / 1e9, 2
+            )
 
     if args.ingest_only:
         with phase("index_build", args.out):
@@ -298,27 +310,31 @@ def main() -> None:
     # (optim/out_of_core.py): host-resident row chunks streamed per L-BFGS
     # pass. This is the end-to-end config-5-scale fixed-effect fit, on the
     # accelerator, at the full row count.
-    with phase("train_full_scale_out_of_core", args.out):
-        from photon_tpu.cli import glm_training_driver
+    if args.game_only:
+        REPORT["game_only"] = True
+    else:
+        with phase("train_full_scale_out_of_core", args.out):
+            from photon_tpu.cli import glm_training_driver
 
-        t0 = time.perf_counter()
-        s = glm_training_driver.run([
-            "--train-data", data,
-            "--output-dir", os.path.join(args.out, "model_full_ooc"),
-            "--task", "LOGISTIC_REGRESSION",
-            "--feature-shard", "global:features",
-            "--reg-weights", "1.0",
-            "--max-iterations", "10",
-            "--normalization", "NONE", "--variance", "NONE", "--no-report",
-            "--row-chunk-rows", str(1 << 21),
-        ])
-        took = time.perf_counter() - t0
-        ent = REPORT["phases"]["train_full_scale_out_of_core"]
-        ent["summary"] = {
-            k: v for k, v in s.items()
-            if isinstance(v, (int, float, str, bool, type(None)))
-        }
-        ent["rows_per_sec_end_to_end"] = round(args.rows / took, 1)
+            t0 = time.perf_counter()
+            s = glm_training_driver.run([
+                "--train-data", data,
+                "--output-dir", os.path.join(args.out, "model_full_ooc"),
+                "--task", "LOGISTIC_REGRESSION",
+                "--feature-shard", "global:features",
+                "--reg-weights", "1.0",
+                "--max-iterations", "10",
+                "--normalization", "NONE", "--variance", "NONE",
+                "--no-report",
+                "--row-chunk-rows", str(1 << 21),
+            ])
+            took = time.perf_counter() - t0
+            ent = REPORT["phases"]["train_full_scale_out_of_core"]
+            ent["summary"] = {
+                k: v for k, v in s.items()
+                if isinstance(v, (int, float, str, bool, type(None)))
+            }
+            ent["rows_per_sec_end_to_end"] = round(args.rows / took, 1)
 
     # Phase B — GAME semantics (fixed + per-user random effect) at half
     # scale by default: RE buckets are built host-resident and stream

@@ -37,8 +37,31 @@ import time
 
 import numpy as np
 
-OUT = f"/tmp/profile_sparse.{os.getuid()}.json"
+# Env-overridable so the fake-window automation rehearsal can divert its
+# CPU measurements away from the REAL banked chip ledger. A smoke run that
+# forgot the explicit override STILL must not touch the real ledger (its
+# tiny-shape entries would be cached as "measured" and the next genuine
+# recovery window would skip the on-chip profile), so smoke defaults to a
+# .smoke ledger.
+OUT = os.environ.get(
+    "PHOTON_PROFILE_SPARSE_OUT",
+    f"/tmp/profile_sparse.{os.getuid()}.smoke.json"
+    if os.environ.get("PHOTON_PROFILE_SMOKE") == "1"
+    else f"/tmp/profile_sparse.{os.getuid()}.json",
+)
 N, D, K = 1 << 19, 1 << 18, 32  # bench headline shape: 201 MB of idx+val+out
+if os.environ.get("PHOTON_PROFILE_SMOKE") == "1":
+    # Fake-window automation rehearsal: tiny shapes prove the sequencing /
+    # banking / hang-budget machinery without an hour of CPU variants. The
+    # ledger still stamps the live backend, so these numbers are
+    # self-describing (and the rehearsal diverts OUT into its sandbox).
+    N, D, K = 1 << 14, 1 << 12, 16
+    # Pin CPU via jax.config: the sitecustomize force-sets
+    # jax_platforms="axon,cpu", and a fake-window variant must never
+    # queue on (or wedge behind) the real chip's tunnel.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 VARIANT_DEADLINE_S = 600.0
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
