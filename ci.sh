@@ -15,6 +15,11 @@ export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
 
 echo "== pytest (full suite, 8-device virtual CPU mesh) =="
+# Needs ~10 GB of host-memory headroom: under co-located pressure (e.g. a
+# ~60 GB rehearsal on the same box) jax/XLA-CPU's eager dispatch ABORTS the
+# interpreter on a failed allocation instead of raising (reproduced twice at
+# tests/test_out_of_core.py::test_mesh_streaming_checkpoint_resume, clean
+# 25/25 on an idle host — docs/round5.md ask #1).
 python -m pytest tests/ -x -q
 
 if [[ "${1:-}" == "fast" ]]; then

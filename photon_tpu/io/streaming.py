@@ -73,6 +73,7 @@ _ERRORS = {
     -4: "unexpected type in data",
     -5: "missing id tag",
     -6: "nesting too deep",
+    -7: "native allocation failed (host out of memory?)",
 }
 
 
@@ -625,6 +626,12 @@ class NativeDecoder:
         r = self.lib.ph_decode_block(
             self.state, _np_ptr(arr, ctypes.c_uint8), len(payload), count
         )
+        if r == -7:
+            # bad_alloc caught at the native ABI boundary (the alternative
+            # was std::terminate -> a fatal interpreter abort). The chunk
+            # state is incoherent; the stream must abort, not continue.
+            raise MemoryError("native avro decode: allocation failed "
+                              "(host out of memory?)")
         if r < 0:
             raise SchemaError(
                 f"native avro decode failed: {_ERRORS.get(r, r)}"

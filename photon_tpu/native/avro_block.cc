@@ -29,6 +29,7 @@
 #include <cstdio>
 #include <cstring>
 #include <cmath>
+#include <memory>
 #include <string>
 #include <vector>
 
@@ -57,7 +58,7 @@ enum Op : int32_t {
 
 enum Err : int64_t {
   E_TRUNCATED = -1, E_BADVARINT = -2, E_BADUNION = -3, E_BADTYPE = -4,
-  E_TAGMISSING = -5, E_DEPTH = -6,
+  E_TAGMISSING = -5, E_DEPTH = -6, E_NOMEM = -7,
 };
 
 struct Reader {
@@ -659,6 +660,12 @@ void ph_hash_keys(const uint8_t* blob, const int64_t* offs, int64_t n, uint64_t*
   }
 }
 
+// try/catch at EVERY allocating ABI entry: a std::bad_alloc (host under
+// memory pressure — e.g. a co-located 60 GB training run) thrown through
+// the extern "C" / ctypes boundary is undefined behavior that in practice
+// reaches std::terminate -> abort -> a "Fatal Python error: Aborted" that
+// kills the whole interpreter. Allocation failure must surface as a
+// catchable Python exception (nullptr / E_NOMEM), not a crashed process.
 void* ph_create(
     const int32_t* ttree, int64_t ttree_len,
     const int32_t* ops, int64_t ops_len,
@@ -667,8 +674,9 @@ void* ph_create(
     int32_t n_str,
     const uint8_t* tag_blob, const int64_t* tag_offs, int64_t n_tag_names,
     int32_t n_shards, const uint64_t** table_hashes, const int32_t** table_vals,
-    const int64_t* table_sizes) {
-  State* st = new State();
+    const int64_t* table_sizes) try {
+  std::unique_ptr<State> owned(new State());
+  State* st = owned.get();
   st->ttree.assign(ttree, ttree + ttree_len);
   st->ops.assign(ops, ops + ops_len);
   st->op_starts.assign(op_starts, op_starts + n_ops);
@@ -694,14 +702,16 @@ void* ph_create(
   st->str_codes.resize(n_str);
   st->cur_num.resize(n_num);
   st->cur_str.resize(n_str);
-  return st;
+  return owned.release();
+} catch (...) {
+  return nullptr;  // caller raises MemoryError("ph_create failed")
 }
 
 void ph_destroy(void* p) { delete (State*)p; }
 
 // Decode `count` records from an (already-inflated) block payload.
 // Returns rows decoded so far in this chunk, or a negative error code.
-int64_t ph_decode_block(void* p, const uint8_t* payload, int64_t size, int64_t count) {
+int64_t ph_decode_block(void* p, const uint8_t* payload, int64_t size, int64_t count) try {
   State& st = *(State*)p;
   Reader r{payload, size};
   for (int64_t i = 0; i < count; i++) {
@@ -724,6 +734,11 @@ int64_t ph_decode_block(void* p, const uint8_t* payload, int64_t size, int64_t c
   flush_pending(st);
   if (r.pos != r.n) return E_TRUNCATED;  // trailing garbage = framing bug
   return st.n_rows;
+} catch (...) {
+  // Almost certainly bad_alloc from a buffer growth mid-decode; the chunk
+  // state is now incoherent, so the caller must treat this decoder as
+  // dead (the raised error aborts the stream — correct: rows were lost).
+  return E_NOMEM;
 }
 
 int64_t ph_chunk_rows(void* p) { return ((State*)p)->n_rows; }
