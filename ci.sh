@@ -15,11 +15,15 @@ export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
 
 echo "== pytest (full suite, 8-device virtual CPU mesh) =="
-# Needs ~10 GB of host-memory headroom: under co-located pressure (e.g. a
-# ~60 GB rehearsal on the same box) jax/XLA-CPU's eager dispatch ABORTS the
-# interpreter on a failed allocation instead of raising (reproduced twice at
-# tests/test_out_of_core.py::test_mesh_streaming_checkpoint_resume, clean
-# 25/25 on an idle host — docs/round5.md ask #1).
+# mmap-region headroom: compiled XLA executables hold mmap'd JIT code pages
+# that jax never frees in-process; a full suite can cross vm.max_map_count
+# (default 65530), after which LLVM's code-page mmap fails and jaxlib
+# segfaults/aborts mid-compile (diagnosed round 5 — docs/round5.md ask #1).
+# conftest.py bounds it by clearing jax caches every 100 tests; raising the
+# sysctl adds belt to suspenders when we can.
+if [ "$(id -u)" = "0" ] && [ "$(cat /proc/sys/vm/max_map_count)" -lt 262144 ]; then
+  sysctl -w vm.max_map_count=262144 || true
+fi
 python -m pytest tests/ -x -q
 
 if [[ "${1:-}" == "fast" ]]; then

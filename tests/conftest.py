@@ -27,6 +27,26 @@ jax.config.update("jax_enable_x64", True)
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Bound the process's mmap-region count. Every compiled XLA executable holds
+# mmap'd JIT code pages, and jax's per-process executable caches never free
+# them — ~350 tests push the process past vm.max_map_count (default 65530),
+# at which point LLVM's code-page mmap fails ("LLVM compilation error:
+# Cannot allocate memory") and jaxlib SEGFAULTS/ABORTS instead of raising
+# (the round-4/5 1-in-2 'Fatal Python error' at ~test 256; full diagnosis
+# in docs/round5.md ask #1). Clearing jax's caches every N tests caps the
+# live-executable count; the handful of re-compiles costs ~2 min across the
+# suite, a crash costs the whole run.
+_TESTS_PER_CACHE_CLEAR = 100
+_test_counter = {"n": 0}
+
+
+@pytest.fixture(autouse=True)
+def _bound_jit_executable_maps():
+    yield
+    _test_counter["n"] += 1
+    if _test_counter["n"] % _TESTS_PER_CACHE_CLEAR == 0:
+        jax.clear_caches()
+
 
 @pytest.fixture
 def rng():
