@@ -58,10 +58,19 @@ from photon_tpu.optim.base import (
 from photon_tpu.optim.lbfgs import (
     LBFGSHistory,
     empty_history,
-    two_loop_direction,
-    update_history,
 )
+from photon_tpu.optim.lbfgs import two_loop_direction as _two_loop_eager
+from photon_tpu.optim.lbfgs import update_history as _update_history_eager
 from photon_tpu.optim.owlqn import orthant, pseudo_gradient
+
+# The out-of-core loops run in HOST Python (streams + checkpoints force
+# that), so unlike the in-core solvers these helpers would execute as a
+# cascade of EAGER ops — on the axon tunnel backend every eager op is a
+# round-trip dispatch. Jit them once (pinning the default dot, a plain
+# jnp.dot, out of the traced signature): one compiled program per call
+# site instead of dozens of dispatches per iteration.
+two_loop_direction = jax.jit(lambda g, hist: _two_loop_eager(g, hist))
+update_history = jax.jit(lambda hist, s, y: _update_history_eager(hist, s, y))
 
 Array = jax.Array
 
