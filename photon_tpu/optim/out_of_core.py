@@ -72,6 +72,15 @@ from photon_tpu.optim.owlqn import orthant, pseudo_gradient
 two_loop_direction = jax.jit(lambda g, hist: _two_loop_eager(g, hist))
 update_history = jax.jit(lambda hist, s, y: _update_history_eager(hist, s, y))
 
+
+@jax.jit
+def _reg_at_t(w, d, t, l2v):
+    """½·Σ l2v·(w + t·d)² — the line-search probe's regularizer term, one
+    compiled program instead of 3-4 eager O(dim) dispatches per probe
+    (every arg traced, so neither backtracking nor a λ-sweep recompiles)."""
+    wt = w + t * d
+    return 0.5 * jnp.sum(l2v * wt * wt)
+
 Array = jax.Array
 
 
@@ -279,13 +288,22 @@ def _matvec_for(dim: int):
 
 @functools.lru_cache(maxsize=None)
 def _kernels_for(loss, dim: int):
-    """(matvec, probe, grad) jitted per-chunk kernels. Cached on the
-    (loss, dim) pair — `loss_for_task` returns per-task singletons, so a
-    regularization sweep never recompiles (λ enters host-side only)."""
+    """(matvec, probe, probe_at_t, grad) jitted per-chunk kernels. Cached
+    on the (loss, dim) pair — `loss_for_task` returns per-task singletons,
+    so a regularization sweep never recompiles (λ enters host-side only)."""
 
     @jax.jit
     def k_probe(z, labels, weights):
         return jnp.sum(weights * loss.loss(z, labels))
+
+    @jax.jit
+    def k_probe_at_t(z, zd, t, labels, weights):
+        # Fused line-search probe over RESIDENT margins: one compiled
+        # program instead of an eager z+t·zd add (a full chunk-sized
+        # temporary + an extra dispatch per chunk per probe — on the axon
+        # tunnel backend every eager op is a round trip). ``t`` is a
+        # traced scalar so backtracking never recompiles.
+        return jnp.sum(weights * loss.loss(z + t * zd, labels))
 
     @jax.jit
     def k_grad(z, labels, weights, idx, val):
@@ -293,7 +311,7 @@ def _kernels_for(loss, dim: int):
         sf = SparseFeatures(idx=idx, val=val, dim=dim)
         return jnp.sum(weights * lv), sf.rmatvec(weights * d1)
 
-    return _matvec_for(dim), k_probe, k_grad
+    return _matvec_for(dim), k_probe, k_probe_at_t, k_grad
 
 
 def _mesh_puts(mesh, data_axis: str, chunk_rows: int):
@@ -378,9 +396,10 @@ class OutOfCoreLBFGS:
     def _streams(self, data: ChunkedGLMData):
         """Shard the resident row vectors (REBINDING onto ``data`` — see
         the class doc's sharding contract) and return the streamed-pass
-        closures ``(put_rep, stream_scores, data_value, stream_grad)``
+        closures ``(put_rep, stream_scores, data_value, data_value_at_t,
+        stream_grad)``
         every out-of-core solver loop is built from."""
-        k_matvec, k_probe, k_grad = self._kernels(data.dim)
+        k_matvec, k_probe, k_probe_at_t, k_grad = self._kernels(data.dim)
         put_row, put_ell, put_rep = _mesh_puts(
             self.mesh, self.data_axis, data.chunk_rows
         )
@@ -402,6 +421,14 @@ class OutOfCoreLBFGS:
                 for i, z in enumerate(z_chunks)
             )
 
+        def data_value_at_t(z_chunks, zd_chunks, t):
+            """Line-search probe f_data(z + t·zd), fused per chunk."""
+            t = jnp.asarray(t, jnp.float32)
+            return sum(
+                k_probe_at_t(z, zd, t, labels[i], weights[i])
+                for i, (z, zd) in enumerate(zip(z_chunks, zd_chunks))
+            )
+
         def stream_grad(z_chunks):
             f = jnp.zeros((), jnp.float32)
             g = jnp.zeros((data.dim,), jnp.float32)
@@ -411,7 +438,8 @@ class OutOfCoreLBFGS:
                 f, g = f + fc, g + gc
             return f, g
 
-        return put_rep, stream_scores, data_value, stream_grad
+        return (put_rep, stream_scores, data_value, data_value_at_t,
+                stream_grad)
 
     def _ckpt_tag(self, data: ChunkedGLMData, prefix: str,
                   extra: str = "") -> str:
@@ -536,7 +564,8 @@ class OutOfCoreLBFGS:
     def optimize(self, data: ChunkedGLMData, x0: Array) -> OptimizerResult:
         cfg = self.config
         dim = data.dim
-        put_rep, stream_scores, data_value, stream_grad = self._streams(data)
+        (put_rep, stream_scores, data_value, data_value_at_t,
+         stream_grad) = self._streams(data)
 
         w = put_rep(jnp.asarray(x0, jnp.float32))
         l2v = self._l2_vec(w)
@@ -595,10 +624,9 @@ class OutOfCoreLBFGS:
             t_last = 0.0  # the step size the CURRENT ft was evaluated at
             c1, shrink = 1e-4, 0.5
             for _ in range(cfg.max_line_search_iterations):
-                wt = w + t * d
-                ft = data_value(
-                    [z[i] + t * zd[i] for i in range(data.n_chunks)]
-                ) + 0.5 * jnp.sum(l2v * wt * wt)
+                ft = data_value_at_t(z, zd, t) + _reg_at_t(
+                    w, d, jnp.asarray(t, jnp.float32), l2v
+                )
                 if bool(jnp.isfinite(ft)) and float(ft) <= float(
                     f + c1 * t * dg
                 ):
@@ -689,7 +717,8 @@ class OutOfCoreOWLQN(OutOfCoreLBFGS):
     def optimize(self, data: ChunkedGLMData, x0: Array) -> OptimizerResult:
         cfg = self.config
         dim = data.dim
-        put_rep, stream_scores, data_value, stream_grad = self._streams(data)
+        (put_rep, stream_scores, data_value, data_value_at_t,
+         stream_grad) = self._streams(data)
 
         w = put_rep(jnp.asarray(x0, jnp.float32))
         l2v = self._l2_vec(w)
