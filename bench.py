@@ -751,6 +751,162 @@ def _game_bundle(n_users, rows_per_user, d_global, d_user, n_items=0, seed=2):
     )
 
 
+def bench_serve():
+    """Online serving round-trip (docs/serving.md): train a small GAME
+    model, publish it through the serving registry, and drive concurrent
+    single-row HTTP requests through the micro-batcher. Reports scoring
+    rows/sec and exact p50/p99 request latency — the online companions to
+    ``game_scoring_rows_per_sec`` (the offline batch number)."""
+    import http.client
+    import tempfile
+    import threading
+
+    from photon_tpu.estimators.config import (
+        FixedEffectDataConfig,
+        GLMOptimizationConfiguration,
+        RandomEffectDataConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.index.index_map import (
+        DefaultIndexMap,
+        build_mmap_index,
+        feature_key,
+    )
+    from photon_tpu.io.data_reader import FeatureShardConfig
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.optim import RegularizationContext, RegularizationType
+    from photon_tpu.serving import (
+        MicroBatcher,
+        ModelRegistry,
+        ScoringServer,
+        ServingConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    n_users, rows_per_user, d_global, d_user = (
+        (48, 8, 128, 4) if SMOKE else (256, 16, 1024, 8))
+    n_req = 256 if SMOKE else 2048
+    conc = 4 if SMOKE else 8
+    bundle = _game_bundle(n_users, rows_per_user, d_global, d_user)
+    estimator = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={
+            "fixed": FixedEffectDataConfig("global"),
+            "perUser": RandomEffectDataConfig(re_type="userId",
+                                              feature_shard="global"),
+        },
+        n_sweeps=1,
+    )
+    gcfg = {
+        "fixed": GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=15),
+        "perUser": GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=15),
+    }
+    model = estimator.fit(bundle, None, [gcfg])[0].model
+
+    feats = bundle.features["global"]
+    dim = feats.dim
+    fidx, fval = np.asarray(feats.idx), np.asarray(feats.val)
+    users = bundle.id_tags["userId"]
+    payloads = [
+        json.dumps({
+            "features": [
+                {"name": "c", "term": str(int(c)), "value": float(v)}
+                for c, v in zip(fidx[r], fval[r]) if c < dim
+            ],
+            "entities": {"userId": str(users[r])},
+        }).encode()
+        for r in range(min(512, bundle.n_rows))
+    ]
+
+    with tempfile.TemporaryDirectory() as td:
+        mdir = os.path.join(td, "best")
+        imap = DefaultIndexMap(
+            [feature_key("c", str(j)) for j in range(dim)])
+        save_game_model(
+            mdir, model, {"global": imap},
+            shard_by_coordinate={"perUser": "global"},
+            shard_configs={"global": FeatureShardConfig(
+                ("features",), add_intercept=False)},
+        )
+        build_mmap_index(imap, os.path.join(td, "index", "global"))
+        cfg = ServingConfig(max_batch=32, max_wait_ms=1.0,
+                            cache_entities=max(64, n_users),
+                            max_row_nnz=32)
+        registry = ModelRegistry(mdir, cfg)
+        batcher = MicroBatcher(max_batch=cfg.max_batch,
+                               max_wait_ms=cfg.max_wait_ms)
+        server = ScoringServer(registry, batcher, port=0)
+        server.start()
+        host, port = server.address
+        lat: list = []
+        lat_lock = threading.Lock()
+
+        def fire(conn, body) -> float:
+            t0 = time.perf_counter()
+            conn.request("POST", "/score", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"serve returned {resp.status}")
+            return time.perf_counter() - t0
+
+        worker_errors: list = []
+
+        def worker(wid: int) -> None:
+            try:
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+                mine = [
+                    fire(conn, payloads[i % len(payloads)])
+                    for i in range(wid, n_req, conc)
+                ]
+                conn.close()
+                with lat_lock:
+                    lat.extend(mine)
+            except Exception as e:  # noqa: BLE001 - re-raised after join
+                worker_errors.append(e)
+
+        # Warm the HTTP + batcher path (kernel shapes warmed at load).
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        for i in range(8):
+            fire(conn, payloads[i % len(payloads)])
+        conn.close()
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(conc)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        snap = server.metrics_snapshot()
+        server.shutdown()
+    if worker_errors:
+        # A dead worker's rows never reach `lat`; reporting the surviving
+        # throughput would bank a silently-skewed number.
+        raise RuntimeError(
+            f"{len(worker_errors)} serve worker(s) failed: "
+            f"{worker_errors[0]!r}"
+        )
+    lat.sort()
+
+    def q(p: float) -> float:
+        return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+    return {
+        "serve_rows_per_sec": round(len(lat) / wall, 1),
+        "serve_p50_ms": round(q(0.50) * 1e3, 2),
+        "serve_p99_ms": round(q(0.99) * 1e3, 2),
+        "serve_requests": len(lat),
+        "serve_concurrency": conc,
+        "serve_mean_batch_rows": snap["batcher"]["mean_batch_rows"],
+    }
+
+
 def bench_game_scale():
     """Config-3 at MovieLens scale (VERDICT round-3 ask #9): >=100K users,
     per-coordinate-step time and RE-solve throughput."""
@@ -1440,6 +1596,7 @@ def main():
         ("roofline", stage_roofline),
         ("owlqn_tron", bench_owlqn_tron),
         ("game", bench_game),
+        ("serve", bench_serve),
         ("ingest", bench_ingest),
         ("game_scale", bench_game_scale),
         ("tuner", bench_tuner),
@@ -1449,6 +1606,7 @@ def main():
             "roofline": "roofline",
             "owlqn_tron": "owlqn_linear_l1_samples_per_sec",
             "game": "game_samples_per_sec",
+            "serve": "serve_rows_per_sec",
             "ingest": "ingest_rows_per_sec",
             "game_scale": "game_scale_total_seconds",
             "tuner": "tuner_trials",

@@ -87,19 +87,12 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             meta = json.load(f)
         shards = {info["feature_shard"] for info in meta["coordinates"].values()}
 
-        if args.index_dir:
-            index_root = args.index_dir
-        else:
-            # The training driver writes indexes at <out>/index while models
-            # live at <out>/best or <out>/models/<i> — walk up past "models",
-            # but only for true models/<i> children (an output dir itself
-            # named "models" must not trigger the walk-up).
-            norm = os.path.normpath(args.model_dir)
-            parent = os.path.dirname(norm)
-            if (os.path.basename(parent) == "models"
-                    and os.path.basename(norm).isdigit()):
-                parent = os.path.dirname(parent)
-            index_root = os.path.join(parent, "index")
+        # Index resolution is shared with the serving registry
+        # (io/model_io.default_index_root) so batch and online scoring
+        # resolve a model directory identically.
+        from photon_tpu.io.model_io import default_index_root
+
+        index_root = args.index_dir or default_index_root(args.model_dir)
         index_maps = {
             s: MmapIndexMap(os.path.join(index_root, s)) for s in sorted(shards)
         }

@@ -1,0 +1,158 @@
+"""Online GAME scoring server driver (docs/serving.md).
+
+The fourth driver: where the scoring driver reads a dataset and writes a
+file, this one loads the same training-driver output directory and serves
+single-row JSON requests at low latency:
+
+    python -m photon_tpu.cli.serving_driver \\
+        --model-dir out/best --port 8080 --output-dir serve_logs
+
+    curl -s localhost:8080/score -d '{"features": [{"name": "g", \\
+        "term": "0", "value": 1.2}], "entities": {"userId": "user3"}}'
+
+Scores are identical to the batch scorer's (same index maps, same additive
+kernel — tested parity), unseen entities fall back to fixed-effect-only,
+and ``POST /admin/swap`` hot-swaps to a newly trained model directory
+without dropping in-flight requests.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+from typing import Optional, Sequence
+
+from photon_tpu.serving import (
+    MicroBatcher,
+    ModelRegistry,
+    ScoringServer,
+    ServingConfig,
+)
+from photon_tpu.utils import PhotonLogger
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="serving-driver",
+        description="Serve a trained GAME model over HTTP (JSON rows).",
+    )
+    p.add_argument("--model-dir", required=True,
+                   help="a 'best' or 'models/<i>' directory from the "
+                        "training driver")
+    p.add_argument("--index-dir", default=None,
+                   help="per-shard index stores (default: <model-dir>/../index)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8080,
+                   help="0 binds an ephemeral port (logged at startup)")
+    p.add_argument("--max-batch", type=int, default=64,
+                   help="micro-batch row cap (bucket shapes warm at startup)")
+    p.add_argument("--max-wait-ms", type=float, default=2.0,
+                   help="micro-batcher coalescing window")
+    p.add_argument("--cache-entities", type=int, default=4096,
+                   help="LRU device hot-set capacity per RE coordinate")
+    p.add_argument("--max-row-nnz", type=int, default=128,
+                   help="per-shard feature cap per request row (stable-shape "
+                        "contract; over-cap rows get HTTP 400)")
+    p.add_argument("--output-dir", default=None,
+                   help="photon.log + serving-metrics.jsonl land here")
+    p.add_argument("--metrics-interval", type=float, default=60.0,
+                   help="seconds between JSONL metrics snapshots")
+    from photon_tpu.cli.params import add_compilation_cache_flag
+
+    add_compilation_cache_flag(p)
+    return p
+
+
+def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
+    """Registry (load + warm) → batcher → HTTP front-end, not yet serving."""
+    from photon_tpu.cli.params import enable_compilation_cache
+
+    enable_compilation_cache(args.compilation_cache_dir)
+    plogger = PhotonLogger(args.output_dir)
+    logger = plogger.logger
+    config = ServingConfig(
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        cache_entities=args.cache_entities,
+        max_row_nnz=args.max_row_nnz,
+    )
+    from photon_tpu.utils import Timed
+
+    with Timed("load + warm model", logger):
+        registry = ModelRegistry(
+            args.model_dir, config, index_dir=args.index_dir
+        )
+    batcher = MicroBatcher(
+        max_batch=config.max_batch, max_wait_ms=config.max_wait_ms
+    )
+    metrics_path = (
+        os.path.join(args.output_dir, "serving-metrics.jsonl")
+        if args.output_dir
+        else None
+    )
+    server = ScoringServer(
+        registry,
+        batcher,
+        host=args.host,
+        port=args.port,
+        logger=logger,
+        metrics_path=metrics_path,
+        metrics_interval_s=args.metrics_interval,
+    )
+    v = registry.current
+    logger.info(
+        "serving model version %d (%s) on http://%s:%d  "
+        "[coordinates: %s; max_batch=%d, wait=%.1fms, cache=%d]",
+        v.version, v.model_dir, *server.address,
+        ",".join(sorted(v.coordinates)), config.max_batch,
+        config.max_wait_ms, config.cache_entities,
+    )
+    return server, plogger
+
+
+def run(
+    argv: Optional[Sequence[str]] = None, serve_forever: bool = True
+) -> dict:
+    """Build and (by default) serve until interrupted. ``serve_forever=
+    False`` builds, warms, and tears down — the smoke/integration entry."""
+    args = build_arg_parser().parse_args(argv)
+    server, plogger = build_server(args)
+    v = server.registry.current
+    summary = {
+        "address": list(server.address),
+        "model_version": v.version,
+        "model_dir": v.model_dir,
+        "coordinates": sorted(v.coordinates),
+    }
+    if not serve_forever:
+        server.shutdown()
+        plogger.close()
+        return summary
+    def _graceful(signum, frame):
+        raise KeyboardInterrupt
+
+    try:
+        import signal
+
+        # Production stops send SIGTERM; route it through the same graceful
+        # path as Ctrl-C (drain batcher, flush metrics) instead of dying
+        # with requests in flight. Main-thread only — embedded callers that
+        # run() from a worker thread keep their process's handlers.
+        signal.signal(signal.SIGTERM, _graceful)
+    except ValueError:
+        pass
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        plogger.close()
+    return summary
+
+
+def main() -> None:  # pragma: no cover - console entry
+    run()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

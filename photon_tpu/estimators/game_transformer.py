@@ -14,6 +14,7 @@ scores each bucket with one vmapped gather-dot.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Mapping, Optional
 
 import jax
@@ -36,6 +37,65 @@ from photon_tpu.game.random_effect import RandomEffectModel
 from photon_tpu.io.data_reader import GameDataBundle
 
 Array = jax.Array
+
+# Trace counter for the shared scoring kernel below: the traced-function
+# body runs once per distinct input signature, so this counts XLA
+# compilations. The serving micro-batcher's no-recompile-after-warmup
+# guarantee is asserted against it (tests/test_serving.py).
+SCORE_KERNEL_STATS = {"traces": 0}
+
+
+@partial(jax.jit, static_argnames=("fixed_parts", "re_parts"))
+def additive_score_rows(
+    offsets,
+    shard_idx,
+    shard_val,
+    fixed_ws,
+    re_proj,
+    re_coef,
+    *,
+    fixed_parts,
+    re_parts,
+):
+    """The additive GAME score of B padded rows as ONE jitted program —
+    the kernel shared by ``GameTransformer.transform_rows`` and the online
+    serving scorer (``photon_tpu/serving/``), so batch and online scores
+    cannot drift.
+
+    ``offsets [B]``; ``shard_idx/shard_val``: shard → ELL row arrays
+    ``[B, K]`` (ghost column == that shard's dim, value 0).
+    ``fixed_ws``: coordinate → extended coefficient vector ``[D+1]`` (the
+    trailing zero absorbs ghost gathers). ``re_proj/re_coef``: coordinate →
+    per-row entity subspace ``[B, P]`` — sorted global columns (ghost pad ==
+    dim) and the entity's trained coefficients in those slots; an all-ghost
+    row IS the zero model (the unseen-entity fallback of the batch scorer).
+    ``fixed_parts``/``re_parts`` are static ``((cid, shard), ...)`` tuples
+    fixing which arrays combine.
+
+    Per RE row the contribution is Σ_k val·w_e[idx_k] resolved by a
+    binary search of the row's feature columns against the entity's sorted
+    subspace — the serve-time analog of the transformer's host-side
+    model-RDD join (SURVEY.md §3.6), shaped [B, K] for the accelerator.
+    """
+    SCORE_KERNEL_STATS["traces"] += 1
+    total = offsets
+    for cid, shard in fixed_parts:
+        idx, val = shard_idx[shard], shard_val[shard]
+        w_ext = fixed_ws[cid]
+        total = total + jnp.sum(val * w_ext[idx], axis=1)
+    for cid, shard in re_parts:
+        proj, coef = re_proj[cid], re_coef[cid]
+        if proj.shape[1] == 0:  # empty model: nothing to add (static shape)
+            continue
+        idx, val = shard_idx[shard], shard_val[shard]
+        pos = jax.vmap(jnp.searchsorted)(proj, idx)
+        pos = jnp.minimum(pos, proj.shape[1] - 1)
+        hit = jnp.take_along_axis(proj, pos, axis=1) == idx
+        cv = jnp.take_along_axis(coef, pos, axis=1)
+        total = total + jnp.sum(
+            jnp.where(hit, cv * val.astype(cv.dtype), 0.0), axis=1
+        )
+    return total
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +171,71 @@ class GameTransformer:
             else:  # pragma: no cover - union is closed
                 raise TypeError(f"unknown data config {type(dcfg)}")
         return total
+
+    def transform_rows(self, data: GameDataBundle) -> Array:
+        """Row-level scoring through the shared ``additive_score_rows``
+        kernel — the same program the online serving scorer runs, so this is
+        the parity anchor between batch and online scores (tested equal to
+        ``transform``). Per-entity coefficients are joined host-side row by
+        row (no bucket regrouping), which is the right shape for micro-batch
+        serving and small scoring calls; large offline scans should prefer
+        ``transform``'s bucketed path."""
+        fixed_parts, re_parts = [], []
+        fixed_ws, re_proj, re_coef = {}, {}, {}
+        n = data.n_rows
+        shard_idx = {s: jnp.asarray(f.idx) for s, f in data.features.items()}
+        shard_val = {s: jnp.asarray(f.val) for s, f in data.features.items()}
+        for cid in self.model.keys():
+            dcfg = self.coordinate_data_configs.get(cid)
+            if dcfg is None:
+                raise ValueError(
+                    f"model coordinate {cid!r} has no data config; "
+                    f"configs cover {sorted(self.coordinate_data_configs)}"
+                )
+            m = self.model[cid]
+            if isinstance(dcfg, FixedEffectDataConfig):
+                w = m.model.coefficients.means
+                fixed_ws[cid] = jnp.concatenate(
+                    [w, jnp.zeros((1,), w.dtype)]
+                )
+                fixed_parts.append((cid, dcfg.feature_shard))
+            elif isinstance(dcfg, RandomEffectDataConfig):
+                keys = data.id_tags[dcfg.re_type]
+                dim = data.features[dcfg.feature_shard].dim
+                rows, width, by_key = [], 1, {}
+                for key in keys:
+                    hit = by_key.get(key)
+                    if hit is None:
+                        hit = m.coefficients_for(key)
+                        by_key[key] = hit
+                    rows.append(hit)
+                    width = max(width, len(hit[0]))
+                proj = np.full((n, width), dim, np.int32)
+                # The model's own precision, not hardcoded f32: an f64
+                # model must score identically through this path and
+                # ``transform`` (the same dtype contract newton_re's
+                # solvers honor).
+                cdt = (np.asarray(m.bucket_coefs[0]).dtype
+                       if len(m.bucket_coefs) else np.float32)
+                coef = np.zeros((n, width), cdt)
+                for r, (gi, gv) in enumerate(rows):
+                    proj[r, : len(gi)] = gi
+                    coef[r, : len(gi)] = gv
+                re_proj[cid] = jnp.asarray(proj)
+                re_coef[cid] = jnp.asarray(coef)
+                re_parts.append((cid, dcfg.feature_shard))
+            else:  # pragma: no cover - union is closed
+                raise TypeError(f"unknown data config {type(dcfg)}")
+        return additive_score_rows(
+            jnp.asarray(data.offsets, jnp.float32),
+            shard_idx,
+            shard_val,
+            fixed_ws,
+            re_proj,
+            re_coef,
+            fixed_parts=tuple(fixed_parts),
+            re_parts=tuple(re_parts),
+        )
 
     def transform_and_evaluate(
         self, data: GameDataBundle, suite: EvaluationSuite

@@ -56,6 +56,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from photon_tpu.models.coefficients import Coefficients
 from photon_tpu.models.glm import GeneralizedLinearModel
@@ -70,7 +71,9 @@ from photon_tpu.optim.base import (
 
 Array = jax.Array
 
-NEWTON_MAX_P = 128          # [P,P] solves stay tiny; beyond this, fall back
+NEWTON_MAX_P = 64           # [P,P] solves stay tiny; beyond this, fall back
+                            # (documented gate: module doc, docs/scaling.md,
+                            # docs/round5.md all say P <= 64 — keep in sync)
 DUAL_MAX_T = 80  # S + U cap; beyond this the (S+U)^2 systems stop being tiny
 _DEFAULT_BUDGET_MB = 2048   # dense X + H + probe buffers cap
 
@@ -93,17 +96,19 @@ def _smooth_ok(problem, normalization) -> bool:
     return normalization is None
 
 
-def penalty_terms(problem, local_mask, local_prior):
-    """``(l2v, pm, pp, d_pen)`` in f32 — the quadratic-penalty pieces BOTH
-    solvers and the eligibility gate derive everything from. ONE definition
-    on purpose: the u_max gate counts ``d_pen <= 0`` and the dual solver
-    inverts ``d_pen > 0`` — computed anywhere else (other dtype, other
-    threshold) a divergence would silently pin a coefficient to zero."""
+def penalty_terms(problem, local_mask, local_prior, dtype=jnp.float32):
+    """``(l2v, pm, pp, d_pen)`` in ``dtype`` — the quadratic-penalty pieces
+    BOTH solvers and the eligibility gate derive everything from. ONE
+    definition on purpose: the u_max gate counts ``d_pen <= 0`` and the dual
+    solver inverts ``d_pen > 0`` — computed anywhere else (other dtype, other
+    threshold) a divergence would silently pin a coefficient to zero. The
+    gate's zero-count is dtype-insensitive (masks and λ are exact in f32),
+    so callers may pass any float dtype without moving the threshold."""
     lam = problem.regularization.l2_weight(float(problem.reg_weight))
-    l2v = lam * local_mask.astype(jnp.float32)
+    l2v = lam * local_mask.astype(dtype)
     if local_prior is not None:
-        pm = local_prior.means.astype(jnp.float32)
-        pp = local_prior.precisions.astype(jnp.float32)
+        pm = local_prior.means.astype(dtype)
+        pp = local_prior.precisions.astype(dtype)
     else:
         pm = jnp.zeros_like(l2v)
         pp = jnp.zeros_like(l2v)
@@ -127,9 +132,11 @@ def newton_eligible(problem, bucket, normalization) -> bool:
     p = bucket.local_dim
     if p > NEWTON_MAX_P:
         return False
-    # Dominant dense buffers: X [E,S,P+1] f32, H [E,P,P] f32, probe
-    # margins [L,E,S] f32 (L capped at 12).
-    need = 4.0 * (e * s * (p + 1) + e * p * p + 12 * e * s)
+    # Dominant dense buffers (solvers run in the data dtype): X [E,S,P+1],
+    # H [E,P,P], and the probe batch's [L,E,S] margins + [L,E,S] loss
+    # temporary + [L,E,P] trial parameters (L capped at 12).
+    esize = float(np.dtype(bucket.val.dtype).itemsize)
+    need = esize * (e * s * (p + 1) + e * p * p + 12 * e * (2 * s + p))
     return need <= _budget_bytes()
 
 
@@ -159,28 +166,32 @@ def dual_eligible(problem, bucket, normalization, u_max: int) -> bool:
     p = bucket.local_dim
     if s + u_max > DUAL_MAX_T:
         return False
-    # Dominant buffers: dense X [E,S,P+1] f32 + G/J [E,S,S+U] + probe
-    # margins [12,E,S]. The dense X dominates at wide P.
-    need = 4.0 * (e * s * (p + 1) + 2 * e * s * (s + u_max) + 12 * e * s)
+    # Dominant buffers (in the data dtype): dense X [E,S,P+1] + G/J
+    # [E,S,S+U] + the probe batch's [12,E,S] margins + [12,E,S] loss
+    # temporary + [12,E,S+U] trial parameters. Dense X dominates at wide P.
+    esize = float(np.dtype(bucket.val.dtype).itemsize)
+    need = esize * (e * s * (p + 1) + 2 * e * s * (s + u_max)
+                    + 12 * e * (2 * s + s + u_max))
     return need <= _budget_bytes()
 
 
-def _dense_design(batches):
+def _dense_design(batches, dtype):
     """Dense local design [E,S,P+1] via scatter-add — the ELL ghost column
     (== P) lands in the extra zero column. ONE buffer replaces per-probe
-    ELL gathers for the whole solve. Also returns (y, off, tw) as f32."""
+    ELL gathers for the whole solve. Also returns (y, off, tw) in ``dtype``
+    (the solve precision — f64 datasets keep full precision, ADVICE r5)."""
     idx = batches.features.idx
-    val = batches.features.val.astype(jnp.float32)
+    val = batches.features.val.astype(dtype)
     e, s, _ = idx.shape
     p = batches.features.dim
     ei = jnp.arange(e)[:, None, None]
     si = jnp.arange(s)[None, :, None]
-    x_ext = jnp.zeros((e, s, p + 1), jnp.float32).at[ei, si, idx].add(val)
+    x_ext = jnp.zeros((e, s, p + 1), dtype).at[ei, si, idx].add(val)
     return (
         x_ext,
-        batches.labels.astype(jnp.float32),
-        batches.offsets.astype(jnp.float32),
-        batches.weights.astype(jnp.float32),
+        batches.labels.astype(dtype),
+        batches.offsets.astype(dtype),
+        batches.weights.astype(dtype),
     )
 
 
@@ -202,20 +213,20 @@ def _newton_loop(x0, z0, cfg, value_at, grad_at, hess_at, lin_map,
     path (inf-filled trajectory tails, accepted-step iteration counts).
     """
     e, t_dim = x0.shape
+    dt = x0.dtype
     max_it = cfg.max_iterations
     # 12 vectorized backtracking probes reach t = 2^-11 ≈ 5e-4 — below
     # that a damped-Newton step on a smooth convex objective is noise.
     n_probe = min(cfg.max_line_search_iterations, 12)
-    ts = 0.5 ** jnp.arange(n_probe, dtype=jnp.float32)
-    eye = jnp.eye(t_dim, dtype=jnp.float32)
+    ts = 0.5 ** jnp.arange(n_probe, dtype=dt)
+    eye = jnp.eye(t_dim, dtype=dt)
     c1 = 1e-4
 
     f = value_at(x0, z0)
     g = grad_at(x0, z0)
     gnorm0 = jnp.linalg.norm(g, axis=1)
-    values = jnp.full((e, max_it + 1), jnp.inf, jnp.float32).at[:, 0].set(f)
-    gnorms = jnp.full((e, max_it + 1), jnp.inf,
-                      jnp.float32).at[:, 0].set(gnorm0)
+    values = jnp.full((e, max_it + 1), jnp.inf, dt).at[:, 0].set(f)
+    gnorms = jnp.full((e, max_it + 1), jnp.inf, dt).at[:, 0].set(gnorm0)
 
     state = (
         x0, z0, f, g,
@@ -298,10 +309,13 @@ def fit_bucket_newton(problem, batches, w0, local_mask, local_prior):
     shapes out, so ``train_random_effects`` can swap it in per bucket."""
     from photon_tpu.functions.problem import VarianceComputationType
 
+    # Solve in the data/warm-start precision: f64 RE configs must not
+    # silently drop to f32 on the default fast path (ADVICE r5).
+    dt = w0.dtype
     loss = loss_for_task(problem.task)
-    x_ext, y, off, tw = _dense_design(batches)
+    x_ext, y, off, tw = _dense_design(batches, dt)
     x = x_ext[..., : batches.features.dim]
-    l2v, pm, pp, _ = penalty_terms(problem, local_mask, local_prior)
+    l2v, pm, pp, _ = penalty_terms(problem, local_mask, local_prior, dt)
 
     def value_at(w, z):
         return (
@@ -331,7 +345,7 @@ def fit_bucket_newton(problem, batches, w0, local_mask, local_prior):
             + 0.5 * jnp.sum(pp[None] * (wt - pm[None]) ** 2, axis=2)
         )
 
-    w = w0.astype(jnp.float32)
+    w = w0.astype(dt)
     z = off + lin_map(w)
     (w, z, f, g, reason, _, values, gnorms, passes, iters) = _newton_loop(
         w, z, problem.optimizer_config, value_at, grad_at, hess_at,
@@ -348,7 +362,7 @@ def fit_bucket_newton(problem, batches, w0, local_mask, local_prior):
             diag = jax.vmap(jnp.diag)(h)
             variances = 1.0 / jnp.maximum(diag, 1e-12)
         else:
-            eye = jnp.eye(w.shape[1], dtype=jnp.float32)
+            eye = jnp.eye(w.shape[1], dtype=dt)
             hinv = jnp.linalg.inv(h + 1e-12 * eye)
             variances = jax.vmap(jnp.diag)(hinv)
         variances = variances.astype(w0.dtype)
@@ -382,13 +396,17 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
     """
     from photon_tpu.functions.problem import VarianceComputationType
 
+    # Same dtype contract as the primal path: solve in w0.dtype so f64
+    # datasets keep full precision (ADVICE r5). w0's VALUES stay unused
+    # (module doc); only its dtype steers the compute precision.
+    dt = w0.dtype
     loss = loss_for_task(problem.task)
-    x_ext, y, off, tw = _dense_design(batches)
+    x_ext, y, off, tw = _dense_design(batches, dt)
     e, s, _ = x_ext.shape
     p = batches.features.dim
     x = x_ext[..., :p]
 
-    _, pm, pp, d_pen = penalty_terms(problem, local_mask, local_prior)
+    _, pm, pp, d_pen = penalty_terms(problem, local_mask, local_prior, dt)
     d_pinv = jnp.where(d_pen > 0.0, 1.0 / jnp.maximum(d_pen, 1e-30), 0.0)
     q = pp * pm                                            # [E, P]
 
@@ -406,7 +424,7 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
         )                                                  # [E, S, U]
     else:
         u_idx = jnp.zeros((e, 0), jnp.int32)
-        x_u = jnp.zeros((e, s, 0), jnp.float32)
+        x_u = jnp.zeros((e, s, 0), dt)
 
     xd = x * d_pinv[:, None, :]                            # X·D⁺  [E,S,P]
     gram = jnp.einsum("esp,etp->est", xd, x)               # G = XD⁺Xᵀ [E,S,S]
@@ -445,7 +463,7 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
                 + 0.5 * jnp.sum(alpha_t * ga_of(alpha_t), axis=2)
                 + c_reg[None])
 
-    theta0 = jnp.zeros((e, s + u_max), jnp.float32)
+    theta0 = jnp.zeros((e, s + u_max), dt)
     (theta, z, f, g, reason, _, values, gnorms, passes,
      iters) = _newton_loop(
         theta0, z0, problem.optimizer_config, value_at, grad_at, hess_at,
@@ -459,7 +477,7 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
     alpha, beta = theta[:, :s], theta[:, s:]
     w = d_pinv * (jnp.einsum("esp,es->ep", x, alpha) + q)
     if u_max > 0:
-        w_full = jnp.concatenate([w, jnp.zeros((e, 1), jnp.float32)], axis=1)
+        w_full = jnp.concatenate([w, jnp.zeros((e, 1), dt)], axis=1)
         w_full = w_full.at[jnp.arange(e)[:, None], u_idx].add(beta)
         w = w_full[:, :p]
 

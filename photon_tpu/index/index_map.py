@@ -186,6 +186,13 @@ class MmapIndexMap(IndexMap):
             )
         return self._parts[p]
 
+    def preload(self) -> None:
+        """Open every partition now (serve-path warmup): point lookups on a
+        hot request path must not pay the lazy mmap open + first-touch page
+        faults of a cold partition."""
+        for p in range(self._nparts):
+            self._partition(p)
+
     def index_of(self, key: str) -> int:
         kb = key.encode("utf-8")
         h = _hash64(kb)

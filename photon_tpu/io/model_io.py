@@ -56,6 +56,23 @@ _CLASS_TO_TASK = {v: k for k, v in _MODEL_CLASS.items()}
 _META = "game-metadata.json"
 
 
+def default_index_root(model_dir: str) -> str:
+    """Index-store root for a training-driver model directory.
+
+    The training driver writes indexes at ``<out>/index`` while models live
+    at ``<out>/best`` or ``<out>/models/<i>`` — walk up past "models", but
+    only for true ``models/<i>`` children (an output dir itself named
+    "models" must not trigger the walk-up). Shared by the batch scoring
+    driver and the serving registry so the two resolve identically.
+    """
+    norm = os.path.normpath(model_dir)
+    parent = os.path.dirname(norm)
+    if (os.path.basename(parent) == "models"
+            and os.path.basename(norm).isdigit()):
+        parent = os.path.dirname(parent)
+    return os.path.join(parent, "index")
+
+
 def _nt_list(imap: IndexMap, indices, values) -> list[dict]:
     out = []
     for i, v in zip(indices, values):

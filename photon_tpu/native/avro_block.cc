@@ -400,8 +400,26 @@ bool read_stringish(State& st, Reader& r, int32_t o, const char** s, int64_t* le
       if (r.fail) return false;
       // Shortest round-trip repr (std::to_chars), matching Python's str():
       // str(0.1) == "0.1", not "%.17g"'s "0.10000000000000001".
+      int n;
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
       auto res = std::to_chars(st.fmtbuf, st.fmtbuf + sizeof st.fmtbuf - 2, v);
-      int n = (int)(res.ptr - st.fmtbuf);
+      n = (int)(res.ptr - st.fmtbuf);
+#else
+      // libstdc++ < 11 has no floating-point to_chars: emit the shortest
+      // %g repr that round-trips (tries rising precision, like repr()).
+      // snprintf and strtod share LC_NUMERIC, so the round-trip check is
+      // locale-consistent; the separator then normalizes to '.' so a
+      // host process that setlocale()d can't leak "3,14" into output.
+      n = 0;
+      for (int prec = 15; prec <= 17; prec++) {
+        n = std::snprintf(st.fmtbuf, sizeof st.fmtbuf - 2, "%.*g", prec, v);
+        char* endp = nullptr;
+        double back = std::strtod(st.fmtbuf, &endp);
+        if (endp == st.fmtbuf + n && back == v) break;  // NaN: runs to 17
+      }
+      for (int i = 0; i < n; i++)
+        if (st.fmtbuf[i] == ',') st.fmtbuf[i] = '.';
+#endif
       // str(3.0) == "3.0": add .0 when the repr has no '.', 'e', or specials.
       bool plain = true;
       for (int i = 0; i < n; i++) {

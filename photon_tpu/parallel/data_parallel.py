@@ -30,7 +30,7 @@ from functools import partial
 import jax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+from photon_tpu.parallel.mesh import shard_map  # version-compat wrapper
 
 from photon_tpu.data.batch import LabeledBatch
 from photon_tpu.functions.objective import GLMObjective

@@ -26,6 +26,16 @@ ENTITY_AXIS = "entity"
 FEATURE_AXIS = "feature"
 DCN_AXIS = "dcn"
 
+try:  # jax >= 0.6 exports shard_map at top level (check_vma kwarg)
+    from jax import shard_map
+except ImportError:  # older jax: experimental home + the pre-rename kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_compat
+
+    def shard_map(f, **kw):
+        if "check_vma" in kw:  # renamed from check_rep in newer jax
+            kw["check_rep"] = kw.pop("check_vma")
+        return _shard_map_compat(f, **kw)
+
 # An axis argument throughout parallel/ may be one mesh axis name or a tuple
 # of names (e.g. ("dcn", "data") — rows sharded over slices x chips, with
 # psum lowering hierarchically: ICI within a slice, DCN across slices).

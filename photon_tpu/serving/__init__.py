@@ -1,0 +1,41 @@
+"""Online GAME scoring subsystem (docs/serving.md).
+
+Four parts, composing the low-latency serve path the batch scoring driver
+cannot provide:
+
+* ``registry``      — versioned model registry with atomic hot-swap;
+* ``coefficient_store`` — host-resident per-entity random-effect
+  coefficient table (mmap-friendly flat layout) + LRU device hot-set;
+* ``batcher``       — request micro-batcher coalescing concurrent
+  single-row requests into padded bucket shapes for the shared jitted
+  additive scoring kernel (``estimators.game_transformer
+  .additive_score_rows``), which never recompiles after warmup;
+* ``server``        — stdlib ``ThreadingHTTPServer`` JSON front-end with
+  latency histograms and JSONL metrics.
+
+CLI entry point: ``photon_tpu/cli/serving_driver.py``.
+"""
+from photon_tpu.serving.batcher import MicroBatcher
+from photon_tpu.serving.coefficient_store import (
+    CoefficientStore,
+    DeviceCoefficientCache,
+)
+from photon_tpu.serving.registry import (
+    ModelRegistry,
+    ModelVersion,
+    ServingConfig,
+)
+from photon_tpu.serving.scorer import ParsedRow, RowScorer
+from photon_tpu.serving.server import ScoringServer
+
+__all__ = [
+    "CoefficientStore",
+    "DeviceCoefficientCache",
+    "MicroBatcher",
+    "ModelRegistry",
+    "ModelVersion",
+    "ParsedRow",
+    "RowScorer",
+    "ScoringServer",
+    "ServingConfig",
+]

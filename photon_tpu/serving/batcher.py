@@ -1,0 +1,138 @@
+"""Request micro-batcher: coalesce concurrent single rows into one kernel call.
+
+The serve-path economics: one padded kernel dispatch costs roughly the
+same for 1 row as for 64 (the device work is tiny; dispatch dominates), so
+under concurrency the batcher turns N in-flight single-row requests into
+ceil(N / max_batch) dispatches. A single idle request pays at most
+``max_wait_ms`` of coalescing latency.
+
+One worker thread owns all device interaction (LRU staging + kernel
+dispatch), which keeps the coefficient-cache mutation single-threaded by
+construction. Each queue item carries its ``ModelVersion`` reference: a
+batch only ever contains rows of ONE version, so a hot-swap mid-stream
+simply splits a batch — in-flight requests finish on the version they
+captured, new ones ride the new version, none are dropped.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+
+class _Pending:
+    __slots__ = ("version", "row", "future")
+
+    def __init__(self, version, row):
+        self.version = version
+        self.row = row
+        self.future: Future = Future()
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        max_batch: int = 64,
+        max_wait_ms: float = 2.0,
+        start: bool = True,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._carry: list = []  # other-version items deferred one round
+        self._stop = threading.Event()
+        # Serializes submit vs close: a submit that passed the stop check
+        # must finish its put before close drains, or the item's future
+        # would sit unresolved until the request timeout.
+        self._submit_lock = threading.Lock()
+        self.stats = {"batches": 0, "rows": 0, "max_batch_rows": 0}
+        self._thread = threading.Thread(
+            target=self._loop, name="photon-serve-batcher", daemon=True
+        )
+        if start:
+            self._thread.start()
+
+    def start(self) -> None:
+        if not self._thread.is_alive():
+            self._thread.start()
+
+    def submit(self, version, row) -> Future:
+        """Enqueue one parsed row against ``version``; resolves to its
+        float score (or the scoring exception)."""
+        with self._submit_lock:
+            if self._stop.is_set():
+                raise RuntimeError("batcher is shut down")
+            item = _Pending(version, row)
+            self._q.put(item)
+        return item.future
+
+    def close(self) -> None:
+        with self._submit_lock:
+            self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        # Fail anything still queued rather than hanging its waiter.
+        leftovers = list(self._carry)
+        self._carry = []
+        while True:
+            try:
+                leftovers.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        for item in leftovers:
+            if not item.future.done():
+                item.future.set_exception(
+                    RuntimeError("scoring server shut down")
+                )
+
+    # ------------------------------------------------------------ internals
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            items = self._carry
+            self._carry = []
+            if not items:
+                try:
+                    items = [self._q.get(timeout=0.1)]
+                except queue.Empty:
+                    continue
+            deadline = time.monotonic() + self.max_wait_s
+            while len(items) < self.max_batch:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    items.append(self._q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            # Drain anything already queued (no extra waiting).
+            while len(items) < self.max_batch:
+                try:
+                    items.append(self._q.get_nowait())
+                except queue.Empty:
+                    break
+            v0 = items[0].version
+            batch = [it for it in items if it.version is v0]
+            self._carry = [it for it in items if it.version is not v0]
+            try:
+                scores = v0.scorer.score_rows([it.row for it in batch])
+                for it, s in zip(batch, scores):
+                    it.future.set_result(float(s))
+            except Exception as e:  # noqa: BLE001 - routed to the waiter
+                for it in batch:
+                    if not it.future.done():
+                        it.future.set_exception(e)
+            self.stats["batches"] += 1
+            self.stats["rows"] += len(batch)
+            self.stats["max_batch_rows"] = max(
+                self.stats["max_batch_rows"], len(batch)
+            )
+
+    def snapshot(self) -> dict:
+        s = dict(self.stats)
+        s["mean_batch_rows"] = round(
+            s["rows"] / s["batches"], 2) if s["batches"] else 0.0
+        return s

@@ -1,0 +1,274 @@
+"""Per-entity random-effect coefficients for serving: host table + device LRU.
+
+What makes GAME serving harder than plain GLM serving is the random-effect
+structure: millions of per-entity coefficient vectors, of which any single
+request needs exactly one per RE coordinate. The reference stack held the
+model as an ``RDD[(REId, GLM)]`` and only ever joined it against batch data
+(SURVEY.md §3.6); an online server needs point lookups instead:
+
+* ``CoefficientStore`` — the FULL per-entity table, host-resident in a flat
+  CSR-style layout (``offsets/cols/vals`` arrays + key index). The arrays
+  are plain numpy, so a saved store reopens as ``np.load(mmap_mode="r")``
+  views: a multi-process deployment shares one page-cache copy, the same
+  property ``MmapIndexMap`` gives the feature index.
+* ``DeviceCoefficientCache`` — an LRU hot-set of entities staged on device
+  as fixed-shape ``[capacity+1, P]`` projection/coefficient tables the
+  jitted scoring kernel gathers from. Row ``capacity`` is a permanent
+  all-ghost zero row: unseen entities (and rows with no entity) map there
+  and score fixed-effect-only — the same zero-model fallback as the batch
+  scorer. Staging a miss rewrites one table row (functional ``.at[].set``);
+  table SHAPES never change, so the scoring kernel never recompiles on
+  cache churn.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+_META = "store-meta.json"
+
+
+class CoefficientStore:
+    """Host-resident sparse per-entity coefficient table for ONE random-effect
+    coordinate. ``cols`` are global feature columns, ascending per entity
+    (the layout ``additive_score_rows``'s binary search requires)."""
+
+    def __init__(
+        self,
+        keys,
+        offsets: np.ndarray,
+        cols: np.ndarray,
+        vals: np.ndarray,
+        global_dim: int,
+    ):
+        self.keys = list(keys)
+        self._key_to_row = {k: i for i, k in enumerate(self.keys)}
+        self.offsets = offsets
+        self.cols = cols
+        self.vals = vals
+        self.global_dim = int(global_dim)
+
+    @property
+    def n_entities(self) -> int:
+        return len(self.keys)
+
+    @property
+    def max_width(self) -> int:
+        if len(self.offsets) <= 1:
+            return 1
+        return max(1, int(np.max(np.diff(self.offsets))))
+
+    @classmethod
+    def from_model(cls, model) -> "CoefficientStore":
+        """Build from a trained/loaded ``RandomEffectModel``: same sparse
+        view as ``coefficients_for``, but with each bucket's stacks pulled
+        host-side ONCE — per-entity jax indexing would cost one device
+        dispatch + D2H sync per entity, minutes of swap latency at the
+        millions-of-entities scale this store exists for."""
+        keys = list(model.entity_keys)
+        proj_np = [np.asarray(p) for p in model.bucket_proj]
+        coef_np = [np.asarray(c) for c in model.bucket_coefs]
+        offsets = np.zeros(len(keys) + 1, np.int64)
+        cols_parts, vals_parts = [], []
+        for i in range(len(keys)):
+            b, lane = model.entity_to_slot[i]
+            pv = proj_np[b][lane]
+            valid = pv < model.global_dim
+            gi = pv[valid].astype(np.int64)
+            gv = coef_np[b][lane][valid]
+            if len(gi) > 1 and np.any(np.diff(gi) < 0):
+                order = np.argsort(gi)  # defensive: kernel needs sorted cols
+                gi, gv = gi[order], gv[order]
+            cols_parts.append(gi.astype(np.int32))
+            vals_parts.append(np.asarray(gv, np.float32))
+            offsets[i + 1] = offsets[i] + len(gi)
+        cols = (
+            np.concatenate(cols_parts) if cols_parts else np.zeros(0, np.int32)
+        )
+        vals = (
+            np.concatenate(vals_parts)
+            if vals_parts
+            else np.zeros(0, np.float32)
+        )
+        return cls(keys, offsets, cols, vals, model.global_dim)
+
+    def lookup(self, key) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        """(global_cols, values) views for one entity, or None if unseen."""
+        row = self._key_to_row.get(key)
+        if row is None:
+            return None
+        s, e = int(self.offsets[row]), int(self.offsets[row + 1])
+        return self.cols[s:e], self.vals[s:e]
+
+    # ------------------------------------------------------------- on disk
+
+    def save(self, out_dir: str) -> None:
+        """Persist as npy arrays + key list; ``load`` reopens them memory-
+        mapped so a 10M-entity table costs ~zero resident RAM per process."""
+        os.makedirs(out_dir, exist_ok=True)
+        np.save(os.path.join(out_dir, "offsets.npy"), self.offsets)
+        np.save(os.path.join(out_dir, "cols.npy"), self.cols)
+        np.save(os.path.join(out_dir, "vals.npy"), self.vals)
+        with open(os.path.join(out_dir, "keys.json"), "w") as f:
+            json.dump([str(k) for k in self.keys], f)
+        with open(os.path.join(out_dir, _META), "w") as f:
+            json.dump(
+                {"global_dim": self.global_dim, "n_entities": len(self.keys)},
+                f,
+            )
+
+    @classmethod
+    def load(cls, store_dir: str, mmap: bool = True) -> "CoefficientStore":
+        with open(os.path.join(store_dir, _META)) as f:
+            meta = json.load(f)
+        with open(os.path.join(store_dir, "keys.json")) as f:
+            keys = json.load(f)
+        mode = "r" if mmap else None
+        return cls(
+            keys,
+            np.load(os.path.join(store_dir, "offsets.npy"), mmap_mode=mode),
+            np.load(os.path.join(store_dir, "cols.npy"), mmap_mode=mode),
+            np.load(os.path.join(store_dir, "vals.npy"), mmap_mode=mode),
+            meta["global_dim"],
+        )
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+class DeviceCoefficientCache:
+    """LRU hot-set of ``CoefficientStore`` rows staged on device (module doc).
+
+    Internal state is lock-protected, but the eviction pin only lasts for
+    one ``slots_for`` call: a resolve-then-``gather`` sequence is NOT
+    atomic against other threads resolving slots in between (an interleaved
+    eviction could restage a returned slot). The server upholds this by
+    funneling ALL resolution + gather through the micro-batcher's single
+    worker thread; direct users of ``RowScorer.score_rows`` must likewise
+    serialize scoring calls per cache. ``stats`` counts hits/misses/
+    evictions/fallbacks for the /metrics endpoint.
+    """
+
+    def __init__(
+        self, store: CoefficientStore, capacity: int = 4096,
+        width: Optional[int] = None,
+    ):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.store = store
+        self.capacity = int(capacity)
+        self.width = _next_pow2(width or store.max_width)
+        # +1 row: the permanent fallback zero row (all-ghost projection).
+        self.proj = jnp.full(
+            (self.capacity + 1, self.width), store.global_dim, jnp.int32
+        )
+        self.coef = jnp.zeros((self.capacity + 1, self.width), jnp.float32)
+        self._slots: OrderedDict = OrderedDict()   # key -> slot, LRU order
+        self._free = list(range(self.capacity))
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0, "fallbacks": 0}
+
+    @property
+    def fallback_slot(self) -> int:
+        return self.capacity
+
+    def slot_for(self, key) -> int:
+        """Cache slot for ONE entity, staging its coefficients on a miss.
+        ``None`` keys and unseen entities get the fallback zero row."""
+        return int(self.slots_for([key])[0])
+
+    def slots_for(self, keys) -> np.ndarray:
+        """Cache slots for a batch of entity keys, staging misses.
+
+        Slots already handed out WITHIN this batch are pinned against
+        eviction until the batch resolves — without the pin, a batch
+        touching more distinct entities than fit would evict a slot it
+        already assigned, and the later gather would read another entity's
+        coefficients. Requires ``capacity >= distinct keys per batch``
+        (the scorer floors capacity at ``max_batch``).
+
+        All of the batch's missed rows land on device in ONE batched
+        ``.at[rows].set`` per table — per-miss eager sets would copy the
+        whole [capacity+1, width] table once per missed entity, turning
+        cold starts and long-tail churn O(capacity) per row.
+        """
+        out = np.empty(len(keys), np.int32)
+        with self._lock:
+            pinned: set = set()
+            staged: list = []  # (slot, padded cols row, padded vals row)
+            for i, key in enumerate(keys):
+                out[i] = self._slot_locked(key, pinned, staged)
+                if out[i] != self.capacity:
+                    pinned.add(int(out[i]))
+            if staged:
+                rows = jnp.asarray(
+                    np.fromiter((s for s, _, _ in staged), np.int32,
+                                len(staged))
+                )
+                self.proj = self.proj.at[rows].set(
+                    jnp.asarray(np.stack([p for _, p, _ in staged]))
+                )
+                self.coef = self.coef.at[rows].set(
+                    jnp.asarray(np.stack([c for _, _, c in staged]))
+                )
+        return out
+
+    def _slot_locked(self, key, pinned: set, staged: list) -> int:
+        slot = self._slots.get(key) if key is not None else None
+        if slot is not None:
+            self._slots.move_to_end(key)
+            self.stats["hits"] += 1
+            return slot
+        hit = self.store.lookup(key) if key is not None else None
+        if hit is None:
+            self.stats["fallbacks"] += 1
+            return self.capacity
+        cols, vals = hit
+        if len(cols) > self.width:
+            raise ValueError(
+                f"entity {key!r} has {len(cols)} coefficients but the "
+                f"device cache width is {self.width}"
+            )
+        if self._free:
+            slot = self._free.pop()
+        else:
+            victim = next(
+                (k for k, s in self._slots.items() if s not in pinned), None
+            )
+            if victim is None:
+                raise RuntimeError(
+                    f"batch needs more than {self.capacity} distinct "
+                    "entities; raise cache capacity above max_batch"
+                )
+            slot = self._slots.pop(victim)
+            self.stats["evictions"] += 1
+        row_p = np.full(self.width, self.store.global_dim, np.int32)
+        row_c = np.zeros(self.width, np.float32)
+        row_p[: len(cols)] = cols
+        row_c[: len(vals)] = vals
+        staged.append((slot, row_p, row_c))
+        self._slots[key] = slot
+        self.stats["misses"] += 1
+        return slot
+
+    def gather(self, slots) -> tuple:
+        """Per-row (proj, coef) ``[B, P]`` device arrays for a slot vector —
+        the eager gather feeding the jitted scoring kernel."""
+        s = jnp.asarray(np.asarray(slots, np.int32))
+        return self.proj[s], self.coef[s]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "width": self.width,
+                "resident": len(self._slots),
+                **self.stats,
+            }

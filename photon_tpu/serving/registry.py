@@ -1,0 +1,148 @@
+"""Versioned model registry with atomic hot-swap for online scoring.
+
+A registry owns the serving-side view of one training-driver output
+directory: the ``MmapIndexMap``s the model was trained with, the loaded
+``GameModel``, per-coordinate data configs reconstructed from
+``game-metadata.json`` (the same reconstruction the batch scoring driver
+does), and a warmed ``RowScorer``.
+
+Hot-swap contract: ``swap(model_dir)`` builds and WARMS the new version
+entirely in the calling thread (typically an admin request handler or a
+background poller) while traffic keeps flowing against the current
+version; only then does the current-version pointer move, under a lock, in
+one reference assignment. Requests capture a version reference at submit
+time and score against it even if a swap lands mid-flight — nothing is
+ever torn down under an in-flight request (old versions are garbage-
+collected when the last request referencing them completes).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from photon_tpu.estimators import (
+    FixedEffectDataConfig,
+    RandomEffectDataConfig,
+)
+from photon_tpu.index.index_map import MmapIndexMap
+from photon_tpu.io.data_reader import FeatureShardConfig
+from photon_tpu.io.model_io import default_index_root, load_game_model
+from photon_tpu.serving.scorer import RowScorer
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Operational knobs (docs/serving.md §knobs)."""
+
+    max_batch: int = 64          # micro-batch row cap (pow2 recommended)
+    max_wait_ms: float = 2.0     # batcher coalescing window
+    cache_entities: int = 4096   # LRU device hot-set capacity per RE coord
+    max_row_nnz: int = 128       # per-shard padded feature width per row
+    default_bags: tuple = ("features",)  # pre-metadata models only
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelVersion:
+    """One immutable, fully-warmed serving snapshot of a model directory."""
+
+    version: int
+    model_dir: str
+    meta: dict
+    scorer: RowScorer
+    loaded_at: float
+
+    @property
+    def coordinates(self) -> dict:
+        return self.meta["coordinates"]
+
+
+def _build_version(
+    version: int, model_dir: str, config: ServingConfig,
+    index_dir: Optional[str] = None,
+) -> ModelVersion:
+    with open(os.path.join(model_dir, "game-metadata.json")) as f:
+        meta = json.load(f)
+    shards = {info["feature_shard"] for info in meta["coordinates"].values()}
+    index_root = index_dir or default_index_root(model_dir)
+    index_maps = {
+        s: MmapIndexMap(os.path.join(index_root, s)) for s in sorted(shards)
+    }
+    for im in index_maps.values():
+        # Touch every partition now: lazy mmap loads must not land on the
+        # first request's latency.
+        im.preload()
+    model, meta = load_game_model(model_dir, index_maps)
+
+    data_configs = {}
+    for cid, info in meta["coordinates"].items():
+        if info["type"] == "fixed":
+            data_configs[cid] = FixedEffectDataConfig(info["feature_shard"])
+        else:
+            data_configs[cid] = RandomEffectDataConfig(
+                re_type=info["re_type"], feature_shard=info["feature_shard"]
+            )
+    saved_shards = meta.get("feature_shards", {})
+    shard_configs = {
+        s: (
+            FeatureShardConfig(
+                feature_bags=tuple(saved_shards[s]["feature_bags"]),
+                add_intercept=saved_shards[s]["add_intercept"],
+            )
+            if s in saved_shards
+            else FeatureShardConfig(feature_bags=tuple(config.default_bags))
+        )
+        for s in index_maps
+    }
+    scorer = RowScorer(model, data_configs, index_maps, shard_configs, config)
+    scorer.warmup()
+    return ModelVersion(
+        version=version,
+        model_dir=model_dir,
+        meta=meta,
+        scorer=scorer,
+        loaded_at=time.time(),
+    )
+
+
+class ModelRegistry:
+    """Holds the current ModelVersion; ``swap`` replaces it atomically."""
+
+    def __init__(
+        self,
+        model_dir: str,
+        config: ServingConfig = ServingConfig(),
+        index_dir: Optional[str] = None,
+    ):
+        self.config = config
+        self._index_dir = index_dir
+        self._lock = threading.Lock()
+        self._swap_lock = threading.Lock()  # serializes concurrent swaps
+        self._next_version = 1
+        self._current: Optional[ModelVersion] = None
+        self.swap(model_dir)
+
+    @property
+    def current(self) -> ModelVersion:
+        with self._lock:
+            return self._current
+
+    def swap(self, model_dir: str) -> ModelVersion:
+        """Load + warm ``model_dir`` as a new version, then publish it.
+
+        Blocking for the caller; invisible to in-flight traffic until the
+        final pointer assignment. Raises (and keeps the current version)
+        if the new directory fails to load — a bad push can't take the
+        server down.
+        """
+        with self._swap_lock:
+            version = _build_version(
+                self._next_version, model_dir, self.config, self._index_dir
+            )
+            with self._lock:
+                self._current = version
+                self._next_version += 1
+            return version

@@ -1,0 +1,260 @@
+"""Row scorer: request parsing + padded micro-batch assembly + the kernel.
+
+One ``RowScorer`` per ``ModelVersion``. It owns the device-resident fixed
+coefficients, the per-RE-coordinate ``CoefficientStore`` + LRU device
+cache, and the stable-shape contract that keeps the shared jitted kernel
+(``estimators.game_transformer.additive_score_rows``) from ever
+recompiling after warmup:
+
+* row counts pad to the next power of two, capped at ``max_batch`` — a
+  fixed ladder of bucket shapes, all compiled by ``warmup()``;
+* per-shard feature width is the FIXED ``max_row_nnz`` (requests beyond it
+  are rejected with a client error, never silently truncated);
+* the RE subspace width is fixed per version by the coefficient store's
+  widest entity; LRU staging rewrites table rows without changing shapes.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from photon_tpu.estimators.config import (
+    FixedEffectDataConfig,
+    RandomEffectDataConfig,
+)
+from photon_tpu.estimators.game_transformer import additive_score_rows
+from photon_tpu.game.coordinates import FixedEffectModel
+from photon_tpu.game.random_effect import RandomEffectModel
+from photon_tpu.serving.coefficient_store import (
+    CoefficientStore,
+    DeviceCoefficientCache,
+    _next_pow2,
+)
+
+
+class RequestError(ValueError):
+    """Client-side problem with one request (HTTP 400, not 500)."""
+
+
+@dataclasses.dataclass
+class ParsedRow:
+    """One request after feature-index resolution (host numpy only)."""
+
+    shard_idx: Mapping[str, np.ndarray]   # shard -> [K] int32 (ghost = dim)
+    shard_val: Mapping[str, np.ndarray]   # shard -> [K] float32
+    offset: float
+    entity_keys: Mapping[str, Optional[str]]  # RE coordinate id -> key
+
+
+class RowScorer:
+    def __init__(self, model, data_configs, index_maps, shard_configs, config):
+        self.model = model
+        self.data_configs = dict(data_configs)
+        self.index_maps = dict(index_maps)
+        self.shard_configs = dict(shard_configs)
+        self.config = config
+        self._intercepts = {
+            s: im.intercept_index
+            for s, im in index_maps.items()
+            if shard_configs[s].add_intercept
+            and im.intercept_index is not None
+        }
+
+        fixed_parts, re_parts = [], []
+        self._fixed_ws, self._caches = {}, {}
+        for cid, dcfg in self.data_configs.items():
+            m = model[cid]
+            if isinstance(dcfg, FixedEffectDataConfig):
+                if not isinstance(m, FixedEffectModel):
+                    raise TypeError(
+                        f"{cid!r}: fixed-effect config, {type(m)} model"
+                    )
+                w = m.model.coefficients.means.astype(jnp.float32)
+                self._fixed_ws[cid] = jnp.concatenate(
+                    [w, jnp.zeros((1,), w.dtype)]
+                )
+                fixed_parts.append((cid, dcfg.feature_shard))
+            elif isinstance(dcfg, RandomEffectDataConfig):
+                if not isinstance(m, RandomEffectModel):
+                    raise TypeError(
+                        f"{cid!r}: random-effect config, {type(m)} model"
+                    )
+                store = CoefficientStore.from_model(m)
+                self._caches[cid] = DeviceCoefficientCache(
+                    store,
+                    # Floor at max_batch: batch slot resolution pins its
+                    # own slots against eviction, which needs one slot per
+                    # distinct in-batch entity in the worst case.
+                    capacity=max(config.cache_entities, config.max_batch),
+                )
+                re_parts.append((cid, dcfg.feature_shard))
+            else:  # pragma: no cover - union is closed
+                raise TypeError(f"unknown data config {type(dcfg)}")
+        self.fixed_parts = tuple(fixed_parts)
+        self.re_parts = tuple(re_parts)
+        self._re_types = {
+            cid: self.data_configs[cid].re_type for cid, _ in re_parts
+        }
+        self._shards_used = sorted(
+            {shard for _, shard in fixed_parts + re_parts}
+        )
+
+    # -------------------------------------------------------------- parsing
+
+    def parse_request(self, payload: dict) -> ParsedRow:
+        """JSON request → index-resolved row (docs/serving.md §schema).
+
+        Feature lists live under the shard's feature-bag keys (same record
+        fields the training data used); entity ids under ``entities`` (or a
+        top-level field named like the RE type, mirroring the reader's
+        metadataMap fallback). Unindexed features drop, like the reader;
+        unknown entities keep the row and fall back to fixed-effect-only.
+        """
+        if not isinstance(payload, dict):
+            raise RequestError("request body must be a JSON object")
+        k_cap = self.config.max_row_nnz
+        shard_idx, shard_val = {}, {}
+        for shard in self._shards_used:
+            imap = self.index_maps[shard]
+            cfg = self.shard_configs[shard]
+            dim = len(imap)
+            idxs, vals = [], []
+            icpt = self._intercepts.get(shard)
+            if icpt is not None:
+                idxs.append(icpt)
+                vals.append(1.0)
+            for bag in cfg.feature_bags:
+                feats = payload.get(bag)
+                if feats is None:
+                    continue
+                if not isinstance(feats, (list, tuple)):
+                    raise RequestError(f"feature bag {bag!r} must be a list")
+                for feat in feats:
+                    try:
+                        i = imap.get_index(feat["name"], feat.get("term"))
+                        v = float(feat["value"])
+                    except (TypeError, KeyError, ValueError) as e:
+                        raise RequestError(
+                            f"bad feature entry in bag {bag!r}: {e}"
+                        ) from None
+                    if i >= 0:  # unindexed features dropped, as the reader
+                        idxs.append(i)
+                        vals.append(v)
+            if len(idxs) > k_cap:
+                raise RequestError(
+                    f"row has {len(idxs)} features in shard {shard!r}; "
+                    f"serving caps rows at max_row_nnz={k_cap} "
+                    "(raise the knob, don't truncate)"
+                )
+            row_i = np.full(k_cap, dim, np.int32)
+            row_v = np.zeros(k_cap, np.float32)
+            row_i[: len(idxs)] = idxs
+            row_v[: len(vals)] = vals
+            shard_idx[shard] = row_i
+            shard_val[shard] = row_v
+
+        entities = payload.get("entities") or {}
+        if not isinstance(entities, dict):
+            raise RequestError('"entities" must be a map of RE type -> id')
+        entity_keys = {}
+        for cid, re_type in self._re_types.items():
+            key = entities.get(re_type)
+            if key is None:
+                key = payload.get(re_type)  # top-level fallback, as reader
+            entity_keys[cid] = None if key is None else str(key)
+        try:
+            offset = float(payload.get("offset") or 0.0)
+        except (TypeError, ValueError):
+            raise RequestError("offset must be a number") from None
+        return ParsedRow(
+            shard_idx=shard_idx,
+            shard_val=shard_val,
+            offset=offset,
+            entity_keys=entity_keys,
+        )
+
+    # -------------------------------------------------------------- scoring
+
+    def _bucket(self, n: int) -> int:
+        return min(_next_pow2(n), self.config.max_batch)
+
+    def score_rows(self, rows: Sequence[ParsedRow]) -> np.ndarray:
+        """Scores for up to ``max_batch`` rows as ONE padded kernel call;
+        longer sequences score in max_batch-sized chunks."""
+        out = []
+        cap = self.config.max_batch
+        for lo in range(0, len(rows), cap):
+            out.append(self._score_chunk(rows[lo: lo + cap]))
+        return np.concatenate(out) if out else np.zeros(0, np.float32)
+
+    def _score_chunk(self, rows: Sequence[ParsedRow]) -> np.ndarray:
+        b = len(rows)
+        bp = self._bucket(b)
+        k = self.config.max_row_nnz
+        shard_idx, shard_val = {}, {}
+        for shard in self._shards_used:
+            dim = len(self.index_maps[shard])
+            mi = np.full((bp, k), dim, np.int32)
+            mv = np.zeros((bp, k), np.float32)
+            for r, row in enumerate(rows):
+                mi[r] = row.shard_idx[shard]
+                mv[r] = row.shard_val[shard]
+            shard_idx[shard] = jnp.asarray(mi)
+            shard_val[shard] = jnp.asarray(mv)
+        offsets = np.zeros(bp, np.float32)
+        for r, row in enumerate(rows):
+            offsets[r] = row.offset
+
+        re_proj, re_coef = {}, {}
+        for cid, _ in self.re_parts:
+            cache = self._caches[cid]
+            keys = [row.entity_keys[cid] for row in rows]
+            keys += [None] * (bp - b)  # pad rows → fallback zero row
+            re_proj[cid], re_coef[cid] = cache.gather(cache.slots_for(keys))
+
+        scores = additive_score_rows(
+            jnp.asarray(offsets),
+            shard_idx,
+            shard_val,
+            self._fixed_ws,
+            re_proj,
+            re_coef,
+            fixed_parts=self.fixed_parts,
+            re_parts=self.re_parts,
+        )
+        return np.asarray(scores)[:b]
+
+    def warmup(self) -> int:
+        """Compile every row-bucket shape once (empty rows, fallback
+        entities) so no request ever waits on XLA. Returns the number of
+        buckets warmed."""
+        dummy = ParsedRow(
+            shard_idx={
+                s: np.full(
+                    self.config.max_row_nnz,
+                    len(self.index_maps[s]),
+                    np.int32,
+                )
+                for s in self._shards_used
+            },
+            shard_val={
+                s: np.zeros(self.config.max_row_nnz, np.float32)
+                for s in self._shards_used
+            },
+            offset=0.0,
+            entity_keys={cid: None for cid, _ in self.re_parts},
+        )
+        sizes, b = [], 1
+        while b < self.config.max_batch:
+            sizes.append(b)
+            b <<= 1
+        sizes.append(self.config.max_batch)  # reachable even when not pow2
+        for size in sizes:
+            self._score_chunk([dummy] * size)
+        return len(sizes)
+
+    def cache_snapshot(self) -> dict:
+        return {cid: c.snapshot() for cid, c in self._caches.items()}

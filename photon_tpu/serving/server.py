@@ -1,0 +1,245 @@
+"""Concurrent JSON scoring server on stdlib ``ThreadingHTTPServer``.
+
+Routes (docs/serving.md §schema):
+
+* ``POST /score``       — one JSON row → ``{"score": .., "model_version"}``
+* ``GET  /healthz``     — liveness + current model version
+* ``GET  /metrics``     — latency histogram (p50/p95/p99), throughput
+  counters, batcher + coefficient-cache stats, kernel compile count
+* ``POST /admin/swap``  — ``{"model_dir": ..}`` → hot-swap; blocking,
+  atomic, in-flight requests unaffected
+
+Handler threads only parse and wait; all device work funnels through the
+micro-batcher's single worker. Metrics snapshots append to the output
+directory's ``serving-metrics.jsonl`` through ``utils/logging``'s JSONL
+writer (periodically and at shutdown).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from photon_tpu.estimators.game_transformer import SCORE_KERNEL_STATS
+from photon_tpu.serving.batcher import MicroBatcher
+from photon_tpu.serving.registry import ModelRegistry
+from photon_tpu.serving.scorer import RequestError
+from photon_tpu.utils import LatencyHistogram, write_metrics_jsonl
+
+_REQUEST_TIMEOUT_S = 30.0
+
+
+class ScoringServer:
+    """Owns the HTTP front-end + instrumentation around registry/batcher."""
+
+    def __init__(
+        self,
+        registry: ModelRegistry,
+        batcher: MicroBatcher,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        logger=None,
+        metrics_path: Optional[str] = None,
+        metrics_interval_s: float = 60.0,
+    ):
+        self.registry = registry
+        self.batcher = batcher
+        self.logger = logger
+        self.metrics_path = metrics_path
+        self.latency = LatencyHistogram()
+        self.counters = {"requests": 0, "errors": 0, "swaps": 0}
+        self._started_at = time.time()
+        self._counters_lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through PhotonLogger
+                if server.logger is not None:
+                    server.logger.debug("http: " + fmt, *args)
+
+            def _reply(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_json(self) -> dict:
+                if self.headers.get("Transfer-Encoding"):
+                    # Only Content-Length bodies are read; silently scoring
+                    # an empty row for a chunked body would be a wrong
+                    # answer, not an error — refuse loudly instead. The
+                    # unread chunk bytes would desync a kept-alive
+                    # connection (parsed as the next request line), so
+                    # this connection must close after the error reply.
+                    self.close_connection = True
+                    raise RequestError(
+                        "chunked transfer encoding not supported; "
+                        "send Content-Length"
+                    )
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b"{}"
+                try:
+                    return json.loads(raw or b"{}")
+                except ValueError:
+                    raise RequestError("request body is not valid JSON")
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    v = server.registry.current
+                    self._reply(200, {
+                        "status": "ok",
+                        "model_version": v.version,
+                        "model_dir": v.model_dir,
+                        "uptime_s": round(
+                            time.time() - server._started_at, 1),
+                    })
+                elif self.path == "/metrics":
+                    self._reply(200, server.metrics_snapshot())
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path == "/score":
+                    self._score()
+                elif self.path == "/admin/swap":
+                    self._swap()
+                else:
+                    # Drain the unread body first: on a kept-alive
+                    # connection it would otherwise be parsed as the next
+                    # request line (same desync the chunked path closes).
+                    n = int(self.headers.get("Content-Length") or 0)
+                    if n:
+                        self.rfile.read(n)
+                    if self.headers.get("Transfer-Encoding"):
+                        self.close_connection = True
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def _score(self):
+                t0 = time.perf_counter()
+                try:
+                    payload = self._read_json()
+                    version = server.registry.current
+                    row = version.scorer.parse_request(payload)
+                    fut = server.batcher.submit(version, row)
+                    score = fut.result(timeout=_REQUEST_TIMEOUT_S)
+                except RequestError as e:
+                    server._count(errors=1)
+                    self._reply(400, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 - a 500, not a crash
+                    server._count(errors=1)
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                server.latency.observe(time.perf_counter() - t0)
+                server._count(requests=1)
+                out = {"score": score, "model_version": version.version}
+                if "uid" in payload:
+                    out["uid"] = payload["uid"]
+                self._reply(200, out)
+
+            def _swap(self):
+                try:
+                    payload = self._read_json()
+                    if not isinstance(payload, dict):
+                        raise RequestError(
+                            "request body must be a JSON object")
+                    model_dir = payload.get("model_dir")
+                    if not model_dir:
+                        raise RequestError("model_dir required")
+                    v = server.registry.swap(model_dir)
+                except RequestError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 - bad push, keep old
+                    server._count(errors=1)
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                server._count(swaps=1)
+                if server.logger is not None:
+                    server.logger.info(
+                        "hot-swapped to version %d (%s)", v.version, model_dir
+                    )
+                self._reply(200, {"model_version": v.version})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self._loop_started = False
+        self._serve_thread: Optional[threading.Thread] = None
+        self._metrics_stop = threading.Event()
+        self._metrics_thread: Optional[threading.Thread] = None
+        if metrics_path:
+            self._metrics_thread = threading.Thread(
+                target=self._metrics_loop,
+                args=(float(metrics_interval_s),),
+                name="photon-serve-metrics",
+                daemon=True,
+            )
+            self._metrics_thread.start()
+
+    # ---------------------------------------------------------------- admin
+
+    @property
+    def address(self) -> tuple:
+        return self.httpd.server_address[:2]
+
+    def _count(self, **deltas) -> None:
+        with self._counters_lock:
+            for k, d in deltas.items():
+                self.counters[k] += d
+
+    def metrics_snapshot(self) -> dict:
+        v = self.registry.current
+        with self._counters_lock:
+            counters = dict(self.counters)
+        elapsed = max(time.time() - self._started_at, 1e-9)
+        return {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "model_version": v.version,
+            "latency": self.latency.snapshot(),
+            "throughput_rows_per_sec": round(
+                counters["requests"] / elapsed, 2),
+            **counters,
+            "batcher": self.batcher.snapshot(),
+            "coefficient_caches": v.scorer.cache_snapshot(),
+            "kernel_traces": SCORE_KERNEL_STATS["traces"],
+        }
+
+    def _metrics_loop(self, interval_s: float) -> None:
+        while not self._metrics_stop.wait(interval_s):
+            self.flush_metrics()
+
+    def flush_metrics(self) -> None:
+        if self.metrics_path:
+            write_metrics_jsonl(self.metrics_path, [self.metrics_snapshot()])
+
+    def start(self) -> None:
+        """Serve in a background thread (tests / embedded use)."""
+        self._loop_started = True
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name="photon-serve-http",
+            daemon=True,
+        )
+        self._serve_thread.start()
+
+    def serve_forever(self) -> None:
+        self._loop_started = True
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self._metrics_stop.set()
+        if self._loop_started:
+            # socketserver.shutdown() handshakes with serve_forever() and
+            # would block forever if the loop never ran (build-only use).
+            self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5.0)
+        self.batcher.close()
+        self.flush_metrics()

@@ -1,5 +1,10 @@
 """Cross-cutting utilities — reference ⟦photon-api/.../util⟧ (SURVEY.md §5)."""
-from photon_tpu.utils.logging import PhotonLogger, Timed, write_metrics_jsonl
+from photon_tpu.utils.logging import (
+    LatencyHistogram,
+    PhotonLogger,
+    Timed,
+    write_metrics_jsonl,
+)
 from photon_tpu.utils.vectors import (
     DoubleRange,
     active_indices,
@@ -12,7 +17,7 @@ from photon_tpu.utils.vectors import (
 )
 
 __all__ = [
-    "PhotonLogger", "Timed", "write_metrics_jsonl",
+    "LatencyHistogram", "PhotonLogger", "Timed", "write_metrics_jsonl",
     "DoubleRange", "active_indices", "all_finite", "csr_to_ell",
     "dense_to_ell", "ell_to_csr", "ell_to_dense", "is_almost_zero",
 ]

@@ -10,7 +10,9 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import os
+import threading
 import time
 from typing import Any, Iterable, Mapping, Optional
 
@@ -92,3 +94,70 @@ def write_metrics_jsonl(
     with open(path, "a") as f:
         for rec in records:
             f.write(json.dumps(dict(rec)) + "\n")
+
+
+class LatencyHistogram:
+    """Log-spaced latency histogram with approximate quantiles.
+
+    Serving instrumentation (docs/serving.md): memory stays bounded under
+    any traffic volume (fixed bin array, no sample retention) while
+    p50/p95/p99 stay within one bin's relative width (~12% at the default
+    20 bins/decade). Sum and max are tracked exactly. Thread-safe.
+    """
+
+    def __init__(
+        self,
+        lo_ms: float = 0.05,
+        hi_ms: float = 60_000.0,
+        bins_per_decade: int = 20,
+    ):
+        self._lo = lo_ms / 1e3
+        self._ratio = 10.0 ** (1.0 / bins_per_decade)
+        self._log_ratio = math.log(self._ratio)
+        n = int(math.ceil(math.log(hi_ms / lo_ms) / self._log_ratio)) + 1
+        self._counts = [0] * (n + 2)  # + underflow/overflow bins
+        self._lock = threading.Lock()
+        self._sum = 0.0
+        self._max = 0.0
+        self._n = 0
+
+    def observe(self, seconds: float) -> None:
+        if seconds <= 0:
+            seconds = 1e-9
+        b = int(math.floor(math.log(seconds / self._lo) / self._log_ratio)) + 1
+        b = min(max(b, 0), len(self._counts) - 1)
+        with self._lock:
+            self._counts[b] += 1
+            self._sum += seconds
+            self._max = max(self._max, seconds)
+            self._n += 1
+
+    def quantile_ms(self, q: float) -> float:
+        """Approximate q-quantile in milliseconds (geometric bin midpoint)."""
+        with self._lock:
+            n = self._n
+            counts = list(self._counts)
+        if n == 0:
+            return 0.0
+        target = q * n
+        seen = 0
+        for b, c in enumerate(counts):
+            seen += c
+            if seen >= target:
+                if b == 0:
+                    return self._lo * 1e3
+                lo = self._lo * self._ratio ** (b - 1)
+                return lo * (self._ratio ** 0.5) * 1e3
+        return self._max * 1e3
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            n, s, mx = self._n, self._sum, self._max
+        return {
+            "count": n,
+            "mean_ms": round(s / n * 1e3, 3) if n else 0.0,
+            "p50_ms": round(self.quantile_ms(0.50), 3),
+            "p95_ms": round(self.quantile_ms(0.95), 3),
+            "p99_ms": round(self.quantile_ms(0.99), 3),
+            "max_ms": round(mx * 1e3, 3),
+        }

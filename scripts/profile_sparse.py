@@ -49,6 +49,19 @@ OUT = os.environ.get(
     if os.environ.get("PHOTON_PROFILE_SMOKE") == "1"
     else f"/tmp/profile_sparse.{os.getuid()}.json",
 )
+# Contamination guard mirroring bench.py's hard-coded diversion (ADVICE r5):
+# the fake-window rehearsal widens REAL_ACCELERATOR_BACKENDS via
+# PHOTON_ACCEPT_CPU_AS_REAL, which also widens run_variant's chip gate
+# below. If that masquerade var leaks into a shell that runs this script
+# WITHOUT the explicit smoke/out overrides, CPU timings would land in the
+# real banked ledger — divert them to the .smoke ledger instead. No flag
+# may disable this (same stance as bench.flush()'s hard-coded tuple).
+if (
+    os.environ.get("PHOTON_ACCEPT_CPU_AS_REAL")
+    and "PHOTON_PROFILE_SPARSE_OUT" not in os.environ
+    and not OUT.endswith(".smoke.json")
+):
+    OUT = f"/tmp/profile_sparse.{os.getuid()}.smoke.json"
 N, D, K = 1 << 19, 1 << 18, 32  # bench headline shape: 201 MB of idx+val+out
 if os.environ.get("PHOTON_PROFILE_SMOKE") == "1":
     # Fake-window automation rehearsal: tiny shapes prove the sequencing /
@@ -269,6 +282,12 @@ def _finalize(results: dict) -> None:
     ledger into the repo (PROFILE_SPARSE.json) so banked real-hardware
     numbers survive for the judge even if no further window opens."""
     def _mirror():
+        # Same contamination stance as the OUT diversion above: a smoke /
+        # masquerade ledger must never overwrite the repo's banked
+        # real-chip mirror, no matter which env flags are set.
+        if OUT.endswith(".smoke.json") or os.environ.get(
+                "PHOTON_ACCEPT_CPU_AS_REAL"):
+            return
         try:
             import shutil
 

@@ -1,0 +1,371 @@
+"""Online serving subsystem (photon_tpu/serving/ — docs/serving.md).
+
+Coverage per ISSUE: registry load + hot-swap under concurrent requests,
+LRU coefficient-store eviction + unseen-entity fallback parity with
+``GameTransformer``, micro-batcher shape bucketing (no recompile after
+warmup, asserted via the kernel's trace counter), and an end-to-end HTTP
+round-trip on CPU with score parity against the batch scoring driver.
+"""
+import json
+import http.client
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pytest
+
+from photon_tpu.cli import game_scoring_driver, game_training_driver
+from photon_tpu.estimators import (
+    FixedEffectDataConfig,
+    GameTransformer,
+    RandomEffectDataConfig,
+)
+from photon_tpu.estimators.game_transformer import SCORE_KERNEL_STATS
+from photon_tpu.index.index_map import MmapIndexMap
+from photon_tpu.io.avro import read_records
+from photon_tpu.io.data_reader import AvroDataReader, FeatureShardConfig
+from photon_tpu.io.model_io import load_game_model
+from photon_tpu.serving import (
+    CoefficientStore,
+    DeviceCoefficientCache,
+    MicroBatcher,
+    ModelRegistry,
+    ScoringServer,
+    ServingConfig,
+)
+from tests.test_drivers import _write_game_avro
+
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """Two trained model dirs (different reg weights) over one dataset —
+    the swap test needs genuinely different coefficient sets."""
+    d = tmp_path_factory.mktemp("servedata")
+    _write_game_avro(d / "train.avro", seed=1, n_users=6, rows_per_user=16)
+    n_val = _write_game_avro(d / "val.avro", seed=2, n_users=6,
+                             rows_per_user=16)
+    outs = []
+    for name, reg in (("m1", "1"), ("m2", "100")):
+        out = d / name
+        game_training_driver.run([
+            "--train-data", str(d / "train.avro"),
+            "--output-dir", str(out),
+            "--task", "LOGISTIC_REGRESSION",
+            "--feature-shard", "global:features",
+            "--coordinate",
+            f"fixed:type=fixed,shard=global,reg=L2,max_iter=25,reg_weights={reg}",
+            "--coordinate",
+            f"perUser:type=random,re_type=userId,shard=global,reg=L2,"
+            f"max_iter=25,reg_weights={reg}",
+            "--devices", "1",
+        ])
+        outs.append(str(out / "best"))
+    return d, outs, n_val
+
+
+def _model_and_transformer(model_dir, index_dir):
+    imap = MmapIndexMap(str(index_dir))
+    model, _ = load_game_model(str(model_dir), {"global": imap})
+    configs = {
+        "fixed": FixedEffectDataConfig("global"),
+        "perUser": RandomEffectDataConfig(
+            re_type="userId", feature_shard="global"),
+    }
+    reader = AvroDataReader(
+        {"global": imap},
+        {"global": FeatureShardConfig(("features",), True)},
+        id_tag_columns=["userId"],
+    )
+    transformer = GameTransformer(
+        model, configs, intercept_indices={"global": imap.intercept_index}
+    )
+    return model, reader, transformer
+
+
+def _payload(rec):
+    return {
+        "features": rec["features"],
+        "entities": rec["metadataMap"],
+        "uid": rec["uid"],
+    }
+
+
+def _post(host, port, path, payload):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("POST", path, body=json.dumps(payload).encode(),
+                 headers={"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    return resp.status, body
+
+
+def _get(host, port, path):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    return resp.status, body
+
+
+# ---------------------------------------------------------------- stores
+
+
+def test_coefficient_store_matches_model(trained, tmp_path):
+    d, (m1, _), _ = trained
+    model, _, _ = _model_and_transformer(m1, d / "m1" / "index" / "global")
+    re_m = model["perUser"]
+    store = CoefficientStore.from_model(re_m)
+    assert store.n_entities == re_m.n_entities
+    for key in re_m.entity_keys:
+        gi, gv = re_m.coefficients_for(key)
+        sc, sv = store.lookup(key)
+        np.testing.assert_array_equal(np.asarray(sc), np.asarray(gi))
+        np.testing.assert_allclose(np.asarray(sv), np.asarray(gv),
+                                   rtol=0, atol=1e-7)
+    assert store.lookup("ghost-entity") is None
+
+    # mmap round-trip: identical lookups through np.load(mmap_mode="r")
+    store.save(str(tmp_path / "store"))
+    loaded = CoefficientStore.load(str(tmp_path / "store"))
+    assert isinstance(loaded.cols, np.memmap) or loaded.cols.base is not None
+    for key in re_m.entity_keys:
+        a, b = store.lookup(key), loaded.lookup(str(key))
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        np.testing.assert_allclose(np.asarray(a[1]), np.asarray(b[1]),
+                                   rtol=0, atol=0)
+
+
+def test_device_cache_lru_eviction_and_fallback(trained):
+    d, (m1, _), _ = trained
+    model, _, _ = _model_and_transformer(m1, d / "m1" / "index" / "global")
+    store = CoefficientStore.from_model(model["perUser"])
+    cache = DeviceCoefficientCache(store, capacity=2)
+    keys = list(store.keys)[:3]
+    s0 = cache.slot_for(keys[0])
+    s1 = cache.slot_for(keys[1])
+    assert cache.slot_for(keys[0]) == s0            # hit, refreshes LRU
+    s2 = cache.slot_for(keys[2])                    # evicts keys[1] (LRU)
+    assert s2 == s1
+    assert cache.stats["evictions"] == 1
+    assert cache.stats["hits"] == 1
+    # staged rows carry exactly the store's coefficients
+    proj, coef = cache.gather([cache.slot_for(keys[2])])
+    sc, sv = store.lookup(keys[2])
+    np.testing.assert_array_equal(np.asarray(proj[0])[: len(sc)], sc)
+    np.testing.assert_allclose(np.asarray(coef[0])[: len(sv)], sv,
+                               rtol=0, atol=0)
+    # unseen entity and None → fallback zero row, never evicting anything
+    fb = cache.slot_for("ghost")
+    assert fb == cache.fallback_slot == cache.slot_for(None)
+    proj, coef = cache.gather([fb])
+    assert int(np.asarray(proj).max()) == store.global_dim  # all-ghost
+    assert float(np.abs(np.asarray(coef)).max()) == 0.0
+    # batch resolution pins in-batch slots: all 3 distinct keys in ONE
+    # batch would need 3 slots with only 2 available → loud error, not
+    # silent aliasing (the scorer floors capacity at max_batch).
+    with pytest.raises(RuntimeError, match="distinct entities"):
+        cache.slots_for(keys)
+
+
+# ------------------------------------------------------- registry + scorer
+
+
+def test_registry_scores_match_batch_transformer(trained):
+    """Serving scorer parity with GameTransformer on every validation row,
+    plus unseen-entity fallback = fixed-effect-only (zero model)."""
+    d, (m1, _), _ = trained
+    # cache_entities below max_batch exercises the capacity floor: the
+    # effective capacity is max_batch (8), so all 6 users stay resident.
+    config = ServingConfig(max_batch=8, cache_entities=2, max_row_nnz=32)
+    registry = ModelRegistry(m1, config)
+    scorer = registry.current.scorer
+
+    _, reader, transformer = _model_and_transformer(
+        m1, d / "m1" / "index" / "global")
+    bundle = reader.read([str(d / "val.avro")], require_labels=False)
+    ref = np.asarray(transformer.transform(bundle))
+    ref_rows = np.asarray(transformer.transform_rows(bundle))
+    # the shared-kernel row path is the same math as the bucketed path
+    np.testing.assert_allclose(ref_rows, ref, rtol=0, atol=1e-5)
+
+    recs = read_records(str(d / "val.avro"))
+    rows = [scorer.parse_request(_payload(r)) for r in recs]
+    got = scorer.score_rows(rows)
+    np.testing.assert_allclose(got, ref, rtol=0, atol=1e-5)
+    snap = scorer.cache_snapshot()["perUser"]
+    assert snap["capacity"] == 8          # floored at max_batch
+    assert snap["misses"] >= 1 and snap["hits"] > 0
+
+    # unseen entity → fixed-effect-only: equals a request with no entity
+    p = _payload(recs[0])
+    p["entities"] = {"userId": "never-seen"}
+    unseen = scorer.score_rows([scorer.parse_request(p)])[0]
+    p["entities"] = {}
+    no_entity = scorer.score_rows([scorer.parse_request(p)])[0]
+    assert unseen == pytest.approx(no_entity, abs=1e-7)
+    assert unseen != pytest.approx(float(got[0]), abs=1e-6)  # RE is real
+
+
+def test_no_recompile_after_warmup(trained):
+    """Micro-batch shape bucketing: after registry warmup, no batch size
+    1..max_batch may trigger a kernel retrace (compile counter flat)."""
+    d, (m1, _), _ = trained
+    config = ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32)
+    registry = ModelRegistry(m1, config)
+    scorer = registry.current.scorer
+    recs = read_records(str(d / "val.avro"))
+    rows = [scorer.parse_request(_payload(r)) for r in recs]
+    traces0 = SCORE_KERNEL_STATS["traces"]
+    for size in (1, 2, 3, 5, 7, 8, len(rows)):  # odd sizes pad to buckets
+        scorer.score_rows(rows[:size])
+    assert SCORE_KERNEL_STATS["traces"] == traces0
+
+
+def test_batcher_coalesces_and_recovers(trained):
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    version = registry.current
+    recs = read_records(str(d / "val.avro"))[:8]
+    rows = [version.scorer.parse_request(_payload(r)) for r in recs]
+    ref = version.scorer.score_rows(rows)
+
+    # start=False: queue everything first, so the first wake coalesces all
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=50.0, start=False)
+    futures = [batcher.submit(version, row) for row in rows]
+    batcher.start()
+    got = [f.result(timeout=30) for f in futures]
+    np.testing.assert_allclose(got, ref, rtol=0, atol=1e-6)
+    assert batcher.stats["batches"] == 1
+    assert batcher.stats["max_batch_rows"] == 8
+    batcher.close()
+    with pytest.raises(RuntimeError):
+        batcher.submit(version, rows[0])
+
+
+# ------------------------------------------------------------- end to end
+
+
+def test_server_end_to_end_with_hot_swap(trained, tmp_path):
+    """Concurrent single-row HTTP requests score with parity against the
+    batch scoring driver; a mid-traffic hot-swap completes without
+    dropping a single in-flight request and moves new traffic to v2."""
+    d, (m1, m2), n_val = trained
+    score_out = tmp_path / "batch_scores"
+    game_scoring_driver.run([
+        "--data", str(d / "val.avro"),
+        "--model-dir", m1,
+        "--output-dir", str(score_out),
+    ])
+    batch = {
+        r["uid"]: r["predictionScore"]
+        for r in read_records(str(score_out / "scores.avro"))
+    }
+
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=2.0)
+    server = ScoringServer(
+        registry, batcher, port=0,
+        metrics_path=str(tmp_path / "serving-metrics.jsonl"),
+        metrics_interval_s=3600,
+    )
+    server.start()
+    host, port = server.address
+    try:
+        recs = read_records(str(d / "val.avro"))
+
+        def score_one(rec):
+            status, body = _post(host, port, "/score", _payload(rec))
+            assert status == 200, body
+            return body
+
+        with ThreadPoolExecutor(8) as ex:
+            outs = list(ex.map(score_one, recs))
+        assert len(outs) == n_val
+        for o in outs:
+            assert o["model_version"] == 1
+            assert abs(o["score"] - batch[o["uid"]]) < 1e-4
+
+        # ---- hot-swap under load: fire requests continuously while v2
+        # loads + warms; every response must be a 200 from v1 or v2.
+        stop = threading.Event()
+        results, errors = [], []
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                try:
+                    status, body = _post(
+                        host, port, "/score", _payload(recs[i % len(recs)]))
+                    results.append((status, body.get("model_version")))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+                i += 1
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        status, body = _post(host, port, "/admin/swap", {"model_dir": m2})
+        assert status == 200, body
+        assert body["model_version"] == 2
+        time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors
+        assert results
+        assert all(status == 200 for status, _ in results)
+        versions = {v for _, v in results}
+        assert 1 in versions      # traffic flowed during the swap
+        status, body = _post(host, port, "/score", _payload(recs[0]))
+        assert status == 200 and body["model_version"] == 2
+
+        # v2 really is the other model: scores differ from v1's
+        assert body["score"] != pytest.approx(batch[recs[0]["uid"]],
+                                              abs=1e-6)
+        status, health = _get(host, port, "/healthz")
+        assert status == 200 and health["model_version"] == 2
+
+        # metrics: latency quantiles + throughput + cache stats all live
+        status, m = _get(host, port, "/metrics")
+        assert status == 200
+        assert m["requests"] == len(results) + n_val + 1
+        assert m["latency"]["count"] == m["requests"]
+        assert m["latency"]["p50_ms"] <= m["latency"]["p99_ms"]
+        assert m["batcher"]["rows"] >= m["requests"]
+        assert "perUser" in m["coefficient_caches"]
+
+        # client errors are 400s, counted, and never kill the server
+        status, body = _post(host, port, "/score", {"features": "nope"})
+        assert status == 400
+    finally:
+        server.shutdown()
+    # shutdown flushed a JSONL metrics snapshot through utils/logging
+    lines = [
+        json.loads(line)
+        for line in open(tmp_path / "serving-metrics.jsonl")
+    ]
+    assert lines and lines[-1]["model_version"] == 2
+
+
+def test_serving_driver_build(trained, tmp_path):
+    """The CLI driver builds, warms, and reports through run() (the
+    serve_forever=False smoke entry used by deploy checks)."""
+    from photon_tpu.cli import serving_driver
+
+    _, (m1, _), _ = trained
+    summary = serving_driver.run([
+        "--model-dir", m1,
+        "--port", "0",
+        "--max-batch", "4",
+        "--output-dir", str(tmp_path / "serve_out"),
+    ], serve_forever=False)
+    assert summary["model_version"] == 1
+    assert summary["coordinates"] == ["fixed", "perUser"]
+    assert (tmp_path / "serve_out" / "photon.log").exists()
+    assert (tmp_path / "serve_out" / "serving-metrics.jsonl").exists()
