@@ -884,6 +884,35 @@ def bench_serve():
             t.join()
         wall = time.perf_counter() - t0
         snap = server.metrics_snapshot()
+
+        # Degraded-mode phase (docs/robustness.md): inject a coefficient-
+        # store outage, let the circuit breaker open, and measure the
+        # fixed-effect-only path — every request must still answer 200,
+        # flagged degraded. This is the floor the serve path stands on
+        # when the store is sick; it belongs next to the happy-path number.
+        from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+
+        ghost = [
+            json.dumps({
+                "features": [{"name": "c", "term": "0", "value": 1.0}],
+                "entities": {"userId": f"bench-ghost-{i}"},
+            }).encode()
+            for i in range(64)
+        ]
+        n_deg = 128 if SMOKE else 512
+        deg_lat: list = []
+        outage = FaultPlan(seed=7, specs=[
+            FaultSpec(site="serving.store_lookup", error="os"),
+        ])
+        with active_plan(outage):
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            td0 = time.perf_counter()
+            for i in range(n_deg):
+                deg_lat.append(fire(conn, ghost[i % len(ghost)]))
+            deg_wall = time.perf_counter() - td0
+            conn.close()
+        deg_snap = server.metrics_snapshot()
+        breaker = deg_snap["breakers"].get("perUser", {})
         server.shutdown()
     if worker_errors:
         # A dead worker's rows never reach `lat`; reporting the surviving
@@ -897,6 +926,7 @@ def bench_serve():
     def q(p: float) -> float:
         return lat[min(len(lat) - 1, int(p * len(lat)))]
 
+    deg_lat.sort()
     return {
         "serve_rows_per_sec": round(len(lat) / wall, 1),
         "serve_p50_ms": round(q(0.50) * 1e3, 2),
@@ -904,6 +934,15 @@ def bench_serve():
         "serve_requests": len(lat),
         "serve_concurrency": conc,
         "serve_mean_batch_rows": snap["batcher"]["mean_batch_rows"],
+        "serve_shed": snap["batcher"]["shed"],
+        "serve_expired": snap["batcher"]["expired"],
+        # Store-outage degraded mode: breaker open, fixed-effect-only.
+        "serve_degraded_rows_per_sec": round(len(deg_lat) / deg_wall, 1),
+        "serve_degraded_p99_ms": round(
+            deg_lat[min(len(deg_lat) - 1, int(0.99 * len(deg_lat)))] * 1e3,
+            2),
+        "serve_degraded_requests": len(deg_lat),
+        "serve_breaker_opens": breaker.get("opens", 0),
     }
 
 

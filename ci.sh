@@ -30,6 +30,15 @@ if [[ "${1:-}" == "fast" ]]; then
   exit 0
 fi
 
+echo "== chaos smoke (deterministic fault injection; docs/robustness.md) =="
+# The chaos suite re-runs standalone so a fault-injection regression is
+# attributable at a glance: training preempted mid-sweep must resume
+# bit-identically, and the scoring server under store-outage + overload
+# plans must answer every request (success, degraded, or 503) — no hangs.
+# (Named files, not tests/: an unrelated collection error — e.g. a missing
+# optional dependency in another test module — must not mask chaos results.)
+python -m pytest tests/test_chaos.py tests/test_serving.py -q -m chaos
+
 echo "== multichip dryrun (8-device mesh: dp, dp x mp, RE, dcn x dp) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 

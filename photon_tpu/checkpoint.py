@@ -15,10 +15,16 @@ Mechanics:
   disk (the "async save" of SURVEY.md §5.4's rebuild note).
 * Writes are atomic: serialize to ``<dir>/tmp-<step>`` then ``os.replace`` to
   ``<dir>/step-<n>``; a torn write can never be mistaken for a checkpoint.
+* Payloads are checksummed (CRC32 in a small header): a snapshot corrupted
+  in place — a bit flip that still unpickles into plausible-looking state —
+  is refused explicitly (:class:`CheckpointCorrupt`) and ``load_latest``
+  falls back to the previous ``step-<n>``, the same path a torn write takes.
+  Pre-checksum snapshots (raw pickle) still load.
 * The newest ``keep`` checkpoints are retained.
-* Format: pickled pytree of numpy leaves + JSON-able metadata. Checkpoints
-  are ephemeral restart artifacts scoped to one training run (the durable
-  model format is the Avro layout of io/model_io.py).
+* Format: magic + CRC32 + pickled pytree of numpy leaves + JSON-able
+  metadata. Checkpoints are ephemeral restart artifacts scoped to one
+  training run (the durable model format is the Avro layout of
+  io/model_io.py).
 
 Determinism note: resume is bit-identical because everything else is already
 deterministic — down-sampling keys derive from (seed, config, coordinate) via
@@ -28,17 +34,50 @@ state restores the exact device arrays.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 import pickle
 import queue
 import re
+import struct
 import threading
+import zlib
 from typing import Any, Optional
 
 import jax
 import numpy as np
 
+from photon_tpu.faults import fault_point
+
+logger = logging.getLogger("photon_tpu.checkpoint")
+
 _STEP_RE = re.compile(r"^step-(\d+)$")
+
+# Checksummed snapshot framing: magic + little-endian CRC32 of the pickle
+# payload. Files without the magic are pre-checksum snapshots (raw pickle).
+_MAGIC = b"PHCKPT1\x00"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A snapshot file that exists but must not be trusted: checksum
+    mismatch (bit rot / in-place corruption) or undecodable payload (torn
+    write). ``load_latest`` refuses it explicitly and falls back to the
+    previous step."""
+
+
+class _Crc32Writer:
+    """File-like pass-through that CRCs everything written (so the pickle
+    streams to disk once, no full-blob copy in memory)."""
+
+    __slots__ = ("_f", "crc")
+
+    def __init__(self, f):
+        self._f = f
+        self.crc = 0
+
+    def write(self, data) -> int:
+        self.crc = zlib.crc32(data, self.crc) & 0xFFFFFFFF
+        return self._f.write(data)
 
 
 def run_fingerprint(parts: Any, length: int = 16) -> str:
@@ -71,6 +110,7 @@ class CheckpointManager:
         self._queue: "queue.Queue" = queue.Queue()
         self._error: Optional[BaseException] = None
         self._saves = 0
+        self.last_skipped: list[tuple[int, str]] = []
         self._worker = threading.Thread(target=self._drain, daemon=True)
         self._worker.start()
 
@@ -96,9 +136,21 @@ class CheckpointManager:
                 return
             step, payload = item
             try:
+                fault_point("checkpoint.write", step=step)
                 tmp = os.path.join(self.directory, f"tmp-{step}")
                 with open(tmp, "wb") as f:
-                    pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+                    # STREAM the pickle through a CRC-accumulating wrapper
+                    # (placeholder CRC patched afterwards): materializing
+                    # the blob with pickle.dumps would double peak host
+                    # memory for multi-GB snapshots.
+                    f.write(_MAGIC)
+                    f.write(struct.pack("<I", 0))
+                    crc_writer = _Crc32Writer(f)
+                    pickle.dump(payload, crc_writer,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                    f.flush()
+                    f.seek(len(_MAGIC))
+                    f.write(struct.pack("<I", crc_writer.crc))
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp, os.path.join(self.directory, f"step-{step}"))
@@ -140,16 +192,60 @@ class CheckpointManager:
         steps = self._list_steps()
         return max(steps) if steps else None
 
+    def load_file(self, path: str) -> dict:
+        """Read + verify one snapshot file.
+
+        Checksummed files (the current format) verify CRC32 before
+        unpickling, so in-place corruption that would still unpickle into
+        plausible garbage is refused, not resumed. Files without the magic
+        are pre-checksum snapshots and load as raw pickle. Either way, an
+        untrustworthy file raises :class:`CheckpointCorrupt`.
+        """
+        fault_point("checkpoint.load", path=path)
+        with open(path, "rb") as f:
+            head = f.read(len(_MAGIC))
+            if head == _MAGIC:
+                crc_bytes = f.read(4)
+                if len(crc_bytes) < 4:
+                    # Torn inside the header itself (magic landed, CRC did
+                    # not) — corrupt, not a crash.
+                    raise CheckpointCorrupt(
+                        f"{path}: truncated checkpoint header"
+                    )
+                (stored,) = struct.unpack("<I", crc_bytes)
+                blob = f.read()
+                if zlib.crc32(blob) & 0xFFFFFFFF != stored:
+                    raise CheckpointCorrupt(
+                        f"{path}: checksum mismatch (stored {stored:#010x}) "
+                        "— refusing corrupted snapshot"
+                    )
+            else:
+                blob = head + f.read()  # pre-checksum snapshot
+        try:
+            return pickle.loads(blob)
+        except Exception as e:
+            raise CheckpointCorrupt(
+                f"{path}: undecodable payload ({type(e).__name__}: {e})"
+            ) from e
+
     def load_latest(self) -> Optional[dict]:
-        """Newest readable checkpoint payload, or None. A corrupt newest file
-        (torn write from a hard kill) falls back to the previous one."""
+        """Newest trustworthy checkpoint payload, or None. A corrupt newest
+        file — torn write from a hard kill, or a checksum-refused snapshot —
+        falls back to the previous one; refusals are logged and recorded in
+        ``self.last_skipped`` as ``(step, reason)``."""
+        self.last_skipped: list[tuple[int, str]] = []
         for s in sorted(self._list_steps(), reverse=True):
             path = os.path.join(self.directory, f"step-{s}")
             try:
-                with open(path, "rb") as f:
-                    return pickle.load(f)
-            except Exception:
-                continue
+                return self.load_file(path)
+            except CheckpointCorrupt as e:
+                logger.warning(
+                    "refusing checkpoint step-%d (%s); falling back to the "
+                    "previous snapshot", s, e,
+                )
+                self.last_skipped.append((s, str(e)))
+            except OSError as e:
+                self.last_skipped.append((s, f"unreadable: {e}"))
         return None
 
     def load_checked(self, kind: str, fingerprint: str) -> Optional[dict]:

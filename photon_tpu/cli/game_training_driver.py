@@ -147,9 +147,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "max/nnz) for every shard to <output-dir>/summary/"
                         "<shard>.avro (reference FeatureSummarizationResultAvro "
                         "output, SURVEY.md §3.1 feature-summarization stage)")
-    from photon_tpu.cli.params import add_compilation_cache_flag
+    from photon_tpu.cli.params import (
+        add_compilation_cache_flag,
+        add_fault_plan_flag,
+    )
 
     add_compilation_cache_flag(p)
+    add_fault_plan_flag(p)
     return p
 
 
@@ -204,9 +208,13 @@ def _load_or_build_indexes(args, shard_specs, logger):
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     """Run training; returns a result summary dict (also written to disk)."""
     args = build_arg_parser().parse_args(argv)
-    from photon_tpu.cli.params import enable_compilation_cache
+    from photon_tpu.cli.params import (
+        enable_compilation_cache,
+        enable_fault_plan,
+    )
 
     enable_compilation_cache(args.compilation_cache_dir)
+    enable_fault_plan(args.fault_plan)
     # Join the multi-host runtime first (no-op single-process) so
     # jax.devices() below sees the whole pod slice (SURVEY.md §5.8).
     from photon_tpu.parallel.distributed import initialize_distributed

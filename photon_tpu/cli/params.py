@@ -280,3 +280,33 @@ def enable_compilation_cache(path) -> None:
         "jax_persistent_cache_min_compile_time_secs",
         float(os.environ.get("PHOTON_XLA_CACHE_MIN_SECS", "1.0")),
     )
+
+
+def add_fault_plan_flag(parser) -> None:
+    """Shared --fault-plan flag (default: $PHOTON_FAULT_PLAN): run the
+    driver under a deterministic fault-injection plan for chaos drills
+    (docs/robustness.md). Never set in production."""
+    import os
+
+    parser.add_argument(
+        "--fault-plan",
+        default=os.environ.get("PHOTON_FAULT_PLAN") or None,
+        help="JSON FaultPlan file (photon_tpu.faults): inject seeded "
+             "faults — IO errors, preemptions, store latency — at the "
+             "framework's hook points to rehearse recovery paths "
+             "(default: $PHOTON_FAULT_PLAN)")
+
+
+def enable_fault_plan(path) -> None:
+    """Install the plan file process-wide (no-op if falsy)."""
+    if not path:
+        return
+    import logging
+
+    from photon_tpu.faults import install_from_file
+
+    install_from_file(path)
+    logging.getLogger("photon_tpu.faults").warning(
+        "FAULT INJECTION ACTIVE: plan %s (chaos drill — not production)",
+        path,
+    )

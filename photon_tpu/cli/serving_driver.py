@@ -52,21 +52,41 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-row-nnz", type=int, default=128,
                    help="per-shard feature cap per request row (stable-shape "
                         "contract; over-cap rows get HTTP 400)")
+    p.add_argument("--max-queue", type=int, default=1024,
+                   help="admission-queue bound; beyond it requests shed "
+                        "with HTTP 503 + Retry-After (docs/robustness.md)")
+    p.add_argument("--request-timeout", type=float, default=30.0,
+                   help="per-request deadline in seconds, enforced inside "
+                        "the batcher (expired rows never reach the kernel)")
+    p.add_argument("--breaker-failures", type=int, default=5,
+                   help="consecutive coefficient-store failures that open "
+                        "the circuit breaker (0 disables); while open, RE "
+                        "lookups degrade to fixed-effect-only scoring")
+    p.add_argument("--breaker-cooldown", type=float, default=2.0,
+                   help="seconds the breaker stays open before a probe")
     p.add_argument("--output-dir", default=None,
                    help="photon.log + serving-metrics.jsonl land here")
     p.add_argument("--metrics-interval", type=float, default=60.0,
                    help="seconds between JSONL metrics snapshots")
-    from photon_tpu.cli.params import add_compilation_cache_flag
+    from photon_tpu.cli.params import (
+        add_compilation_cache_flag,
+        add_fault_plan_flag,
+    )
 
     add_compilation_cache_flag(p)
+    add_fault_plan_flag(p)
     return p
 
 
 def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
     """Registry (load + warm) → batcher → HTTP front-end, not yet serving."""
-    from photon_tpu.cli.params import enable_compilation_cache
+    from photon_tpu.cli.params import (
+        enable_compilation_cache,
+        enable_fault_plan,
+    )
 
     enable_compilation_cache(args.compilation_cache_dir)
+    enable_fault_plan(args.fault_plan)
     plogger = PhotonLogger(args.output_dir)
     logger = plogger.logger
     config = ServingConfig(
@@ -74,6 +94,10 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
         max_wait_ms=args.max_wait_ms,
         cache_entities=args.cache_entities,
         max_row_nnz=args.max_row_nnz,
+        max_queue=args.max_queue,
+        request_timeout_s=args.request_timeout,
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown,
     )
     from photon_tpu.utils import Timed
 
@@ -82,7 +106,9 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
             args.model_dir, config, index_dir=args.index_dir
         )
     batcher = MicroBatcher(
-        max_batch=config.max_batch, max_wait_ms=config.max_wait_ms
+        max_batch=config.max_batch,
+        max_wait_ms=config.max_wait_ms,
+        max_queue=config.max_queue,
     )
     metrics_path = (
         os.path.join(args.output_dir, "serving-metrics.jsonl")
@@ -97,6 +123,7 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
         logger=logger,
         metrics_path=metrics_path,
         metrics_interval_s=args.metrics_interval,
+        request_timeout_s=config.request_timeout_s,
     )
     v = registry.current
     logger.info(

@@ -1,0 +1,36 @@
+"""Deterministic fault-injection framework + chaos helpers
+(docs/robustness.md).
+
+``fault_point(site, **ctx)`` hooks are threaded through io, checkpoint,
+supervisor, descent, and serving; a seeded :class:`FaultPlan` decides which
+of them misbehave. The chaos test suite (``pytest -m chaos``) drives
+training and serving under injected plans and asserts the recovery
+contracts hold (bit-identical resume, no hung requests, bounded
+degradation).
+"""
+from photon_tpu.faults.chaos import bit_flip, torn_write
+from photon_tpu.faults.plan import (
+    FaultInjector,
+    FaultPlan,
+    FaultSpec,
+    PreemptionError,
+    active_plan,
+    deactivate,
+    fault_point,
+    install,
+    install_from_file,
+)
+
+__all__ = [
+    "FaultInjector",
+    "FaultPlan",
+    "FaultSpec",
+    "PreemptionError",
+    "active_plan",
+    "bit_flip",
+    "deactivate",
+    "fault_point",
+    "install",
+    "install_from_file",
+    "torn_write",
+]

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.evaluation import EvaluationResults, EvaluationSuite
+from photon_tpu.faults import fault_point
 from photon_tpu.game.coordinates import Coordinate, DatumScoringModel
 
 Array = jax.Array
@@ -171,6 +172,12 @@ class CoordinateDescent:
                 if resumed_pos is not None and (sweep, ci) <= resumed_pos:
                     step += 1
                     continue
+                # Chaos hook: a preemption delivered here kills the attempt
+                # between steps — after the previous step's checkpoint, before
+                # this one's work — the exact window resume must cover.
+                fault_point(
+                    "descent.step", sweep=sweep, coordinate=cid, step=step
+                )
                 coord = coordinates[cid]
                 t0 = time.perf_counter()
                 residual_offset = total - scores[cid]

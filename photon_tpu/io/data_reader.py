@@ -256,7 +256,13 @@ class AvroDataReader:
 
 
 def _iter_records(files: list[str]) -> Iterable[dict]:
+    from photon_tpu.faults import fault_point
+
     for path in files:
+        # Chaos hook (docs/robustness.md): per-file IO faults on the
+        # per-record fallback path (the streaming path injects per block
+        # through io/streaming.py and carries its own bounded retry).
+        fault_point("io.record_read", path=path)
         _, it = read_container(path)
         yield from it
 

@@ -39,9 +39,13 @@ from __future__ import annotations
 
 import ctypes
 import dataclasses
-from typing import Iterator, Mapping, Optional, Sequence
+import logging
+import time
+from typing import Callable, Iterator, Mapping, Optional, Sequence
 
 import numpy as np
+
+from photon_tpu.faults import fault_point
 
 from photon_tpu.data.batch import SparseFeatures
 from photon_tpu.index.index_map import (
@@ -53,6 +57,8 @@ from photon_tpu.index.index_map import (
 from photon_tpu.io import avro
 from photon_tpu.io.avro import SchemaError
 from photon_tpu import native
+
+logger = logging.getLogger("photon_tpu.io")
 
 # Type-tree node kinds — must match avro_block.cc.
 K_NULL, K_BOOL, K_INT, K_LONG, K_FLOAT, K_DOUBLE = 0, 1, 2, 3, 4, 5
@@ -825,6 +831,79 @@ def iter_container_blocks(path: str):
     return schema, codec, blocks()
 
 
+def iter_blocks_with_retry(
+    path: str,
+    retries: int = 2,
+    backoff_s: float = 0.05,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """``iter_container_blocks`` with bounded retry of transient IO errors.
+
+    A single flaky read (network filesystem hiccup, object-store 5xx
+    surfaced as ``OSError``) used to kill the whole ingest. Here each
+    transient ``OSError`` — during the header open or mid-stream — reopens
+    the container after an exponential backoff and SKIPS the blocks already
+    yielded (block framing is positional, so re-reading and discarding the
+    prefix is exact; rows already decoded downstream stay valid). After
+    ``retries`` reopens the error propagates. ``FileNotFoundError`` never
+    retries: a missing input is a config bug, not a hiccup.
+
+    The per-block ``io.block_read`` fault point lives here, so injected
+    faults exercise exactly this recovery path.
+    """
+    attempt = 0
+    while True:
+        try:
+            schema, codec, blocks = iter_container_blocks(path)
+            break
+        except FileNotFoundError:
+            raise
+        except OSError as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            logger.warning(
+                "transient open error on %s (%s); retry %d/%d",
+                path, e, attempt, retries,
+            )
+            sleep(backoff_s * (2 ** (attempt - 1)))
+
+    def gen():
+        nonlocal blocks
+        attempts = attempt
+        yielded = 0
+        while True:
+            try:
+                if blocks is None:
+                    # Reopen INSIDE the protected region: during a real
+                    # outage the reopen is the call most likely to fail,
+                    # and it must draw on the same retry budget.
+                    _, _, blocks = iter_container_blocks(path)
+                skip = yielded
+                for payload, count in blocks:
+                    if skip:
+                        skip -= 1
+                        continue
+                    fault_point("io.block_read", path=path, block=yielded)
+                    yield payload, count
+                    yielded += 1
+                return
+            except FileNotFoundError:
+                raise
+            except OSError as e:
+                blocks = None
+                attempts += 1
+                if attempts > retries:
+                    raise
+                logger.warning(
+                    "transient read error on %s block %d (%s); retry %d/%d",
+                    path, yielded, e, attempts, retries,
+                )
+                sleep(backoff_s * (2 ** (attempts - 1)))
+
+    return schema, codec, gen()
+
+
 def collect_feature_keys(
     paths,
     shard_configs: Mapping[str, object],
@@ -913,10 +992,16 @@ class StreamingAvroReader:
         id_tag_columns: Sequence[str] = (),
         chunk_rows: int = 1 << 20,
         capture_uids: bool = True,
+        io_retries: int = 2,
+        io_retry_backoff_s: float = 0.05,
     ):
         from photon_tpu.io.data_reader import FeatureShardConfig, InputColumnNames
 
         self.columns = columns or InputColumnNames()
+        # Bounded retry of transient OSErrors per input file (see
+        # iter_blocks_with_retry); 0 disables.
+        self.io_retries = int(io_retries)
+        self.io_retry_backoff_s = float(io_retry_backoff_s)
         self.index_maps = dict(index_maps)
         self.shard_configs = dict(shard_configs) if shard_configs else {
             s: FeatureShardConfig(feature_bags=(self.columns.features,))
@@ -971,7 +1056,10 @@ class StreamingAvroReader:
         dec: Optional[NativeDecoder] = None
         pending = 0
         for path in files:
-            schema, _, blocks = iter_container_blocks(path)
+            schema, _, blocks = iter_blocks_with_retry(
+                path, retries=self.io_retries,
+                backoff_s=self.io_retry_backoff_s,
+            )
             d = self._decoder_for(schema)
             if dec is not None and d is not dec and pending:
                 yield self._finish_chunk(dec, dtype, require_labels)

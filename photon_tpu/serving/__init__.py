@@ -13,9 +13,21 @@ cannot provide:
 * ``server``        — stdlib ``ThreadingHTTPServer`` JSON front-end with
   latency histograms and JSONL metrics.
 
+Robustness (docs/robustness.md): bounded admission queue with load
+shedding (503 + Retry-After), request deadlines enforced inside the
+batcher, a circuit breaker that degrades a sick coefficient store to
+fixed-effect-only scoring, and worker-crash detection surfaced through
+``/healthz`` — all exercised by the chaos suite (``pytest -m chaos``).
+
 CLI entry point: ``photon_tpu/cli/serving_driver.py``.
 """
-from photon_tpu.serving.batcher import MicroBatcher
+from photon_tpu.serving.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    Overloaded,
+    ScoreResult,
+)
+from photon_tpu.serving.circuit import CircuitBreaker
 from photon_tpu.serving.coefficient_store import (
     CoefficientStore,
     DeviceCoefficientCache,
@@ -29,13 +41,17 @@ from photon_tpu.serving.scorer import ParsedRow, RowScorer
 from photon_tpu.serving.server import ScoringServer
 
 __all__ = [
+    "CircuitBreaker",
     "CoefficientStore",
+    "DeadlineExceeded",
     "DeviceCoefficientCache",
     "MicroBatcher",
     "ModelRegistry",
     "ModelVersion",
+    "Overloaded",
     "ParsedRow",
     "RowScorer",
+    "ScoreResult",
     "ScoringServer",
     "ServingConfig",
 ]

@@ -12,6 +12,21 @@ construction. Each queue item carries its ``ModelVersion`` reference: a
 batch only ever contains rows of ONE version, so a hot-swap mid-stream
 simply splits a batch — in-flight requests finish on the version they
 captured, new ones ride the new version, none are dropped.
+
+Overload and failure story (docs/robustness.md):
+
+* ADMISSION is bounded: at most ``max_queue`` requests may wait. Beyond
+  that ``submit`` raises :class:`Overloaded` immediately — the server turns
+  it into HTTP 503 + ``Retry-After`` (load shedding) instead of letting the
+  queue, and every queued request's latency, grow without bound.
+* DEADLINES propagate into the worker: a request whose deadline passed
+  while it sat in the queue is failed with :class:`DeadlineExceeded`
+  *before* the jitted kernel runs — the waiter already gave up, so burning
+  device time on its row would only add latency to live requests behind it.
+* A WORKER CRASH (exception escaping the loop itself, not a per-batch
+  scoring error) fails every pending future immediately and marks the
+  batcher unhealthy (``/healthz`` goes 503) — queued waiters must not sit
+  out the full request timeout against a dead worker.
 """
 from __future__ import annotations
 
@@ -19,14 +34,26 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
+from typing import Optional
+
+from photon_tpu.faults import fault_point
+
+
+class Overloaded(RuntimeError):
+    """Admission queue full; the caller should shed this request (503)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before its row reached the kernel."""
 
 
 class _Pending:
-    __slots__ = ("version", "row", "future")
+    __slots__ = ("version", "row", "deadline", "future")
 
-    def __init__(self, version, row):
+    def __init__(self, version, row, deadline=None):
         self.version = version
         self.row = row
+        self.deadline = deadline  # time.monotonic() value, or None
         self.future: Future = Future()
 
 
@@ -35,20 +62,31 @@ class MicroBatcher:
         self,
         max_batch: int = 64,
         max_wait_ms: float = 2.0,
+        max_queue: int = 1024,
         start: bool = True,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1e3
-        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self.max_queue = int(max_queue)
+        # A bounded stdlib queue IS the admission control: put_nowait past
+        # maxsize raises queue.Full, which submit turns into Overloaded.
+        self._q: queue.Queue = queue.Queue(maxsize=self.max_queue)
         self._carry: list = []  # other-version items deferred one round
+        self._inflight: list = []  # items the worker holds this round
         self._stop = threading.Event()
-        # Serializes submit vs close: a submit that passed the stop check
-        # must finish its put before close drains, or the item's future
+        self.failed: Optional[BaseException] = None
+        # Serializes submit vs close/crash: a submit that passed the checks
+        # must finish its put before a drain runs, or the item's future
         # would sit unresolved until the request timeout.
         self._submit_lock = threading.Lock()
-        self.stats = {"batches": 0, "rows": 0, "max_batch_rows": 0}
+        self.stats = {
+            "batches": 0, "rows": 0, "max_batch_rows": 0,
+            "shed": 0, "expired": 0,
+        }
         self._thread = threading.Thread(
             target=self._loop, name="photon-serve-batcher", daemon=True
         )
@@ -59,14 +97,32 @@ class MicroBatcher:
         if not self._thread.is_alive():
             self._thread.start()
 
-    def submit(self, version, row) -> Future:
+    @property
+    def healthy(self) -> bool:
+        """False once the worker has died from an unexpected exception."""
+        return self.failed is None
+
+    def submit(self, version, row, deadline: Optional[float] = None) -> Future:
         """Enqueue one parsed row against ``version``; resolves to its
-        float score (or the scoring exception)."""
+        score (or the scoring exception). ``deadline`` is a
+        ``time.monotonic()`` value after which the row is dropped unscored
+        (future fails with :class:`DeadlineExceeded`). Raises
+        :class:`Overloaded` when the admission queue is full."""
         with self._submit_lock:
+            if self.failed is not None:
+                raise RuntimeError(
+                    "batcher worker died"
+                ) from self.failed
             if self._stop.is_set():
                 raise RuntimeError("batcher is shut down")
-            item = _Pending(version, row)
-            self._q.put(item)
+            item = _Pending(version, row, deadline)
+            try:
+                self._q.put_nowait(item)
+            except queue.Full:
+                self.stats["shed"] += 1
+                raise Overloaded(
+                    f"admission queue full ({self.max_queue} waiting)"
+                ) from None
         return item.future
 
     def close(self) -> None:
@@ -75,28 +131,52 @@ class MicroBatcher:
         if self._thread.is_alive():
             self._thread.join(timeout=5.0)
         # Fail anything still queued rather than hanging its waiter.
-        leftovers = list(self._carry)
+        self._fail_pending(RuntimeError("scoring server shut down"))
+
+    # ------------------------------------------------------------ internals
+
+    def _take(self, timeout: Optional[float]) -> _Pending:
+        """Pop one queued item (worker thread / final drain)."""
+        if timeout is not None:
+            return self._q.get(timeout=timeout)
+        return self._q.get_nowait()
+
+    def _fail_pending(self, error: BaseException) -> None:
+        # _inflight first: items the worker had already dequeued when it
+        # died would otherwise be invisible to the drain below and leave
+        # their waiters hanging the full request timeout.
+        leftovers = list(self._inflight) + list(self._carry)
+        self._inflight = []
         self._carry = []
         while True:
             try:
-                leftovers.append(self._q.get_nowait())
+                leftovers.append(self._take(None))
             except queue.Empty:
                 break
         for item in leftovers:
             if not item.future.done():
-                item.future.set_exception(
-                    RuntimeError("scoring server shut down")
-                )
-
-    # ------------------------------------------------------------ internals
+                item.future.set_exception(error)
 
     def _loop(self) -> None:
+        try:
+            self._run()
+        except BaseException as e:  # noqa: BLE001 - worker death, not a batch error
+            # Mark failure UNDER the submit lock: any submit that has not
+            # yet enqueued will now raise, and everything already enqueued
+            # is drained below — no future can slip through unresolved.
+            with self._submit_lock:
+                self.failed = e
+            self._fail_pending(
+                RuntimeError(f"batcher worker died: {type(e).__name__}: {e}")
+            )
+
+    def _run(self) -> None:
         while not self._stop.is_set():
             items = self._carry
             self._carry = []
             if not items:
                 try:
-                    items = [self._q.get(timeout=0.1)]
+                    items = [self._take(timeout=0.1)]
                 except queue.Empty:
                     continue
             deadline = time.monotonic() + self.max_wait_s
@@ -105,26 +185,48 @@ class MicroBatcher:
                 if remaining <= 0:
                     break
                 try:
-                    items.append(self._q.get(timeout=remaining))
+                    items.append(self._take(timeout=remaining))
                 except queue.Empty:
                     break
             # Drain anything already queued (no extra waiting).
             while len(items) < self.max_batch:
                 try:
-                    items.append(self._q.get_nowait())
+                    items.append(self._take(None))
                 except queue.Empty:
                     break
+            # Deadline-expired rows are dropped BEFORE the kernel runs:
+            # their waiters have (or are about to) time out, and scoring
+            # them would only delay the live rows behind them.
+            now = time.monotonic()
+            live = []
+            for it in items:
+                if it.deadline is not None and now >= it.deadline:
+                    self.stats["expired"] += 1
+                    if not it.future.done():
+                        it.future.set_exception(DeadlineExceeded(
+                            "request deadline passed before scoring"
+                        ))
+                else:
+                    live.append(it)
+            if not live:
+                continue
+            items = live
+            self._inflight = items  # crash drain covers dequeued items
+            fault_point("serving.batcher_batch", rows=len(items))
             v0 = items[0].version
             batch = [it for it in items if it.version is v0]
             self._carry = [it for it in items if it.version is not v0]
             try:
-                scores = v0.scorer.score_rows([it.row for it in batch])
-                for it, s in zip(batch, scores):
-                    it.future.set_result(float(s))
+                scores, flags = v0.scorer.score_rows_flagged(
+                    [it.row for it in batch]
+                )
+                for it, s, fl in zip(batch, scores, flags):
+                    it.future.set_result(ScoreResult(float(s), fl))
             except Exception as e:  # noqa: BLE001 - routed to the waiter
                 for it in batch:
                     if not it.future.done():
                         it.future.set_exception(e)
+            self._inflight = []
             self.stats["batches"] += 1
             self.stats["rows"] += len(batch)
             self.stats["max_batch_rows"] = max(
@@ -135,4 +237,20 @@ class MicroBatcher:
         s = dict(self.stats)
         s["mean_batch_rows"] = round(
             s["rows"] / s["batches"], 2) if s["batches"] else 0.0
+        s["queued"] = self._q.qsize()
+        s["max_queue"] = self.max_queue
+        s["healthy"] = self.healthy
         return s
+
+
+class ScoreResult(float):
+    """A score that IS a float (full arithmetic/JSON compatibility) plus the
+    degradation flags: which RE coordinates scored fixed-effect-only because
+    their coefficient-store circuit breaker was open."""
+
+    __slots__ = ("degraded",)
+
+    def __new__(cls, value: float, degraded=()):
+        obj = super().__new__(cls, value)
+        obj.degraded = tuple(degraded)
+        return obj

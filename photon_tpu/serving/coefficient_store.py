@@ -25,11 +25,15 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from collections import OrderedDict
 from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
+
+from photon_tpu.faults import fault_point
+from photon_tpu.serving.circuit import CircuitBreaker
 
 _META = "store-meta.json"
 
@@ -100,6 +104,9 @@ class CoefficientStore:
 
     def lookup(self, key) -> Optional[tuple[np.ndarray, np.ndarray]]:
         """(global_cols, values) views for one entity, or None if unseen."""
+        # Chaos hook: latency spikes (delay_s) and IO errors on the store
+        # path — what an mmap'd table on a sick filesystem really does.
+        fault_point("serving.store_lookup", key=key)
         row = self._key_to_row.get(key)
         if row is None:
             return None
@@ -159,12 +166,18 @@ class DeviceCoefficientCache:
     def __init__(
         self, store: CoefficientStore, capacity: int = 4096,
         width: Optional[int] = None,
+        breaker: Optional[CircuitBreaker] = None,
     ):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.store = store
         self.capacity = int(capacity)
         self.width = _next_pow2(width or store.max_width)
+        # Optional circuit breaker around store lookups: when open, misses
+        # degrade to the fallback zero row (fixed-effect-only) instead of
+        # touching — or failing on — a sick store. Cache HITS still serve
+        # full RE scores; only the store path degrades.
+        self.breaker = breaker
         # +1 row: the permanent fallback zero row (all-ghost projection).
         self.proj = jnp.full(
             (self.capacity + 1, self.width), store.global_dim, jnp.int32
@@ -173,7 +186,10 @@ class DeviceCoefficientCache:
         self._slots: OrderedDict = OrderedDict()   # key -> slot, LRU order
         self._free = list(range(self.capacity))
         self._lock = threading.Lock()
-        self.stats = {"hits": 0, "misses": 0, "evictions": 0, "fallbacks": 0}
+        self.stats = {
+            "hits": 0, "misses": 0, "evictions": 0, "fallbacks": 0,
+            "degraded": 0,
+        }
 
     @property
     def fallback_slot(self) -> int:
@@ -185,7 +201,14 @@ class DeviceCoefficientCache:
         return int(self.slots_for([key])[0])
 
     def slots_for(self, keys) -> np.ndarray:
-        """Cache slots for a batch of entity keys, staging misses.
+        """Cache slots for a batch of entity keys (see :meth:`resolve`)."""
+        return self.resolve(keys)[0]
+
+    def resolve(self, keys) -> tuple[np.ndarray, np.ndarray]:
+        """``(slots, degraded)`` for a batch of entity keys, staging misses.
+        ``degraded[i]`` marks rows routed to the fallback zero row because
+        the store breaker was open or the store call failed — NOT rows whose
+        entity is simply unseen (those are correct fallbacks, not degraded).
 
         Slots already handed out WITHIN this batch are pinned against
         eviction until the batch resolves — without the pin, a batch
@@ -200,11 +223,12 @@ class DeviceCoefficientCache:
         cold starts and long-tail churn O(capacity) per row.
         """
         out = np.empty(len(keys), np.int32)
+        degraded = np.zeros(len(keys), bool)
         with self._lock:
             pinned: set = set()
             staged: list = []  # (slot, padded cols row, padded vals row)
             for i, key in enumerate(keys):
-                out[i] = self._slot_locked(key, pinned, staged)
+                out[i], degraded[i] = self._slot_locked(key, pinned, staged)
                 if out[i] != self.capacity:
                     pinned.add(int(out[i]))
             if staged:
@@ -218,18 +242,42 @@ class DeviceCoefficientCache:
                 self.coef = self.coef.at[rows].set(
                     jnp.asarray(np.stack([c for _, _, c in staged]))
                 )
-        return out
+        return out, degraded
 
-    def _slot_locked(self, key, pinned: set, staged: list) -> int:
+    def _guarded_lookup(self, key) -> tuple[Optional[tuple], bool]:
+        """``store.lookup`` behind the breaker: ``(hit, degraded)``.
+        Degraded = the store was not consulted (breaker open) or its call
+        failed / ran slow — the row scores fixed-effect-only but the
+        request survives."""
+        br = self.breaker
+        if br is None:
+            return self.store.lookup(key), False
+        if not br.allow():
+            self.stats["degraded"] += 1
+            return None, True
+        t0 = time.monotonic()
+        try:
+            hit = self.store.lookup(key)
+        except Exception:  # noqa: BLE001 - degrade, never fail the request
+            br.record_failure()
+            self.stats["degraded"] += 1
+            return None, True
+        br.record_success(time.monotonic() - t0)
+        return hit, False
+
+    def _slot_locked(self, key, pinned: set, staged: list) -> tuple[int, bool]:
         slot = self._slots.get(key) if key is not None else None
         if slot is not None:
             self._slots.move_to_end(key)
             self.stats["hits"] += 1
-            return slot
-        hit = self.store.lookup(key) if key is not None else None
+            return slot, False
+        hit, degraded = (
+            self._guarded_lookup(key) if key is not None else (None, False)
+        )
         if hit is None:
-            self.stats["fallbacks"] += 1
-            return self.capacity
+            if not degraded:
+                self.stats["fallbacks"] += 1
+            return self.capacity, degraded
         cols, vals = hit
         if len(cols) > self.width:
             raise ValueError(
@@ -256,7 +304,7 @@ class DeviceCoefficientCache:
         staged.append((slot, row_p, row_c))
         self._slots[key] = slot
         self.stats["misses"] += 1
-        return slot
+        return slot, False
 
     def gather(self, slots) -> tuple:
         """Per-row (proj, coef) ``[B, P]`` device arrays for a slot vector —
@@ -266,9 +314,12 @@ class DeviceCoefficientCache:
 
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "capacity": self.capacity,
                 "width": self.width,
                 "resident": len(self._slots),
                 **self.stats,
             }
+        if self.breaker is not None:
+            out["breaker"] = self.breaker.snapshot()
+        return out

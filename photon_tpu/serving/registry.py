@@ -43,6 +43,16 @@ class ServingConfig:
     cache_entities: int = 4096   # LRU device hot-set capacity per RE coord
     max_row_nnz: int = 128       # per-shard padded feature width per row
     default_bags: tuple = ("features",)  # pre-metadata models only
+    # Robustness knobs (docs/robustness.md): bounded admission queue
+    # (beyond it requests shed with HTTP 503 + Retry-After), per-request
+    # deadline propagated into the batcher, and the coefficient-store
+    # circuit breaker (0 breaker_failures disables; when open, RE lookups
+    # degrade to fixed-effect-only scoring, flagged in the response).
+    max_queue: int = 1024        # admission-queue bound (load shedding)
+    request_timeout_s: float = 30.0  # per-request deadline
+    breaker_failures: int = 5    # consecutive store failures to open
+    breaker_cooldown_s: float = 2.0  # open-state duration before a probe
+    breaker_slow_call_s: float = 0.0  # store-lookup latency SLO (0 = off)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -28,6 +28,7 @@ from photon_tpu.estimators.config import (
 from photon_tpu.estimators.game_transformer import additive_score_rows
 from photon_tpu.game.coordinates import FixedEffectModel
 from photon_tpu.game.random_effect import RandomEffectModel
+from photon_tpu.serving.circuit import CircuitBreaker
 from photon_tpu.serving.coefficient_store import (
     CoefficientStore,
     DeviceCoefficientCache,
@@ -83,12 +84,20 @@ class RowScorer:
                         f"{cid!r}: random-effect config, {type(m)} model"
                     )
                 store = CoefficientStore.from_model(m)
+                breaker = None
+                if getattr(config, "breaker_failures", 0) > 0:
+                    breaker = CircuitBreaker(
+                        failure_threshold=config.breaker_failures,
+                        cooldown_s=config.breaker_cooldown_s,
+                        slow_call_s=config.breaker_slow_call_s or None,
+                    )
                 self._caches[cid] = DeviceCoefficientCache(
                     store,
                     # Floor at max_batch: batch slot resolution pins its
                     # own slots against eviction, which needs one slot per
                     # distinct in-batch entity in the worst case.
                     capacity=max(config.cache_entities, config.max_batch),
+                    breaker=breaker,
                 )
                 re_parts.append((cid, dcfg.feature_shard))
             else:  # pragma: no cover - union is closed
@@ -184,13 +193,29 @@ class RowScorer:
     def score_rows(self, rows: Sequence[ParsedRow]) -> np.ndarray:
         """Scores for up to ``max_batch`` rows as ONE padded kernel call;
         longer sequences score in max_batch-sized chunks."""
-        out = []
+        return self.score_rows_flagged(rows)[0]
+
+    def score_rows_flagged(
+        self, rows: Sequence[ParsedRow]
+    ) -> tuple[np.ndarray, list]:
+        """``(scores, flags)``: ``flags[i]`` is the tuple of RE coordinate
+        ids whose contribution row ``i`` LOST to an open coefficient-store
+        circuit breaker (fixed-effect-only degradation, docs/robustness.md);
+        empty for fully-scored rows."""
+        out, flags = [], []
         cap = self.config.max_batch
         for lo in range(0, len(rows), cap):
-            out.append(self._score_chunk(rows[lo: lo + cap]))
-        return np.concatenate(out) if out else np.zeros(0, np.float32)
+            s, f = self._score_chunk(rows[lo: lo + cap])
+            out.append(s)
+            flags.extend(f)
+        return (
+            np.concatenate(out) if out else np.zeros(0, np.float32),
+            flags,
+        )
 
-    def _score_chunk(self, rows: Sequence[ParsedRow]) -> np.ndarray:
+    def _score_chunk(
+        self, rows: Sequence[ParsedRow]
+    ) -> tuple[np.ndarray, list]:
         b = len(rows)
         bp = self._bucket(b)
         k = self.config.max_row_nnz
@@ -209,11 +234,16 @@ class RowScorer:
             offsets[r] = row.offset
 
         re_proj, re_coef = {}, {}
+        degraded_rows: list[list[str]] = [[] for _ in range(b)]
         for cid, _ in self.re_parts:
             cache = self._caches[cid]
             keys = [row.entity_keys[cid] for row in rows]
             keys += [None] * (bp - b)  # pad rows → fallback zero row
-            re_proj[cid], re_coef[cid] = cache.gather(cache.slots_for(keys))
+            slots, degraded = cache.resolve(keys)
+            if degraded.any():
+                for r in np.flatnonzero(degraded[:b]):
+                    degraded_rows[int(r)].append(cid)
+            re_proj[cid], re_coef[cid] = cache.gather(slots)
 
         scores = additive_score_rows(
             jnp.asarray(offsets),
@@ -225,7 +255,7 @@ class RowScorer:
             fixed_parts=self.fixed_parts,
             re_parts=self.re_parts,
         )
-        return np.asarray(scores)[:b]
+        return np.asarray(scores)[:b], [tuple(d) for d in degraded_rows]
 
     def warmup(self) -> int:
         """Compile every row-bucket shape once (empty rows, fallback
@@ -258,3 +288,11 @@ class RowScorer:
 
     def cache_snapshot(self) -> dict:
         return {cid: c.snapshot() for cid, c in self._caches.items()}
+
+    def breaker_snapshot(self) -> dict:
+        """Per-RE-coordinate circuit-breaker state (for /metrics)."""
+        return {
+            cid: c.breaker.snapshot()
+            for cid, c in self._caches.items()
+            if c.breaker is not None
+        }

@@ -3,14 +3,22 @@
 Routes (docs/serving.md §schema):
 
 * ``POST /score``       — one JSON row → ``{"score": .., "model_version"}``
-* ``GET  /healthz``     — liveness + current model version
-* ``GET  /metrics``     — latency histogram (p50/p95/p99), throughput
-  counters, batcher + coefficient-cache stats, kernel compile count
+  (plus ``"degraded": [..]`` when RE coordinates scored fixed-effect-only
+  behind an open coefficient-store circuit breaker)
+* ``GET  /healthz``     — liveness + current model version; 503 once the
+  batcher worker has died
+* ``GET  /metrics``     — latency histogram (p50/p95/p99), throughput +
+  shed/expired counters, batcher + coefficient-cache + breaker stats,
+  kernel compile count
 * ``POST /admin/swap``  — ``{"model_dir": ..}`` → hot-swap; blocking,
   atomic, in-flight requests unaffected
 
 Handler threads only parse and wait; all device work funnels through the
-micro-batcher's single worker. Metrics snapshots append to the output
+micro-batcher's single worker. Overload story (docs/robustness.md): a full
+admission queue sheds the request with HTTP 503 + ``Retry-After`` instead
+of queueing unboundedly, and each admitted request carries a deadline the
+batcher honors — an expired row is dropped before the kernel runs and its
+waiter gets 503, never a hang. Metrics snapshots append to the output
 directory's ``serving-metrics.jsonl`` through ``utils/logging``'s JSONL
 writer (periodically and at shutdown).
 """
@@ -19,11 +27,16 @@ from __future__ import annotations
 import json
 import threading
 import time
+from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from photon_tpu.estimators.game_transformer import SCORE_KERNEL_STATS
-from photon_tpu.serving.batcher import MicroBatcher
+from photon_tpu.serving.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    Overloaded,
+)
 from photon_tpu.serving.registry import ModelRegistry
 from photon_tpu.serving.scorer import RequestError
 from photon_tpu.utils import LatencyHistogram, write_metrics_jsonl
@@ -43,13 +56,18 @@ class ScoringServer:
         logger=None,
         metrics_path: Optional[str] = None,
         metrics_interval_s: float = 60.0,
+        request_timeout_s: float = _REQUEST_TIMEOUT_S,
     ):
         self.registry = registry
         self.batcher = batcher
         self.logger = logger
         self.metrics_path = metrics_path
+        self.request_timeout_s = float(request_timeout_s)
         self.latency = LatencyHistogram()
-        self.counters = {"requests": 0, "errors": 0, "swaps": 0}
+        self.counters = {
+            "requests": 0, "errors": 0, "swaps": 0,
+            "shed": 0, "expired": 0, "degraded": 0,
+        }
         self._started_at = time.time()
         self._counters_lock = threading.Lock()
         server = self
@@ -61,11 +79,13 @@ class ScoringServer:
                 if server.logger is not None:
                     server.logger.debug("http: " + fmt, *args)
 
-            def _reply(self, code: int, payload: dict) -> None:
+            def _reply(self, code: int, payload: dict, headers=()) -> None:
                 body = json.dumps(payload).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -92,6 +112,14 @@ class ScoringServer:
             def do_GET(self):
                 if self.path == "/healthz":
                     v = server.registry.current
+                    if not server.batcher.healthy:
+                        self._reply(503, {
+                            "status": "unhealthy",
+                            "error": "batcher worker died: "
+                                     f"{server.batcher.failed!r}",
+                            "model_version": v.version,
+                        })
+                        return
                     self._reply(200, {
                         "status": "ok",
                         "model_version": v.version,
@@ -126,11 +154,32 @@ class ScoringServer:
                     payload = self._read_json()
                     version = server.registry.current
                     row = version.scorer.parse_request(payload)
-                    fut = server.batcher.submit(version, row)
-                    score = fut.result(timeout=_REQUEST_TIMEOUT_S)
+                    deadline = time.monotonic() + server.request_timeout_s
+                    fut = server.batcher.submit(
+                        version, row, deadline=deadline
+                    )
+                    # The batcher fails the future at the deadline; the
+                    # +1s slack only covers a dead worker missed by the
+                    # crash drain — a waiter must NEVER outlive its budget
+                    # by more than that.
+                    score = fut.result(
+                        timeout=server.request_timeout_s + 1.0
+                    )
                 except RequestError as e:
                     server._count(errors=1)
                     self._reply(400, {"error": str(e)})
+                    return
+                except Overloaded as e:
+                    # Load shed: bounded queue full. 503 + Retry-After is
+                    # the contract a client-side retry policy needs.
+                    server._count(shed=1)
+                    self._reply(503, {"error": str(e), "shed": True},
+                                headers=(("Retry-After", "1"),))
+                    return
+                except (DeadlineExceeded, FuturesTimeout, TimeoutError):
+                    server._count(expired=1)
+                    self._reply(503, {"error": "request deadline exceeded"},
+                                headers=(("Retry-After", "1"),))
                     return
                 except Exception as e:  # noqa: BLE001 - a 500, not a crash
                     server._count(errors=1)
@@ -139,6 +188,13 @@ class ScoringServer:
                 server.latency.observe(time.perf_counter() - t0)
                 server._count(requests=1)
                 out = {"score": score, "model_version": version.version}
+                degraded = getattr(score, "degraded", ())
+                if degraded:
+                    # Fixed-effect-only fallback behind an open store
+                    # breaker: a usable score, but the client deserves to
+                    # know which coordinates are missing.
+                    server._count(degraded=1)
+                    out["degraded"] = sorted(degraded)
                 if "uid" in payload:
                     out["uid"] = payload["uid"]
                 self._reply(200, out)
@@ -207,6 +263,7 @@ class ScoringServer:
             **counters,
             "batcher": self.batcher.snapshot(),
             "coefficient_caches": v.scorer.cache_snapshot(),
+            "breakers": v.scorer.breaker_snapshot(),
             "kernel_traces": SCORE_KERNEL_STATS["traces"],
         }
 

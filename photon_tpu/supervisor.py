@@ -45,8 +45,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import random
 import time
-from typing import Callable, Optional, Sequence
+from typing import Callable, Iterator, Optional, Sequence
+
+from photon_tpu.faults import fault_point
 
 __all__ = [
     "RestartPolicy",
@@ -74,17 +77,49 @@ _FATAL = (ValueError, TypeError, AssertionError, KeyboardInterrupt)
 
 @dataclasses.dataclass(frozen=True)
 class RestartPolicy:
-    """How many times to restart and how to pace the attempts."""
+    """How many times to restart and how to pace the attempts.
+
+    Pacing uses DECORRELATED JITTER by default (``jitter=True``): each delay
+    is ``min(max_backoff, uniform(backoff, 3 * previous_delay))``. Without
+    it, every process of a multi-host job fails at the same collective and
+    restarts in lockstep — a thundering herd against the shared checkpoint
+    filesystem on every attempt. Jitter spreads the herd while keeping each
+    host's expected pace exponential. ``seed`` pins the stream for tests;
+    None seeds from OS entropy so hosts genuinely decorrelate.
+    ``jitter=False`` restores exact exponential pacing
+    (``backoff * multiplier^n``, capped at ``max_backoff_seconds``).
+    """
 
     max_restarts: int = 3
     backoff_seconds: float = 1.0
     backoff_multiplier: float = 2.0
+    max_backoff_seconds: float = 60.0
+    jitter: bool = True
+    seed: Optional[int] = None
     retryable: tuple = dataclasses.field(default_factory=_default_retryable)
 
     def is_retryable(self, err: BaseException) -> bool:
         if isinstance(err, _FATAL):
             return False
         return isinstance(err, self.retryable)
+
+    def delays(self) -> Iterator[float]:
+        """The (possibly jittered) inter-attempt delay sequence."""
+        rng = random.Random(self.seed)
+        delay = self.backoff_seconds
+        while True:
+            if self.jitter:
+                delay = min(
+                    self.max_backoff_seconds,
+                    rng.uniform(
+                        self.backoff_seconds,
+                        max(self.backoff_seconds, 3.0 * delay),
+                    ),
+                )
+                yield delay
+            else:
+                yield min(self.max_backoff_seconds, delay)
+                delay *= self.backoff_multiplier
 
 
 @dataclasses.dataclass
@@ -123,7 +158,7 @@ def run_with_recovery(
     :class:`RestartsExhausted` chained to the last error.
     """
     failures: list[AttemptFailure] = []
-    delay = policy.backoff_seconds
+    delays = policy.delays()
     for attempt in range(policy.max_restarts + 1):
         t0 = time.monotonic()
         try:
@@ -144,9 +179,9 @@ def run_with_recovery(
                 )
             if attempt >= policy.max_restarts:
                 raise RestartsExhausted(failures, e) from e
+            delay = next(delays)
             if delay > 0:
                 sleep(delay)
-            delay *= policy.backoff_multiplier
     raise AssertionError("unreachable")
 
 
@@ -202,6 +237,9 @@ class Heartbeat:
     def beat_once(self) -> None:
         import threading
 
+        # Chaos hook: an injected OSError here makes THIS process's beat go
+        # stale while it keeps running — the failure mode peers must detect.
+        fault_point("heartbeat.beat", process_id=self.process_id)
         self._beats += 1
         payload = {
             "process_id": self.process_id,

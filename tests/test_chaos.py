@@ -1,0 +1,180 @@
+"""Chaos suite, training side (docs/robustness.md): GAME training under
+injected fault plans must honor the recovery contracts the module docs
+claim — a preemption mid-coordinate-sweep restarts under the supervisor and
+resumes to a BIT-IDENTICAL final model; a corrupted checkpoint is refused
+by checksum and resume falls back to the previous snapshot, still
+bit-identical. Serving-side chaos lives in tests/test_serving.py (it reuses
+that module's trained-model fixture).
+
+Run standalone with ``pytest -m chaos`` (ci.sh's chaos smoke stage). Also
+marked ``slow``: the end-to-end fits keep these out of the tight tier-1
+wall-clock budget — the dedicated chaos stage (and ci.sh's full pytest run)
+is where they gate.
+"""
+import numpy as np
+import pytest
+
+from photon_tpu.checkpoint import CheckpointManager
+from photon_tpu.estimators.config import GLMOptimizationConfiguration
+from photon_tpu.faults import FaultPlan, FaultSpec, active_plan, bit_flip
+from photon_tpu.optim import RegularizationContext, RegularizationType
+from photon_tpu.supervisor import RestartPolicy, run_with_recovery
+from tests.test_checkpoint import _bundle, _estimator, _final_arrays
+
+pytestmark = [pytest.mark.chaos, pytest.mark.slow]
+
+
+def _config():
+    """One configuration (2 sweeps x 2 coordinates = 4 descent steps + 1
+    config-done snapshot) — enough steps to preempt mid-sweep, cheap enough
+    for the tier-1 budget."""
+    base = dict(
+        regularization=RegularizationContext(RegularizationType.L2),
+        max_iterations=10,
+    )
+    return [{
+        "fixed": GLMOptimizationConfiguration(reg_weight=1.0, **base),
+        "perUser": GLMOptimizationConfiguration(reg_weight=1.0, **base),
+    }]
+
+
+@pytest.fixture(scope="module")
+def reference():
+    """The uninterrupted run every chaos variant must reproduce exactly."""
+    bundle, vbundle = _bundle(), _bundle(seed=1)
+    ref = _estimator().fit(bundle, vbundle, _config())
+    return bundle, vbundle, ref
+
+
+def _attempt_factory(ckdir, bundle, vbundle):
+    """One supervisor attempt = a fresh manager on the shared checkpoint
+    directory + a fresh fit, exactly like a restarted driver process."""
+
+    def attempt(i):
+        mgr = CheckpointManager(ckdir)
+        try:
+            return _estimator().fit(
+                bundle, vbundle, _config(), checkpoint_manager=mgr
+            )
+        finally:
+            mgr._queue.put(None)  # stop the writer without masking errors
+
+    return attempt
+
+
+def test_preemption_mid_sweep_resumes_bit_identical(tmp_path, reference):
+    """ISSUE acceptance: training killed mid-sweep by an injected
+    preemption resumes to a bit-identical final model. The PreemptionError
+    fires at the descent.step hook after 2 completed (and checkpointed)
+    steps — squarely inside sweep 0/1 — and the supervisor's restart +
+    checkpoint fast-forward must erase it entirely."""
+    bundle, vbundle, ref = reference
+    ckdir = str(tmp_path / "ck")
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="descent.step", error="preemption", after=2, count=1),
+    ])
+    attempts = []
+
+    def attempt(i):
+        attempts.append(i)
+        return _attempt_factory(ckdir, bundle, vbundle)(i)
+
+    with active_plan(plan) as inj:
+        resumed = run_with_recovery(
+            attempt,
+            RestartPolicy(max_restarts=2, backoff_seconds=0, jitter=False),
+            sleep=lambda s: None,
+        )
+    assert inj.fired("descent.step") == 1   # the preemption really happened
+    assert attempts == [0, 1]               # one kill, one clean resume
+    for a, b in zip(_final_arrays(resumed), _final_arrays(ref)):
+        np.testing.assert_array_equal(a, b)
+    assert resumed[0].evaluation.values == ref[0].evaluation.values
+
+
+def test_corrupt_checkpoint_falls_back_then_resumes_identical(
+    tmp_path, reference
+):
+    """A bit-flipped newest snapshot (corruption a torn-write check cannot
+    see: the file is whole and may even unpickle) must be REFUSED by
+    checksum; resume falls back to the previous step-<n> and still lands on
+    the uninterrupted run's exact final model."""
+    import os
+
+    bundle, vbundle, ref = reference
+    ckdir = str(tmp_path / "ck")
+    # Crash after 3 step snapshots (CheckpointManager's built-in kill hook).
+    mgr = CheckpointManager(ckdir, fail_after=3)
+    with pytest.raises(KeyboardInterrupt):
+        _estimator().fit(bundle, vbundle, _config(), checkpoint_manager=mgr)
+    mgr._queue.put(None)
+
+    steps = sorted(
+        int(n.split("-")[1]) for n in os.listdir(ckdir) if n.startswith("step-")
+    )
+    newest = os.path.join(ckdir, f"step-{steps[-1]}")
+    # Flip one payload bit past the magic+CRC header.
+    bit_flip(newest, n_flips=1, seed=5, min_offset=16)
+
+    mgr2 = CheckpointManager(ckdir)
+    resumed = _estimator().fit(
+        bundle, vbundle, _config(), checkpoint_manager=mgr2
+    )
+    mgr2.close()
+    # The corrupted newest snapshot was explicitly refused (not resumed,
+    # not silently ignored) and the previous one carried the run.
+    assert mgr2.last_skipped and mgr2.last_skipped[0][0] == steps[-1]
+    assert "checksum" in mgr2.last_skipped[0][1]
+    for a, b in zip(_final_arrays(resumed), _final_arrays(ref)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_checkpoint_write_fault_surfaces_as_retryable(tmp_path, reference):
+    """An injected IO error in the background checkpoint writer surfaces on
+    the next save as a RuntimeError — retryable by the supervisor, never a
+    silent checkpoint gap."""
+    bundle, vbundle, _ = reference
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="checkpoint.write", error="os", after=1, count=1),
+    ])
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    with active_plan(plan) as inj:
+        with pytest.raises(RuntimeError, match="checkpoint writer failed"):
+            _estimator().fit(
+                bundle, vbundle, _config(), checkpoint_manager=mgr
+            )
+    assert inj.fired("checkpoint.write") == 1
+    mgr._queue.put(None)
+
+
+def test_ingest_preemption_via_driver_fault_plan(tmp_path):
+    """The --fault-plan flag end to end: a training-driver run under a plan
+    that injects one transient error is retried by --max-restarts and
+    completes; the plan file is the JSON the docs show."""
+    from photon_tpu.cli import game_training_driver
+    from photon_tpu.faults import deactivate
+    from tests.test_drivers import _write_game_avro
+
+    d = tmp_path / "data"
+    d.mkdir()
+    _write_game_avro(d / "train.avro", seed=1, n_users=4, rows_per_user=12)
+    plan_path = tmp_path / "plan.json"
+    plan_path.write_text(FaultPlan(seed=0, specs=[
+        FaultSpec(site="descent.step", error="preemption", count=1),
+    ]).to_json())
+    try:
+        summary = game_training_driver.run([
+            "--train-data", str(d / "train.avro"),
+            "--output-dir", str(tmp_path / "out"),
+            "--task", "LOGISTIC_REGRESSION",
+            "--feature-shard", "global:features",
+            "--coordinate",
+            "fixed:type=fixed,shard=global,reg=L2,max_iter=5,reg_weights=1",
+            "--checkpoint-dir", str(tmp_path / "ck"),
+            "--max-restarts", "1", "--restart-backoff", "0",
+            "--fault-plan", str(plan_path),
+            "--devices", "1",
+        ])
+    finally:
+        deactivate()  # driver installs the plan process-wide
+    assert summary["n_configs"] == 1

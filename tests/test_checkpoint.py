@@ -174,3 +174,78 @@ def test_driver_checkpoint_flag(tmp_path):
         "--devices", "1",
     ])
     assert any(n.startswith("step-") for n in os.listdir(tmp_path / "ck"))
+
+
+# ----------------------------------------------- checksummed snapshots (PR-2)
+
+
+def test_checksum_refuses_bitflip_and_falls_back(tmp_path):
+    """A bit-flipped snapshot keeps its framing and may even unpickle —
+    only the CRC catches it. load_latest must refuse it EXPLICITLY (recorded
+    in last_skipped) and fall back to the previous step, exactly like the
+    torn-write path."""
+    from photon_tpu.checkpoint import CheckpointCorrupt
+    from photon_tpu.faults import bit_flip
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=3)
+    mgr.save(0, {"x": jnp.arange(4)})
+    mgr.save(1, {"x": jnp.arange(4) + 1})
+    mgr.wait()
+    newest = str(tmp_path / "ck" / "step-1")
+    bit_flip(newest, n_flips=1, seed=2, min_offset=16)  # past magic + CRC
+    with pytest.raises(CheckpointCorrupt, match="checksum mismatch"):
+        mgr.load_file(newest)
+    payload = mgr.load_latest()
+    np.testing.assert_array_equal(payload["state"]["x"], np.arange(4))
+    assert mgr.last_skipped == [(1, mgr.last_skipped[0][1])]
+    assert "checksum mismatch" in mgr.last_skipped[0][1]
+    mgr.close()
+
+
+def test_legacy_pre_checksum_snapshot_still_loads(tmp_path):
+    """Snapshots written before the checksum header (raw pickle) load
+    unchanged — a running fleet can upgrade without losing resume."""
+    import pickle
+
+    ckdir = tmp_path / "ck"
+    ckdir.mkdir()
+    with open(ckdir / "step-4", "wb") as f:
+        pickle.dump({"state": {"x": 7}, "meta": {}, "step": 4}, f)
+    mgr = CheckpointManager(str(ckdir))
+    payload = mgr.load_latest()
+    assert payload["step"] == 4 and payload["state"]["x"] == 7
+    assert mgr.last_skipped == []
+    mgr.close()
+
+
+def test_checksum_roundtrip_and_header(tmp_path):
+    from photon_tpu.checkpoint import _MAGIC
+
+    mgr = CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(3, {"a": np.arange(5, dtype=np.float64)}, {"kind": "t"})
+    mgr.wait()
+    path = tmp_path / "ck" / "step-3"
+    assert path.read_bytes()[: len(_MAGIC)] == _MAGIC
+    payload = mgr.load_file(str(path))
+    assert payload["meta"]["kind"] == "t"
+    np.testing.assert_array_equal(payload["state"]["a"], np.arange(5))
+    mgr.close()
+
+
+def test_header_torn_inside_crc_falls_back(tmp_path):
+    """A snapshot torn INSIDE the magic+CRC header (magic landed, CRC did
+    not) must read as corrupt — fallback, not a struct.error crash."""
+    from photon_tpu.checkpoint import CheckpointCorrupt, _MAGIC
+
+    mgr = CheckpointManager(str(tmp_path / "ck"), keep=3)
+    mgr.save(0, {"x": 1})
+    mgr.save(1, {"x": 2})
+    mgr.wait()
+    newest = tmp_path / "ck" / "step-1"
+    with open(newest, "rb+") as f:
+        f.truncate(len(_MAGIC) + 2)  # magic + half the CRC field
+    with pytest.raises(CheckpointCorrupt, match="truncated"):
+        mgr.load_file(str(newest))
+    assert mgr.load_latest()["state"]["x"] == 1
+    assert mgr.last_skipped and mgr.last_skipped[0][0] == 1
+    mgr.close()

@@ -1,0 +1,151 @@
+"""Deterministic fault-injection framework (photon_tpu/faults/ —
+docs/robustness.md): plan semantics, seeded reproducibility, hook no-op
+cost path, JSON round-trip, and the on-disk corruption helpers."""
+import os
+
+import pytest
+
+from photon_tpu.faults import (
+    FaultPlan,
+    FaultSpec,
+    PreemptionError,
+    active_plan,
+    bit_flip,
+    deactivate,
+    fault_point,
+    install,
+    install_from_file,
+    torn_write,
+)
+
+
+def _hammer(site, n):
+    """Hit ``site`` n times; return indices where a fault fired."""
+    fired = []
+    for i in range(n):
+        try:
+            fault_point(site, i=i)
+        except Exception:  # noqa: BLE001 - the injected fault
+            fired.append(i)
+    return fired
+
+
+def test_inactive_hook_is_a_noop():
+    deactivate()
+    # No plan installed: hooks must never raise, sleep, or record.
+    for i in range(1000):
+        fault_point("anything", i=i)
+
+
+def test_after_count_every_semantics():
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="s", error="os", after=3, count=2),
+    ])
+    with active_plan(plan) as inj:
+        fired = _hammer("s", 10)
+    assert fired == [3, 4]           # skips 3 warmup hits, fires twice
+    assert inj.fired("s") == 2
+    assert [e["hit"] for e in inj.events] == [4, 5]
+
+    with active_plan(FaultPlan(seed=0, specs=[
+            FaultSpec(site="s", error="os", every=3)])):
+        assert _hammer("s", 9) == [0, 3, 6]   # every 3rd eligible hit
+
+
+def test_probability_is_seed_deterministic():
+    plan = FaultPlan(seed=11, specs=[
+        FaultSpec(site="s", error="runtime", probability=0.4),
+    ])
+    with active_plan(plan):
+        a = _hammer("s", 50)
+    with active_plan(plan):
+        b = _hammer("s", 50)
+    assert a == b
+    assert 0 < len(a) < 50
+    with active_plan(FaultPlan(seed=12, specs=plan.specs)):
+        c = _hammer("s", 50)
+    assert c != a  # a different seed is a different schedule
+
+
+def test_sites_and_matches_are_independent():
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="a", error="os"),
+        FaultSpec(site="b", error="preemption",
+                  match={"path": "part-3"}),
+    ])
+    with active_plan(plan):
+        with pytest.raises(OSError):
+            fault_point("a")
+        fault_point("c")  # unlisted site: untouched
+        fault_point("b", path="part-7.avro")  # match filter: no fire
+        with pytest.raises(PreemptionError):
+            fault_point("b", path="/data/part-3.avro")
+
+
+def test_error_types_and_delay():
+    assert isinstance(PreemptionError("x"), RuntimeError)  # retryable
+    with pytest.raises(ValueError, match="unknown fault error"):
+        FaultSpec(site="s", error="nope")
+    import time
+
+    with active_plan(FaultPlan(seed=0, specs=[
+            FaultSpec(site="s", delay_s=0.05)])) as inj:
+        t0 = time.monotonic()
+        fault_point("s")  # delay-only spec: sleeps, no raise
+        assert time.monotonic() - t0 >= 0.05
+    assert inj.events[0]["delay_s"] == 0.05
+
+
+def test_json_round_trip_and_file_install(tmp_path):
+    plan = FaultPlan(seed=5, specs=[
+        FaultSpec(site="io.block_read", error="os", after=2, count=1,
+                  match={"path": "train"}),
+        FaultSpec(site="serving.store_lookup", delay_s=0.01,
+                  probability=0.5),
+    ])
+    loaded = FaultPlan.from_json(plan.to_json())
+    assert loaded == plan
+    path = tmp_path / "plan.json"
+    path.write_text(plan.to_json())
+    inj = install_from_file(str(path))
+    try:
+        assert inj is not None and inj.plan == plan
+    finally:
+        deactivate()
+    assert install_from_file(None) is None
+    # Programmatic error factories are explicitly not serializable.
+    with pytest.raises(ValueError, match="not JSON-serializable"):
+        FaultSpec(site="s", error_factory=RuntimeError).to_dict()
+
+
+def test_active_plan_restores_previous():
+    outer = install(FaultPlan(seed=0, specs=[FaultSpec(site="o", error="os")]))
+    try:
+        with active_plan(FaultPlan(seed=0, specs=[])):
+            fault_point("o")  # inner plan has no spec for "o"
+        with pytest.raises(OSError):
+            fault_point("o")  # outer plan restored
+    finally:
+        deactivate()
+
+
+def test_torn_write_and_bit_flip(tmp_path):
+    p = tmp_path / "blob"
+    p.write_bytes(bytes(range(256)) * 4)
+    assert torn_write(str(p), keep_fraction=0.25) == 256
+    assert os.path.getsize(p) == 256
+
+    before = p.read_bytes()
+    offs = bit_flip(str(p), n_flips=2, seed=3, min_offset=8)
+    after = p.read_bytes()
+    assert len(after) == len(before)           # framing intact
+    assert after != before
+    assert all(o >= 8 for o in offs)
+    diff = [i for i, (x, y) in enumerate(zip(before, after)) if x != y]
+    assert 1 <= len(diff) <= 2
+    # Seeded: the same flip sequence reproduces exactly.
+    p2 = tmp_path / "blob2"
+    p2.write_bytes(before)
+    assert bit_flip(str(p2), n_flips=2, seed=3, min_offset=8) == offs
+    with pytest.raises(ValueError):
+        bit_flip(str(p), min_offset=10**6)

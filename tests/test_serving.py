@@ -26,11 +26,14 @@ from photon_tpu.index.index_map import MmapIndexMap
 from photon_tpu.io.avro import read_records
 from photon_tpu.io.data_reader import AvroDataReader, FeatureShardConfig
 from photon_tpu.io.model_io import load_game_model
+from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
 from photon_tpu.serving import (
     CoefficientStore,
+    DeadlineExceeded,
     DeviceCoefficientCache,
     MicroBatcher,
     ModelRegistry,
+    Overloaded,
     ScoringServer,
     ServingConfig,
 )
@@ -369,3 +372,265 @@ def test_serving_driver_build(trained, tmp_path):
     assert summary["coordinates"] == ["fixed", "perUser"]
     assert (tmp_path / "serve_out" / "photon.log").exists()
     assert (tmp_path / "serve_out" / "serving-metrics.jsonl").exists()
+
+
+# ----------------------------------------------- robustness (PR-2 hardening)
+
+
+def test_batcher_sheds_beyond_queue_bound(trained):
+    """Bounded admission: submits past max_queue raise Overloaded
+    immediately (the server's 503 load-shed path) instead of growing the
+    queue and every queued request's latency without bound."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    version = registry.current
+    row = version.scorer.parse_request(
+        _payload(read_records(str(d / "val.avro"))[0]))
+    batcher = MicroBatcher(max_batch=8, max_queue=2, start=False)
+    futs = [batcher.submit(version, row) for _ in range(2)]
+    with pytest.raises(Overloaded):
+        batcher.submit(version, row)
+    assert batcher.stats["shed"] == 1
+    batcher.start()  # the admitted requests still complete normally
+    assert all(isinstance(f.result(timeout=30), float) for f in futs)
+    assert batcher.snapshot()["queued"] == 0
+    batcher.close()
+
+
+def test_batcher_drops_expired_rows_before_kernel(trained):
+    """Deadline propagation: a row whose deadline passed while queued is
+    failed with DeadlineExceeded BEFORE scoring; live rows in the same
+    round still score."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    version = registry.current
+    row = version.scorer.parse_request(
+        _payload(read_records(str(d / "val.avro"))[0]))
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=1.0, start=False)
+    rows0 = batcher.stats["rows"]
+    expired = batcher.submit(version, row, deadline=time.monotonic() - 0.01)
+    live = batcher.submit(version, row, deadline=time.monotonic() + 30.0)
+    batcher.start()
+    assert isinstance(live.result(timeout=30), float)
+    with pytest.raises(DeadlineExceeded):
+        expired.result(timeout=30)
+    assert batcher.stats["expired"] == 1
+    assert batcher.stats["rows"] - rows0 == 1  # expired row never scored
+    batcher.close()
+
+
+def test_breaker_degrades_to_fixed_effect_only(trained):
+    """Store outage behind the circuit breaker: rows needing a store
+    lookup degrade to fixed-effect-only (score == entity-less request,
+    flagged), cached entities still get full RE scores, and the breaker
+    closes again after the cooldown probe."""
+    d, (m1, _), _ = trained
+    config = ServingConfig(
+        max_batch=8, cache_entities=16, max_row_nnz=32,
+        breaker_failures=3, breaker_cooldown_s=0.2,
+    )
+    scorer = ModelRegistry(m1, config).current.scorer
+    rec = read_records(str(d / "val.avro"))[0]
+
+    # Reference: entity-less request = pure fixed-effect score.
+    p0 = _payload(rec)
+    p0["entities"] = {}
+    fixed_only = float(scorer.score_rows([scorer.parse_request(p0)])[0])
+    # Cache the real entity BEFORE the outage (the resident hot set).
+    p_cached = _payload(rec)
+    cached_ref, cached_flags = scorer.score_rows_flagged(
+        [scorer.parse_request(p_cached)])
+    assert cached_flags[0] == ()
+
+    p_ghost = _payload(rec)
+    p_ghost["entities"] = {"userId": "chaos-ghost"}
+    ghost_row = scorer.parse_request(p_ghost)
+    outage = FaultPlan(seed=0, specs=[
+        FaultSpec(site="serving.store_lookup", error="os"),
+    ])
+    with active_plan(outage):
+        scores, flags = scorer.score_rows_flagged([ghost_row])
+        # Request survives, degraded to the fixed-effect-only score.
+        assert flags[0] == ("perUser",)
+        assert float(scores[0]) == pytest.approx(fixed_only, abs=1e-6)
+        for _ in range(4):  # push past breaker_failures
+            scorer.score_rows_flagged([ghost_row])
+        snap = scorer.cache_snapshot()["perUser"]
+        assert snap["breaker"]["state"] == "open"
+        assert snap["breaker"]["short_circuited"] >= 1
+        assert snap["degraded"] >= 3
+        # Degradation ladder: a CACHED entity still scores full RE even
+        # with the breaker open (hits never touch the store).
+        s, f = scorer.score_rows_flagged([scorer.parse_request(p_cached)])
+        assert f[0] == () and float(s[0]) == pytest.approx(
+            float(cached_ref[0]), abs=1e-7)
+    # Outage over + cooldown elapsed: the half-open probe succeeds and
+    # un-degrades traffic (unseen entity is a clean fallback again).
+    time.sleep(0.25)
+    s2, f2 = scorer.score_rows_flagged([ghost_row])
+    assert f2[0] == ()
+    assert scorer.cache_snapshot()["perUser"]["breaker"]["state"] == "closed"
+    assert scorer.breaker_snapshot()["perUser"]["opens"] == 1
+
+
+# ------------------------------------------------------------- chaos (HTTP)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_server_outage_and_overload(trained, tmp_path):
+    """ISSUE acceptance: under an injected coefficient-store outage (errors
+    + latency spikes) and overload (tiny admission queue), EVERY request
+    gets a non-hanging response — success, degraded, or 503 — and none is
+    stuck past its deadline."""
+    d, (m1, _), _ = trained
+    timeout_s = 3.0
+    config = ServingConfig(
+        max_batch=4, max_wait_ms=1.0, cache_entities=16, max_row_nnz=32,
+        max_queue=8, request_timeout_s=timeout_s,
+        breaker_failures=3, breaker_cooldown_s=60.0,  # stays open once hit
+        breaker_slow_call_s=0.05,
+    )
+    registry = ModelRegistry(m1, config)
+    batcher = MicroBatcher(max_batch=4, max_wait_ms=1.0, max_queue=8)
+    server = ScoringServer(registry, batcher, port=0,
+                           request_timeout_s=timeout_s)
+    server.start()
+    host, port = server.address
+    recs = read_records(str(d / "val.avro"))
+    plan = FaultPlan(seed=2, specs=[
+        FaultSpec(site="serving.store_lookup", error="os",
+                  probability=0.5),
+        FaultSpec(site="serving.store_lookup", delay_s=0.1,
+                  probability=0.3),
+    ])
+    results, errors = [], []
+
+    def one(i):
+        p = _payload(recs[i % len(recs)])
+        if i % 2:  # half the traffic needs a store lookup (unseen entity)
+            p["entities"] = {"userId": f"chaos-{i}"}
+        t0 = time.monotonic()
+        try:
+            status, body = _post(host, port, "/score", p)
+            results.append((status, body, time.monotonic() - t0))
+        except Exception as e:  # noqa: BLE001 - a hang/transport failure
+            errors.append(repr(e))
+
+    try:
+        with active_plan(plan) as inj:
+            with ThreadPoolExecutor(16) as ex:
+                list(ex.map(one, range(80)))
+        assert inj.fired("serving.store_lookup") >= 1  # the outage was real
+        assert not errors, errors
+        assert len(results) == 80                      # nothing hung
+        statuses = {s for s, _, _ in results}
+        assert statuses <= {200, 503}, statuses
+        assert 200 in statuses
+        # Bounded: no response took longer than the deadline + slack.
+        worst = max(dt for _, _, dt in results)
+        assert worst < timeout_s + 2.0, worst
+        # The degradation ladder showed up: degraded 200s and/or sheds.
+        degraded = [b for s, b, _ in results if s == 200 and b.get("degraded")]
+        shed = [b for s, b, _ in results if s == 503]
+        assert degraded or shed
+        for b in degraded:
+            assert b["degraded"] == ["perUser"]
+        status, m = _get(host, port, "/metrics")
+        assert status == 200
+        assert m["breakers"]["perUser"]["opens"] >= 1
+        assert m["shed"] + m["expired"] == len(shed)
+        assert m["degraded"] == len(degraded)
+        # Server is still healthy — shedding is not dying.
+        status, health = _get(host, port, "/healthz")
+        assert status == 200 and health["status"] == "ok"
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_store_stall_expires_requests_not_hangs(trained):
+    """A stalled store (big latency injection, breaker disabled) must turn
+    into bounded 503s — queued rows expire inside the batcher before the
+    kernel, waiters get Retry-After, nothing waits out a 30s default."""
+    d, (m1, _), _ = trained
+    timeout_s = 0.6
+    config = ServingConfig(
+        max_batch=2, max_wait_ms=1.0, cache_entities=16, max_row_nnz=32,
+        request_timeout_s=timeout_s, breaker_failures=0,  # raw stall
+    )
+    registry = ModelRegistry(m1, config)
+    batcher = MicroBatcher(max_batch=2, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0,
+                           request_timeout_s=timeout_s)
+    server.start()
+    host, port = server.address
+    rec = read_records(str(d / "val.avro"))[0]
+    stall = FaultPlan(seed=0, specs=[
+        FaultSpec(site="serving.store_lookup", delay_s=0.5),
+    ])
+    results = []
+
+    def one(i):
+        p = _payload(rec)
+        p["entities"] = {"userId": f"stall-{i}"}  # every row hits the store
+        t0 = time.monotonic()
+        status, body = _post(host, port, "/score", p)
+        results.append((status, time.monotonic() - t0))
+
+    try:
+        with active_plan(stall):
+            with ThreadPoolExecutor(6) as ex:
+                list(ex.map(one, range(6)))
+        assert len(results) == 6
+        assert {s for s, _ in results} <= {200, 503}
+        assert any(s == 503 for s, _ in results)   # some rows gave up
+        assert max(dt for _, dt in results) < timeout_s + 2.5
+        assert server.counters["expired"] >= 1
+        assert batcher.stats["expired"] >= 1       # dropped pre-kernel
+        # Stall over: the server recovered without a restart.
+        status, body = _post(host, port, "/score", _payload(rec))
+        assert status == 200
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_batcher_crash_fails_fast_and_flags_healthz(trained):
+    """Satellite: if the micro-batcher worker dies, queued futures fail
+    immediately (not after the full request timeout) and /healthz flips to
+    503 so an orchestrator can replace the process."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0, request_timeout_s=30.0)
+    server.start()
+    host, port = server.address
+    rec = read_records(str(d / "val.avro"))[0]
+    crash = FaultPlan(seed=0, specs=[
+        FaultSpec(site="serving.batcher_batch", error="runtime", count=1),
+    ])
+    try:
+        status, health = _get(host, port, "/healthz")
+        assert status == 200
+        with active_plan(crash):
+            t0 = time.monotonic()
+            status, body = _post(host, port, "/score", _payload(rec))
+            took = time.monotonic() - t0
+        assert status == 500
+        assert "worker died" in body["error"]
+        assert took < 10.0          # failed fast, not a 30s timeout wait
+        assert not batcher.healthy
+        status, health = _get(host, port, "/healthz")
+        assert status == 503
+        assert health["status"] == "unhealthy"
+        # Later submits are refused instantly too.
+        status, body = _post(host, port, "/score", _payload(rec))
+        assert status == 500
+    finally:
+        server.shutdown()

@@ -693,3 +693,76 @@ class TestMmapBlockReader:
         _, _, blocks = iter_container_blocks(str(bad))
         with pytest.raises(SchemaError, match="corrupt avro block header"):
             list(blocks)
+
+
+# ------------------------------------------------- transient-IO retry (PR-2)
+
+
+class TestIngestRetry:
+    """Bounded retry-with-backoff for transient OSErrors on block reads
+    (docs/robustness.md): one flaky read must not kill the ingest, a
+    persistently failing file must fail loudly after the budget."""
+
+    def _reader(self, imap, **kw):
+        return StreamingAvroReader(
+            {"g": imap}, columns=InputColumnNames(),
+            id_tag_columns=("userId",), **kw,
+        )
+
+    def test_transient_error_recovers_identical(self, dataset):
+        from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+
+        imap, paths, _ = dataset
+        clean = self._reader(imap).read(paths)
+        # One transient OSError mid-file (after 3 blocks of the deflate
+        # file), then healed: the retry must reopen, skip the already-
+        # consumed blocks, and produce a bit-identical bundle.
+        plan = FaultPlan(seed=0, specs=[
+            FaultSpec(site="io.block_read", error="os", after=3, count=1),
+        ])
+        with active_plan(plan) as inj:
+            sr = self._reader(imap, io_retries=2, io_retry_backoff_s=0.001)
+            recovered = sr.read(paths)
+        assert inj.fired("io.block_read") == 1
+        np.testing.assert_array_equal(recovered.labels, clean.labels)
+        np.testing.assert_array_equal(recovered.offsets, clean.offsets)
+        np.testing.assert_array_equal(recovered.weights, clean.weights)
+        assert list(recovered.uids) == list(clean.uids)
+        assert list(recovered.id_tags["userId"]) == list(
+            clean.id_tags["userId"])
+        np.testing.assert_array_equal(
+            _dense(recovered.features["g"]), _dense(clean.features["g"])
+        )
+
+    def test_retry_budget_exhausts_loudly(self, dataset):
+        from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+
+        imap, paths, _ = dataset
+        plan = FaultPlan(seed=0, specs=[
+            FaultSpec(site="io.block_read", error="os"),  # permanent outage
+        ])
+        with active_plan(plan) as inj:
+            sr = self._reader(imap, io_retries=2, io_retry_backoff_s=0.001)
+            with pytest.raises(OSError, match="injected fault"):
+                sr.read(paths)
+        # initial attempt + exactly io_retries reopens, then give up
+        assert inj.fired("io.block_read") == 3
+
+    def test_missing_file_never_retries(self, dataset):
+        imap, _, _ = dataset
+        sr = self._reader(imap, io_retries=5)
+        with pytest.raises(FileNotFoundError):
+            list(sr.iter_chunks(["/nonexistent/nowhere.avro"]))
+
+    def test_retry_disabled_propagates_first_error(self, dataset):
+        from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+
+        imap, paths, _ = dataset
+        plan = FaultPlan(seed=0, specs=[
+            FaultSpec(site="io.block_read", error="os", count=1),
+        ])
+        with active_plan(plan) as inj:
+            sr = self._reader(imap, io_retries=0)
+            with pytest.raises(OSError):
+                sr.read(paths)
+        assert inj.fired("io.block_read") == 1

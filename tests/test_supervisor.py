@@ -31,12 +31,53 @@ def test_retries_transient_then_succeeds():
 
     sleeps = []
     out = run_with_recovery(
-        attempt, RestartPolicy(max_restarts=3, backoff_seconds=0.5),
+        attempt,
+        RestartPolicy(max_restarts=3, backoff_seconds=0.5, jitter=False),
         sleep=sleeps.append,
     )
     assert out == "done"
     assert calls == [0, 1, 2]
     assert sleeps == [0.5, 1.0]  # exponential backoff between attempts
+
+
+def test_backoff_jitter_bounds_and_determinism():
+    """Decorrelated jitter (the default): every delay stays within
+    [backoff, min(cap, 3 * previous)], the stream is reproducible for a
+    pinned seed, and different seeds decorrelate (the anti-thundering-herd
+    property multi-host restarts need)."""
+    policy = RestartPolicy(
+        backoff_seconds=1.0, max_backoff_seconds=8.0, seed=7,
+    )
+    assert policy.jitter  # jitter is the default
+    gen = policy.delays()
+    delays = [next(gen) for _ in range(8)]
+    prev = policy.backoff_seconds
+    for d in delays:
+        assert policy.backoff_seconds <= d <= min(8.0, 3.0 * prev) + 1e-9
+        prev = d
+    assert max(delays) <= 8.0  # cap respected
+    # Deterministic for the same seed…
+    gen2 = policy.delays()
+    assert [next(gen2) for _ in range(8)] == delays
+    # …and decorrelated across seeds (different hosts restart apart).
+    import dataclasses as _dc
+
+    other = _dc.replace(policy, seed=8).delays()
+    assert [next(other) for _ in range(8)] != delays
+    # run_with_recovery actually sleeps the jittered sequence.
+    sleeps = []
+
+    def attempt(i):
+        raise OSError("flaky")
+
+    with pytest.raises(RestartsExhausted):
+        run_with_recovery(
+            attempt,
+            RestartPolicy(max_restarts=3, backoff_seconds=1.0,
+                          max_backoff_seconds=8.0, seed=7),
+            sleep=sleeps.append,
+        )
+    assert sleeps == delays[:3]
 
 
 def test_fatal_errors_propagate_immediately():
@@ -462,3 +503,31 @@ def test_peer_epochs_tolerates_corrupt_and_missing(tmp_path):
     assert epochs == {0: 2, 1: -1, 2: -1}
     assert me.wait_for_epoch([0, 1], 1, timeout_seconds=0.2,
                              poll_seconds=0.05) == [1]
+
+
+def test_injected_heartbeat_outage_reads_as_dead_peer(tmp_path):
+    """Chaos hook heartbeat.beat: a process whose beacon writes start
+    failing (sick shared fs) keeps running but its beat goes stale — and
+    peers must classify it dead, which is the watchdog's trigger."""
+    from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+
+    hdir = str(tmp_path / "hb")
+    me = Heartbeat(hdir, process_id=0, interval_seconds=0.05)
+    peer = Heartbeat(hdir, process_id=1, interval_seconds=0.05)
+    me.beat_once()
+    peer.beat_once()
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="heartbeat.beat", error="os",
+                  match={"process_id": "1"}),   # only peer 1's fs is sick
+    ])
+    with active_plan(plan) as inj:
+        with pytest.raises(OSError):
+            peer.beat_once()
+        me.beat_once()                           # unmatched: still beats
+    assert inj.fired("heartbeat.beat") == 1
+    # Age out the peer's last good beat; the healthy host must see it dead.
+    old = time.time() - 60.0
+    os.utime(os.path.join(hdir, "host-1.hb"), (old, old))
+    me.beat_once()
+    report = me.check_peers([0, 1], max_age_seconds=1.0)
+    assert report.dead == [1] and report.alive == [0]
