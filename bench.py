@@ -875,15 +875,37 @@ def bench_serve():
         for i in range(8):
             fire(conn, payloads[i % len(payloads)])
         conn.close()
-        t0 = time.perf_counter()
-        threads = [threading.Thread(target=worker, args=(w,))
-                   for w in range(conc)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        wall = time.perf_counter() - t0
+        # Headline numbers are ALWAYS tracing-off, even under --trace-out:
+        # the overhead sub-measurement below is the only traced phase
+        # (docs/observability.md §overhead).
+        from photon_tpu.obs import suspend_tracing, tracing
+
+        with suspend_tracing():
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(conc)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
         snap = server.metrics_snapshot()
+
+        # Tracing-overhead sub-measurement: two identical sequential
+        # volleys over one connection, tracing off vs on; the p50 delta IS
+        # the per-request instrumentation cost (span objects + event
+        # appends on the request/queue/kernel path).
+        n_ovh = 64 if SMOKE else 256
+        ovh = {}
+        for mode in ("off", "on"):
+            ctx = tracing() if mode == "on" else suspend_tracing()
+            with ctx:
+                conn = http.client.HTTPConnection(host, port, timeout=30)
+                mine = [fire(conn, payloads[i % len(payloads)])
+                        for i in range(n_ovh)]
+                conn.close()
+            mine.sort()
+            ovh[mode] = mine
 
         # Degraded-mode phase (docs/robustness.md): inject a coefficient-
         # store outage, let the circuit breaker open, and measure the
@@ -904,7 +926,10 @@ def bench_serve():
         outage = FaultPlan(seed=7, specs=[
             FaultSpec(site="serving.store_lookup", error="os"),
         ])
-        with active_plan(outage):
+        # suspend_tracing: the degraded floor is a headline number too —
+        # under --trace-out it must not pay span emission (or a fault
+        # instant event per request) the untraced baseline didn't.
+        with active_plan(outage), suspend_tracing():
             conn = http.client.HTTPConnection(host, port, timeout=30)
             td0 = time.perf_counter()
             for i in range(n_deg):
@@ -943,6 +968,15 @@ def bench_serve():
             2),
         "serve_degraded_requests": len(deg_lat),
         "serve_breaker_opens": breaker.get("opens", 0),
+        # Instrumentation overhead (docs/observability.md §overhead):
+        # sequential single-connection p50 with tracing off vs on.
+        "serve_trace_off_p50_ms": round(
+            ovh["off"][len(ovh["off"]) // 2] * 1e3, 3),
+        "serve_trace_on_p50_ms": round(
+            ovh["on"][len(ovh["on"]) // 2] * 1e3, 3),
+        "serve_trace_overhead_p50_ms": round(
+            (ovh["on"][len(ovh["on"]) // 2]
+             - ovh["off"][len(ovh["off"]) // 2]) * 1e3, 3),
     }
 
 
@@ -1317,7 +1351,30 @@ def _load_resume(path: str) -> dict:
 
 
 def main():
+    import argparse
     import sys
+
+    ap = argparse.ArgumentParser(prog="bench", add_help=True)
+    ap.add_argument(
+        "--trace-out",
+        default=os.environ.get("PHOTON_TRACE_OUT") or None,
+        help="write the bench run's spans (training sweeps, serve path) as "
+             "Chrome trace-event JSON (docs/observability.md). The serve "
+             "stage's headline p50/p99 are ALWAYS measured with tracing "
+             "off; its tracing-overhead sub-measurement is separate.")
+    # parse_known_args: other flags (--force-probe) are consulted straight
+    # from sys.argv by the stages and must keep working.
+    bench_args, _ = ap.parse_known_args()
+    if bench_args.trace_out:
+        import atexit
+
+        from photon_tpu.cli.params import enable_trace, finish_trace
+
+        enable_trace(bench_args.trace_out)
+        # Write at interpreter exit, normal or not — a bench killed by a
+        # wedged backend is exactly the run whose timeline matters most.
+        # finish_trace is idempotent once the collector is stopped.
+        atexit.register(finish_trace, bench_args.trace_out)
 
     # Persistent compilation cache: timed regions all measure warm
     # (post-compile) execution, so caching never distorts a number — it only

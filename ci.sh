@@ -39,6 +39,14 @@ echo "== chaos smoke (deterministic fault injection; docs/robustness.md) =="
 # optional dependency in another test module — must not mask chaos results.)
 python -m pytest tests/test_chaos.py tests/test_serving.py -q -m chaos
 
+echo "== obs smoke (tracing + Prometheus exposition; docs/observability.md) =="
+# A tiny traced training + scoring pass: validates the --trace-out artifact
+# is well-formed Chrome trace-event JSON with >=1 span per instrumented
+# layer (ingest / descent / optim / serving) plus a tagged event per
+# injected fault, and lints /metrics?format=prom against the Prometheus
+# text-format grammar (latency, throughput, queue depth, kernel retraces).
+python scripts/obs_smoke.py
+
 echo "== multichip dryrun (8-device mesh: dp, dp x mp, RE, dcn x dp) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 

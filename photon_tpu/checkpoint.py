@@ -13,8 +13,17 @@ Mechanics:
 * ``save`` converts device arrays to host numpy (one sync D2H copy) and hands
   the snapshot to a background writer thread — training does not wait for
   disk (the "async save" of SURVEY.md §5.4's rebuild note).
-* Writes are atomic: serialize to ``<dir>/tmp-<step>`` then ``os.replace`` to
-  ``<dir>/step-<n>``; a torn write can never be mistaken for a checkpoint.
+* Writes are atomic: serialize to a manager-unique ``<dir>/tmp-<step>-<tag>``
+  then ``os.replace`` to ``<dir>/step-<n>``; a torn write can never be
+  mistaken for a checkpoint. The tmp name carries a per-manager tag because
+  two managers can legitimately write the same directory at once: a
+  preempted attempt's background writer may still be draining its queue
+  when the supervisor's restarted attempt (a fresh manager on the same
+  directory) re-runs the step it never saw on disk — with a shared tmp
+  name, the loser of that race ``os.replace``s a path the winner already
+  renamed away and poisons its manager with ``FileNotFoundError``. Both
+  snapshots are consistent states of the same deterministic step, so
+  last-writer-wins on ``step-<n>`` itself is benign.
 * Payloads are checksummed (CRC32 in a small header): a snapshot corrupted
   in place — a bit flip that still unpickles into plausible-looking state —
   is refused explicitly (:class:`CheckpointCorrupt`) and ``load_latest``
@@ -41,6 +50,7 @@ import queue
 import re
 import struct
 import threading
+import time
 import zlib
 from typing import Any, Optional
 
@@ -107,6 +117,26 @@ class CheckpointManager:
 
     def __post_init__(self):
         os.makedirs(self.directory, exist_ok=True)
+        # Manager-unique tmp tag (module doc): a restarted attempt's fresh
+        # manager must never collide on tmp paths with the preempted
+        # attempt's still-draining writer.
+        self._tmp_tag = f"{os.getpid():x}-{id(self):x}"
+        # Sweep orphaned tmp files from crashed/preempted predecessors so a
+        # restart loop never accumulates garbage — but only STALE ones (by
+        # mtime): on a shared multi-host checkpoint directory
+        # (docs/scaling.md) a peer's in-flight tmp file is seconds old, and
+        # unlinking it between its open() and os.replace() would poison a
+        # healthy manager. A live writer streams the pickle continuously,
+        # so any tmp untouched for this long is a corpse.
+        stale_s = 15 * 60.0
+        for name in os.listdir(self.directory):
+            if name.startswith("tmp-"):
+                path = os.path.join(self.directory, name)
+                try:
+                    if time.time() - os.path.getmtime(path) > stale_s:
+                        os.remove(path)
+                except OSError:
+                    pass
         self._queue: "queue.Queue" = queue.Queue()
         self._error: Optional[BaseException] = None
         self._saves = 0
@@ -137,7 +167,8 @@ class CheckpointManager:
             step, payload = item
             try:
                 fault_point("checkpoint.write", step=step)
-                tmp = os.path.join(self.directory, f"tmp-{step}")
+                tmp = os.path.join(
+                    self.directory, f"tmp-{step}-{self._tmp_tag}")
                 with open(tmp, "wb") as f:
                     # STREAM the pickle through a CRC-accumulating wrapper
                     # (placeholder CRC patched afterwards): materializing

@@ -150,10 +150,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     from photon_tpu.cli.params import (
         add_compilation_cache_flag,
         add_fault_plan_flag,
+        add_trace_flag,
     )
 
     add_compilation_cache_flag(p)
     add_fault_plan_flag(p)
+    add_trace_flag(p)
     return p
 
 
@@ -211,10 +213,12 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     from photon_tpu.cli.params import (
         enable_compilation_cache,
         enable_fault_plan,
+        enable_trace,
     )
 
     enable_compilation_cache(args.compilation_cache_dir)
     enable_fault_plan(args.fault_plan)
+    enable_trace(args.trace_out)
     # Join the multi-host runtime first (no-op single-process) so
     # jax.devices() below sees the whole pod slice (SURVEY.md §5.8).
     from photon_tpu.parallel.distributed import initialize_distributed
@@ -333,6 +337,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             import jax.profiler
 
             jax.profiler.stop_trace()
+        from photon_tpu.cli.params import finish_trace
+
+        finish_trace(args.trace_out)
 
 
 class RestartsUselessError(Exception):

@@ -119,9 +119,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "-1 = auto (accelerator backends route here when the "
                         "input file size exceeds "
                         "$PHOTON_DEVICE_DATA_BUDGET_GB, default 10)")
-    from photon_tpu.cli.params import add_compilation_cache_flag
+    from photon_tpu.cli.params import (
+        add_compilation_cache_flag,
+        add_trace_flag,
+    )
 
     add_compilation_cache_flag(p)
+    add_trace_flag(p)
     return p
 
 
@@ -387,9 +391,21 @@ def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
-    from photon_tpu.cli.params import enable_compilation_cache
+    from photon_tpu.cli.params import (
+        enable_compilation_cache,
+        enable_trace,
+        finish_trace,
+    )
 
     enable_compilation_cache(args.compilation_cache_dir)
+    enable_trace(args.trace_out)
+    try:
+        return _run(args)
+    finally:
+        finish_trace(args.trace_out)
+
+
+def _run(args) -> dict:
     if args.dtype == "float64":
         import jax
 

@@ -282,6 +282,49 @@ def enable_compilation_cache(path) -> None:
     )
 
 
+def add_trace_flag(parser) -> None:
+    """Shared --trace-out flag (default: $PHOTON_TRACE_OUT): write the
+    run's spans — ingest blocks, coordinate steps, optimizer solves, the
+    serving path, injected faults — as Chrome trace-event JSON, loadable
+    in Perfetto (docs/observability.md)."""
+    import os
+
+    parser.add_argument(
+        "--trace-out",
+        default=os.environ.get("PHOTON_TRACE_OUT") or None,
+        help="write an end-to-end Chrome trace-event JSON timeline of this "
+             "run to this file (open in https://ui.perfetto.dev; "
+             "docs/observability.md; default: $PHOTON_TRACE_OUT)")
+
+
+def enable_trace(path) -> None:
+    """Install the process-wide trace collector (no-op if falsy); pair
+    with :func:`finish_trace` in a ``finally``."""
+    if not path:
+        return
+    from photon_tpu.obs import start_tracing
+
+    start_tracing()
+
+
+def finish_trace(path) -> None:
+    """Write and uninstall the collector installed by :func:`enable_trace`
+    (no-op if falsy). Runs in the driver's ``finally`` so a failed run
+    still leaves a timeline — failures are when the trace matters most."""
+    if not path:
+        return
+    import logging
+
+    from photon_tpu.obs import stop_tracing
+
+    col = stop_tracing(path)
+    if col is not None:
+        logging.getLogger("photon_tpu.obs").info(
+            "trace written: %s (%d events%s)", path, len(col.events),
+            f", {col.dropped} dropped" if col.dropped else "",
+        )
+
+
 def add_fault_plan_flag(parser) -> None:
     """Shared --fault-plan flag (default: $PHOTON_FAULT_PLAN): run the
     driver under a deterministic fault-injection plan for chaos drills

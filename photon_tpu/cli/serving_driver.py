@@ -71,10 +71,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
     from photon_tpu.cli.params import (
         add_compilation_cache_flag,
         add_fault_plan_flag,
+        add_trace_flag,
     )
 
     add_compilation_cache_flag(p)
     add_fault_plan_flag(p)
+    add_trace_flag(p)
     return p
 
 
@@ -83,10 +85,12 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
     from photon_tpu.cli.params import (
         enable_compilation_cache,
         enable_fault_plan,
+        enable_trace,
     )
 
     enable_compilation_cache(args.compilation_cache_dir)
     enable_fault_plan(args.fault_plan)
+    enable_trace(args.trace_out)
     plogger = PhotonLogger(args.output_dir)
     logger = plogger.logger
     config = ServingConfig(
@@ -142,6 +146,17 @@ def run(
     """Build and (by default) serve until interrupted. ``serve_forever=
     False`` builds, warms, and tears down — the smoke/integration entry."""
     args = build_arg_parser().parse_args(argv)
+    from photon_tpu.cli.params import finish_trace
+
+    # finish_trace in a finally covering the BUILD too: a model load or
+    # warmup failure is exactly the run whose timeline matters most.
+    try:
+        return _run(args, serve_forever)
+    finally:
+        finish_trace(args.trace_out)
+
+
+def _run(args, serve_forever: bool) -> dict:
     server, plogger = build_server(args)
     v = server.registry.current
     summary = {

@@ -38,11 +38,35 @@ from photon_tpu.io.data_reader import GameDataBundle
 
 Array = jax.Array
 
-# Trace counter for the shared scoring kernel below: the traced-function
-# body runs once per distinct input signature, so this counts XLA
-# compilations. The serving micro-batcher's no-recompile-after-warmup
-# guarantee is asserted against it (tests/test_serving.py).
-SCORE_KERNEL_STATS = {"traces": 0}
+SCORE_KERNEL_NAME = "additive_score_rows"
+
+
+class _ScoreKernelStats:
+    """Back-compat alias for the old ``SCORE_KERNEL_STATS`` module dict.
+
+    The raw ``{"traces": 0}`` global was bumped from batcher worker threads
+    and read by the metrics loop with no lock; the count now lives in the
+    process-wide ``obs`` registry (thread-safe, resettable, and exported as
+    ``kernel_traces_total{kernel="additive_score_rows"}`` on the Prometheus
+    endpoint). This view keeps ``SCORE_KERNEL_STATS["traces"]`` reads
+    working for existing callers and tests.
+    """
+
+    def __getitem__(self, key: str) -> int:
+        if key != "traces":
+            raise KeyError(key)
+        from photon_tpu.obs import retrace
+
+        return retrace.traces(SCORE_KERNEL_NAME)
+
+    def keys(self):
+        return ("traces",)
+
+    def __repr__(self) -> str:
+        return f"{{'traces': {self['traces']}}}"
+
+
+SCORE_KERNEL_STATS = _ScoreKernelStats()
 
 
 @partial(jax.jit, static_argnames=("fixed_parts", "re_parts"))
@@ -77,7 +101,12 @@ def additive_score_rows(
     subspace — the serve-time analog of the transformer's host-side
     model-RDD join (SURVEY.md §3.6), shaped [B, K] for the accelerator.
     """
-    SCORE_KERNEL_STATS["traces"] += 1
+    # Traced-function body: runs once per distinct input signature, i.e.
+    # once per XLA compilation. The retrace sentinel counts it and warns if
+    # it fires after the serving warmup declared the shape ladder complete.
+    from photon_tpu.obs import retrace
+
+    retrace.note_trace(SCORE_KERNEL_NAME)
     total = offsets
     for cid, shard in fixed_parts:
         idx, val = shard_idx[shard], shard_val[shard]

@@ -245,6 +245,20 @@ class FaultInjector:
         # serialize unrelated sites behind it. All fired delays execute
         # BEFORE any error raises, so a plan combining latency and error
         # specs on one site actually delivers both (events stay accurate).
+        if to_fire:
+            # Observability correlation (docs/observability.md): every fired
+            # fault lands as a tagged instant event in the active trace, so
+            # a chaos run replays as a timeline — the injected fault sits
+            # next to the spans that absorbed it (same trace id when the
+            # firing thread carries request context).
+            from photon_tpu.obs.trace import instant as _trace_instant
+
+            for st, _ in to_fire:
+                _trace_instant(
+                    f"fault:{site}", cat="fault",
+                    site=site, spec=st.index, hit=st.hits,
+                    error=st.spec.error, delay_s=st.spec.delay_s,
+                )
         first_error: Optional[BaseException] = None
         for st, msg in to_fire:
             if st.spec.delay_s > 0:

@@ -115,7 +115,15 @@ class GLMOptimizationProblem:
             reg_weight=1.0 if self.reg_weight > 0 else 0.0,
         )
         rw = jnp.asarray(self.reg_weight, w0.dtype)
-        return _fit_jitted(key, batch, w0, mask, pr, normalization, rw)
+        from photon_tpu.obs import trace_span
+
+        # Optimizer-layer span (docs/observability.md): one per GLM solve,
+        # covering dispatch on the cached executable (compiles show up as
+        # outsized first spans; the sentinel counts them per kernel).
+        with trace_span("optim.glm_fit", cat="optim", rows=batch.n_rows,
+                        dim=batch.dim,
+                        optimizer=self.optimizer_type.name):
+            return _fit_jitted(key, batch, w0, mask, pr, normalization, rw)
 
     def run(
         self,
@@ -258,4 +266,7 @@ class GLMOptimizationProblem:
 @partial(jax.jit, static_argnums=0)
 def _fit_jitted(problem: GLMOptimizationProblem, batch, w0, reg_mask, prior,
                 normalization, reg_weight):
+    from photon_tpu.obs import retrace
+
+    retrace.note_trace("glm_fit")  # 1 trace == 1 XLA compile
     return problem.run(batch, w0, reg_mask, normalization, prior, reg_weight)

@@ -25,6 +25,7 @@ from photon_tpu.game.random_effect import (
     train_random_effects,
 )
 from photon_tpu.models.glm import GeneralizedLinearModel
+from photon_tpu.obs import trace_span, tracing_active
 from photon_tpu.parallel.data_parallel import fit_data_parallel
 
 Array = jax.Array
@@ -65,21 +66,30 @@ class FixedEffectCoordinate:
             w0 = init.model.coefficients.means
         else:
             w0 = jnp.zeros((batch.dim,), batch.labels.dtype)
-        if self.mesh is not None and self.model_axis is not None:
-            from photon_tpu.parallel.model_parallel import fit_model_parallel
+        with trace_span("optim.fixed_solve", cat="optim",
+                        shard=self.feature_shard, rows=batch.n_rows,
+                        dim=batch.dim) as sp:
+            if self.mesh is not None and self.model_axis is not None:
+                from photon_tpu.parallel.model_parallel import fit_model_parallel
 
-            model, result = fit_model_parallel(
-                self.problem, batch, w0, self.mesh,
-                self.data_axis, self.model_axis,
-                normalization=self.normalization,
-            )
-        elif self.mesh is not None:
-            model, result = fit_data_parallel(
-                self.problem, batch, w0, self.mesh, self.data_axis,
-                normalization=self.normalization,
-            )
-        else:
-            model, result = self.problem.fit(batch, w0, normalization=self.normalization)
+                model, result = fit_model_parallel(
+                    self.problem, batch, w0, self.mesh,
+                    self.data_axis, self.model_axis,
+                    normalization=self.normalization,
+                )
+            elif self.mesh is not None:
+                model, result = fit_data_parallel(
+                    self.problem, batch, w0, self.mesh, self.data_axis,
+                    normalization=self.normalization,
+                )
+            else:
+                model, result = self.problem.fit(batch, w0, normalization=self.normalization)
+            if tracing_active():
+                # One tiny D2H per solve, paid only when a trace is being
+                # collected: iteration count + convergence reason make the
+                # optimizer lane of the timeline self-describing.
+                sp.set(iterations=int(result.iterations),
+                       reason=result.reason_name())
         return FixedEffectModel(model, self.feature_shard), result
 
     def score(self, model: FixedEffectModel) -> Array:

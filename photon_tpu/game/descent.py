@@ -14,7 +14,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import time
 from typing import Mapping, Optional, Sequence
 
 import jax
@@ -24,6 +23,7 @@ import numpy as np
 from photon_tpu.evaluation import EvaluationResults, EvaluationSuite
 from photon_tpu.faults import fault_point
 from photon_tpu.game.coordinates import Coordinate, DatumScoringModel
+from photon_tpu.obs import trace_span
 
 Array = jax.Array
 
@@ -168,6 +168,11 @@ class CoordinateDescent:
 
         step = step_base
         for sweep in range(self.n_sweeps):
+            # Manual span, not ``with`` (the inner loop body is long): on a
+            # mid-sweep exception the sweep span is simply not emitted — the
+            # failing step span records the error for the timeline.
+            sweep_span = trace_span("descent.sweep", cat="descent",
+                                    sweep=sweep).__enter__()
             for ci, cid in enumerate(self.update_sequence):
                 if resumed_pos is not None and (sweep, ci) <= resumed_pos:
                     step += 1
@@ -179,21 +184,22 @@ class CoordinateDescent:
                     "descent.step", sweep=sweep, coordinate=cid, step=step
                 )
                 coord = coordinates[cid]
-                t0 = time.perf_counter()
-                residual_offset = total - scores[cid]
-                model, _ = coord.train(residual_offset, models.get(cid))
-                new_score = coord.score(model)
-                total = residual_offset + new_score
-                scores[cid] = new_score
-                models[cid] = model
-                # Tiny D2H fetch: the step record must report COMPLETED
-                # compute, not async dispatch (without this the tracker
-                # claimed ~4s of a 70s fit; block_until_ready alone does not
-                # synchronize on the axon tunnel backend, a D2H does). The
-                # data dependency new_score <- model <- solve forces the
-                # whole step.
-                np.asarray(new_score[:1])
-                dt = time.perf_counter() - t0
+                with trace_span("descent.step", cat="descent", sweep=sweep,
+                                coordinate=cid, step=step) as step_span:
+                    residual_offset = total - scores[cid]
+                    model, _ = coord.train(residual_offset, models.get(cid))
+                    new_score = coord.score(model)
+                    total = residual_offset + new_score
+                    scores[cid] = new_score
+                    models[cid] = model
+                    # Tiny D2H fetch: the step record (and span) must report
+                    # COMPLETED compute, not async dispatch (without this the
+                    # tracker claimed ~4s of a 70s fit; block_until_ready
+                    # alone does not synchronize on the axon tunnel backend,
+                    # a D2H does). The data dependency
+                    # new_score <- model <- solve forces the whole step.
+                    np.asarray(new_score[:1])
+                dt = step_span.seconds
 
                 record = CoordinateStepRecord(sweep, cid, dt)
                 if validation is not None:
@@ -246,6 +252,7 @@ class CoordinateDescent:
                         },
                     )
                 step += 1
+            sweep_span.__exit__(None, None, None)
 
         final = best_models if best_models is not None else models
         return GameModel(dict(final)), tracker

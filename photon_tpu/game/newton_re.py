@@ -308,6 +308,9 @@ def fit_bucket_newton(problem, batches, w0, local_mask, local_prior):
     the eligibility gate excludes) and the same ``(models, result)`` pytree
     shapes out, so ``train_random_effects`` can swap it in per bucket."""
     from photon_tpu.functions.problem import VarianceComputationType
+    from photon_tpu.obs import retrace
+
+    retrace.note_trace("fit_bucket_newton")  # 1 trace == 1 XLA compile
 
     # Solve in the data/warm-start precision: f64 RE configs must not
     # silently drop to f32 on the default fast path (ADVICE r5).
@@ -395,6 +398,9 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
     a couple of extra iterations — the trade for a history-free solver.
     """
     from photon_tpu.functions.problem import VarianceComputationType
+    from photon_tpu.obs import retrace
+
+    retrace.note_trace("fit_bucket_newton_dual")  # 1 trace == 1 XLA compile
 
     # Same dtype contract as the primal path: solve in w0.dtype so f64
     # datasets keep full precision (ADVICE r5). w0's VALUES stay unused

@@ -46,6 +46,7 @@ from typing import Callable, Iterator, Mapping, Optional, Sequence
 import numpy as np
 
 from photon_tpu.faults import fault_point
+from photon_tpu.obs import trace_span
 
 from photon_tpu.data.batch import SparseFeatures
 from photon_tpu.index.index_map import (
@@ -1065,8 +1066,13 @@ class StreamingAvroReader:
                 yield self._finish_chunk(dec, dtype, require_labels)
                 pending = 0
             dec = d
-            for payload, count in blocks:
-                pending = dec.decode_block(payload, count)
+            for b_i, (payload, count) in enumerate(blocks):
+                # Per-block span (docs/observability.md ingest lane): block
+                # decode is the unit of ingest work, and a slow file/fs
+                # shows up as widening ingest.block spans on one path.
+                with trace_span("ingest.block", cat="ingest", path=path,
+                                block=b_i, records=count):
+                    pending = dec.decode_block(payload, count)
                 if pending >= self.chunk_rows:
                     yield self._finish_chunk(dec, dtype, require_labels)
                     pending = 0
@@ -1074,6 +1080,12 @@ class StreamingAvroReader:
             yield self._finish_chunk(dec, dtype, require_labels)
 
     def _finish_chunk(self, dec: NativeDecoder, dtype, require_labels) -> GameDataChunk:
+        with trace_span("ingest.chunk", cat="ingest") as sp:
+            chunk = self._assemble_chunk(dec, dtype, require_labels)
+            sp.set(rows=chunk.n_rows)
+        return chunk
+
+    def _assemble_chunk(self, dec: NativeDecoder, dtype, require_labels) -> GameDataChunk:
         raw = dec.take_chunk(
             ell={
                 shard: (len(self.index_maps[shard]),

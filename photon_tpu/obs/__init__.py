@@ -1,0 +1,64 @@
+"""Unified observability layer (docs/observability.md).
+
+One place the whole stack reports through, replacing the per-subsystem
+patchwork (`Timed` log lines, the serving counter dict, hand-rolled
+``perf_counter`` pairs in descent, the unlocked ``SCORE_KERNEL_STATS``
+global):
+
+* ``metrics``  — :class:`MetricsRegistry` of named counters/gauges/
+  histograms with JSON snapshots and Prometheus text exposition
+  (``GET /metrics?format=prom``);
+* ``trace``    — :class:`trace_span`/:func:`instant` emitting Chrome
+  trace-event JSON (Perfetto-loadable) with propagated trace ids, threaded
+  through ingest, coordinate descent, optimizer solves, and the serving
+  path (``--trace-out`` on every driver);
+* ``retrace``  — jit-compilation sentinel: per-kernel trace counters and a
+  loud warning (log + trace event) when a hot-path kernel retraces after
+  warmup, plus device-memory watermark gauges.
+
+Both hooks follow ``faults.fault_point``'s cost model: one module-global
+read when inactive, so the instrumentation is always-on in production code.
+"""
+from photon_tpu.obs.metrics import (
+    Counter,
+    Gauge,
+    HistogramMetric,
+    MetricsRegistry,
+    REGISTRY,
+    get_registry,
+)
+from photon_tpu.obs.trace import (
+    TraceCollector,
+    current_trace_id,
+    instant,
+    new_trace_id,
+    start_tracing,
+    stop_tracing,
+    suspend_tracing,
+    trace_context,
+    trace_span,
+    tracing,
+    tracing_active,
+)
+from photon_tpu.obs import retrace
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "HistogramMetric",
+    "MetricsRegistry",
+    "REGISTRY",
+    "get_registry",
+    "TraceCollector",
+    "current_trace_id",
+    "instant",
+    "new_trace_id",
+    "retrace",
+    "start_tracing",
+    "stop_tracing",
+    "suspend_tracing",
+    "trace_context",
+    "trace_span",
+    "tracing",
+    "tracing_active",
+]

@@ -1,0 +1,331 @@
+"""Process-wide metrics registry: named counters/gauges/histograms.
+
+One registry replaces the instrumentation patchwork that grew across PRs —
+the serving server's hand-rolled counter dict, the batcher/cache/breaker
+snapshot methods, and the unlocked ``SCORE_KERNEL_STATS`` module global.
+Every instrument is thread-safe and resettable, and a registry exports two
+views of the same state:
+
+* :meth:`MetricsRegistry.snapshot` — the nested JSON dict the existing
+  JSONL metrics pipeline (``utils.write_metrics_jsonl``) and ``/metrics``
+  endpoint already speak;
+* :meth:`MetricsRegistry.to_prometheus` — Prometheus text exposition
+  (version 0.0.4), served at ``GET /metrics?format=prom`` so a standard
+  Prometheus scrape covers latency, throughput, queue depth, and per-kernel
+  retrace counts without a sidecar.
+
+Label support is deliberately minimal (one flat ``dict`` of label pairs per
+child); histograms reuse ``utils.LatencyHistogram`` and export as a
+Prometheus *summary* (quantile series + ``_sum``/``_count``), which keeps
+memory bounded under any traffic volume.
+
+The module-level :data:`REGISTRY` is the process default (kernel retrace
+counters, device-memory gauges); components that need isolation (one
+``ScoringServer`` per test) construct their own registry and merge the
+global view at exposition time.
+"""
+from __future__ import annotations
+
+import math
+import re
+import threading
+import time
+from typing import Callable, Mapping, Optional
+
+from photon_tpu.utils.logging import LatencyHistogram
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "HistogramMetric",
+    "MetricsRegistry",
+    "REGISTRY",
+    "get_registry",
+]
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    name = _NAME_RE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_labels(labels: Mapping[str, str]) -> str:
+    if not labels:
+        return ""
+    body = ",".join(
+        f'{_prom_name(str(k))}="{_escape_label(str(v))}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + body + "}"
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _prom_value(v) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f) if not f.is_integer() else str(int(f))
+
+
+class Counter:
+    """Monotonic counter, optionally with one level of labels.
+
+    ``inc()`` bumps the unlabeled value; ``inc(kernel="score")`` bumps the
+    ``{kernel="score"}`` child. ``value()``/``value(kernel=...)`` read.
+    """
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._children: dict[tuple, float] = {}
+
+    @staticmethod
+    def _key(labels: Mapping[str, str]) -> tuple:
+        return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease by {n}")
+        with self._lock:
+            if labels:
+                k = self._key(labels)
+                self._children[k] = self._children.get(k, 0.0) + n
+            else:
+                self._value += n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            if labels:
+                return self._children.get(self._key(labels), 0.0)
+            return self._value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+            self._children.clear()
+
+    def collect(self) -> list[tuple[dict, float]]:
+        """(labels, value) series, unlabeled first."""
+        with self._lock:
+            out = []
+            if self._value or not self._children:
+                out.append(({}, self._value))
+            out.extend((dict(k), v) for k, v in sorted(self._children.items()))
+            return out
+
+    def snapshot_value(self):
+        with self._lock:
+            if self._children:
+                return {
+                    ".".join(v for _, v in k): val
+                    for k, val in sorted(self._children.items())
+                } | ({"": self._value} if self._value else {})
+            return self._value
+
+
+class Gauge(Counter):
+    """Settable instantaneous value; ``fn`` makes it a callback gauge read
+    at collection time (queue depth, device-memory watermark)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = "",
+                 fn: Optional[Callable[[], float]] = None):
+        super().__init__(name, help)
+        self._fn = fn
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            if labels:
+                self._children[self._key(labels)] = float(v)
+            else:
+                self._value = float(v)
+
+    def inc(self, n: float = 1, **labels) -> None:  # gauges may move freely
+        with self._lock:
+            if labels:
+                k = self._key(labels)
+                self._children[k] = self._children.get(k, 0.0) + n
+            else:
+                self._value += n
+
+    def dec(self, n: float = 1, **labels) -> None:
+        self.inc(-n, **labels)
+
+    def collect(self) -> list[tuple[dict, float]]:
+        if self._fn is not None:
+            try:
+                v = self._fn()
+            except Exception:  # noqa: BLE001 - a sick probe must not 500 /metrics
+                return []
+            if isinstance(v, Mapping):
+                return [(dict(k) if isinstance(k, tuple) else {"key": str(k)},
+                         float(val)) for k, val in sorted(v.items())]
+            return [({}, float(v))] if v is not None else []
+        return super().collect()
+
+    def snapshot_value(self):
+        if self._fn is not None:
+            series = self.collect()
+            if len(series) == 1 and not series[0][0]:
+                return series[0][1]
+            return {
+                ".".join(f"{k}={v}" for k, v in sorted(lbl.items())): val
+                for lbl, val in series
+            }
+        return super().snapshot_value()
+
+
+class HistogramMetric:
+    """A named ``LatencyHistogram`` exported as a Prometheus summary."""
+
+    kind = "summary"
+    QUANTILES = (0.5, 0.95, 0.99)
+
+    def __init__(self, name: str, help: str = "",
+                 histogram: Optional[LatencyHistogram] = None):
+        self.name = name
+        self.help = help
+        self.histogram = histogram or LatencyHistogram()
+
+    def observe(self, seconds: float) -> None:
+        self.histogram.observe(seconds)
+
+    def reset(self) -> None:
+        # LatencyHistogram has no public reset; replace it wholesale (racy
+        # observers at worst land one sample in the discarded instance).
+        self.histogram = LatencyHistogram()
+
+    def snapshot_value(self) -> dict:
+        return self.histogram.snapshot()
+
+    def prometheus_lines(self, exposed_name: Optional[str] = None) -> list[str]:
+        h = self.histogram
+        name = exposed_name or _prom_name(self.name)
+        with h._lock:
+            n, s = h._n, h._sum
+        lines = []
+        for q in self.QUANTILES:
+            lines.append(
+                f'{name}{{quantile="{q}"}} '
+                f"{_prom_value(h.quantile_ms(q) / 1e3)}"
+            )
+        lines.append(f"{name}_sum {_prom_value(s)}")
+        lines.append(f"{name}_count {_prom_value(n)}")
+        return lines
+
+
+class MetricsRegistry:
+    """Name → instrument registry. Instruments are created on first use and
+    shared thereafter (idempotent ``counter``/``gauge``/``histogram``
+    accessors), so call sites don't coordinate setup order."""
+
+    def __init__(self, prefix: str = "photon"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, name: str, factory, kind) -> object:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, kind):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help), Counter)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        m = self._get(name, lambda: Gauge(name, help), Gauge)
+        return m
+
+    def gauge_fn(self, name: str, fn: Callable[[], float],
+                 help: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help, fn=fn), Gauge)
+
+    def histogram(self, name: str, help: str = "",
+                  histogram: Optional[LatencyHistogram] = None
+                  ) -> HistogramMetric:
+        return self._get(
+            name, lambda: HistogramMetric(name, help, histogram),
+            HistogramMetric,
+        )
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def reset(self) -> None:
+        """Zero every instrument (tests; NOT for production use — counters
+        are contractually monotonic between scrapes)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            reset = getattr(m, "reset", None)
+            if reset is not None:
+                reset()
+
+    # ------------------------------------------------------------ exports
+
+    def snapshot(self) -> dict:
+        """Flat name → value dict (counters/gauges scalar or per-label dict,
+        histograms their quantile snapshot)."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        return {name: m.snapshot_value() for name, m in sorted(metrics.items())}
+
+    def to_prometheus(self, extra: Optional["MetricsRegistry"] = None) -> str:
+        """Prometheus text exposition of this registry (merged with
+        ``extra`` — typically the process-global registry — when given)."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        if extra is not None:
+            with extra._lock:
+                for name, m in extra._metrics.items():
+                    metrics.setdefault(name, m)
+        lines: list[str] = []
+        for name in sorted(metrics):
+            m = metrics[name]
+            pname = _prom_name(f"{self.prefix}_{name}")
+            if m.help:
+                lines.append(f"# HELP {pname} {m.help}")
+            lines.append(f"# TYPE {pname} {m.kind}")
+            if isinstance(m, HistogramMetric):
+                lines.extend(m.prometheus_lines(pname))
+            else:
+                for labels, value in m.collect():
+                    lines.append(
+                        f"{pname}{_prom_labels(labels)} {_prom_value(value)}"
+                    )
+        return "\n".join(lines) + "\n"
+
+    def now(self) -> float:  # patchable in tests
+        return time.time()
+
+
+# Process-global default registry: kernel retrace counters, device-memory
+# gauges, ingest/descent counters — anything not owned by a single server.
+REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    return REGISTRY

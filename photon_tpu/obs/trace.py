@@ -1,0 +1,302 @@
+"""Process-wide tracing: Chrome trace-event spans with propagated context.
+
+Dapper-style (Sigelman et al., 2010) always-on, low-overhead tracing for the
+whole stack — ingest, coordinate descent, optimizer solves, the serving
+path — emitting the Chrome trace-event JSON format, so one run's timeline
+opens directly in Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``.
+
+Design constraints (docs/observability.md):
+
+* **Near-zero cost when off.** Like ``faults.fault_point``, the hot-path
+  check is one module-global read: :class:`trace_span` is a plain slotted
+  class (no generator machinery) whose ``__exit__`` does nothing but two
+  ``perf_counter`` reads when no collector is installed. Spans still
+  measure wall-clock (``span.seconds``) so callers can keep using the
+  measurement for records/logs whether or not tracing is on.
+* **Propagated context.** Spans on a context-carrying thread inherit a
+  ``trace_id``. A request's id is minted once at the edge
+  (:func:`new_trace_id`) and attached via :func:`trace_context`; the
+  serving micro-batcher stores the submitting request's id on the queue
+  item, and the worker stamps it onto that row's queue-wait span and into
+  the coalesced batch span's ``trace_ids`` list (a batch mixes several
+  requests, so batch-level work — kernel, store resolve — correlates
+  through that list rather than a single id).
+* **One artifact.** Events buffer in memory (bounded) and
+  :func:`stop_tracing` writes a single ``{"traceEvents": [...]}`` JSON
+  object; ``scripts/obs_smoke.py`` validates the format in CI.
+
+Span taxonomy (``cat`` → ``name``) is documented in docs/observability.md.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "TraceCollector",
+    "trace_span",
+    "instant",
+    "start_tracing",
+    "stop_tracing",
+    "suspend_tracing",
+    "tracing_active",
+    "tracing",
+    "new_trace_id",
+    "current_trace_id",
+    "trace_context",
+]
+
+# Common clock for all collectors in this process: microsecond timestamps
+# relative to module import, so events from collectors started at different
+# times still order correctly within one process.
+_EPOCH = time.perf_counter()
+
+_span_ids = itertools.count(1)
+_trace_ids = itertools.count(1)
+_tls = threading.local()
+
+# Default cap on buffered events: a leaked always-on collector must not grow
+# host memory without bound. Dropped events are counted and reported in the
+# written artifact ("photon.trace.dropped" metadata event).
+_DEFAULT_MAX_EVENTS = 1_000_000
+
+
+def new_trace_id() -> str:
+    """Mint a fresh trace id (process-unique, human-scannable)."""
+    return f"t{os.getpid():x}.{next(_trace_ids):x}"
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace id attached to this thread, if any."""
+    return getattr(_tls, "trace_id", None)
+
+
+class trace_context:
+    """``with trace_context(trace_id):`` — attach a trace id to this thread.
+
+    Used at work-handoff boundaries: the producing thread records
+    ``current_trace_id()`` next to the work item, the consuming thread
+    re-enters it here so spans emitted while processing the item correlate
+    with the originating request. Re-entrant; restores the previous id."""
+
+    __slots__ = ("trace_id", "_prev")
+
+    def __init__(self, trace_id: Optional[str]):
+        self.trace_id = trace_id
+
+    def __enter__(self) -> "trace_context":
+        self._prev = getattr(_tls, "trace_id", None)
+        _tls.trace_id = self.trace_id
+        return self
+
+    def __exit__(self, *exc) -> None:
+        _tls.trace_id = self._prev
+
+
+class TraceCollector:
+    """Thread-safe in-memory buffer of Chrome trace events."""
+
+    def __init__(self, max_events: int = _DEFAULT_MAX_EVENTS):
+        self.max_events = int(max_events)
+        self.events: list[dict] = []
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._pid = os.getpid()
+
+    def add(self, event: dict) -> None:
+        with self._lock:
+            if len(self.events) >= self.max_events:
+                self.dropped += 1
+                return
+            self.events.append(event)
+
+    def complete(
+        self,
+        name: str,
+        cat: str,
+        t0: float,
+        dur_s: float,
+        args: Optional[dict] = None,
+    ) -> None:
+        """One 'X' (complete) event; ``t0`` is a perf_counter value."""
+        self.add({
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": round((t0 - _EPOCH) * 1e6, 1),
+            "dur": round(dur_s * 1e6, 1),
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+            "args": args or {},
+        })
+
+    def instant(self, name: str, cat: str, args: Optional[dict] = None) -> None:
+        """One 'i' (instant) event at now — fault firings, retrace warnings."""
+        self.add({
+            "name": name,
+            "cat": cat,
+            "ph": "i",
+            "s": "t",
+            "ts": round((time.perf_counter() - _EPOCH) * 1e6, 1),
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+            "args": args or {},
+        })
+
+    def span_count(self, cat: Optional[str] = None) -> int:
+        with self._lock:
+            return sum(
+                1 for e in self.events
+                if e["ph"] == "X" and (cat is None or e["cat"] == cat)
+            )
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            events = list(self.events)
+            dropped = self.dropped
+        out = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if dropped:
+            out["photon.trace.dropped"] = dropped
+        return out
+
+    def write(self, path: str) -> str:
+        """Write the trace artifact as one JSON object (Perfetto-loadable)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f)
+        return path
+
+
+_ACTIVE: Optional[TraceCollector] = None
+
+
+def tracing_active() -> bool:
+    return _ACTIVE is not None
+
+
+def active_collector() -> Optional[TraceCollector]:
+    return _ACTIVE
+
+
+def start_tracing(max_events: int = _DEFAULT_MAX_EVENTS) -> TraceCollector:
+    """Install a process-wide collector (replacing any active one)."""
+    global _ACTIVE
+    _ACTIVE = TraceCollector(max_events=max_events)
+    return _ACTIVE
+
+
+def stop_tracing(path: Optional[str] = None) -> Optional[TraceCollector]:
+    """Uninstall the active collector; write it to ``path`` if given."""
+    global _ACTIVE
+    col = _ACTIVE
+    _ACTIVE = None
+    if col is not None and path:
+        col.write(path)
+    return col
+
+
+class suspend_tracing:
+    """``with suspend_tracing():`` — temporarily uninstall any active
+    collector (restored on exit). Benchmarks use this so headline numbers
+    are always measured tracing-off even under ``--trace-out``."""
+
+    __slots__ = ("_prev",)
+
+    def __enter__(self) -> None:
+        global _ACTIVE
+        self._prev = _ACTIVE
+        _ACTIVE = None
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        _ACTIVE = self._prev
+
+
+class tracing:
+    """``with tracing(path) as col:`` — scoped collector install, written on
+    exit (restores whatever was active before, so traces can nest in
+    tests)."""
+
+    __slots__ = ("path", "max_events", "collector", "_prev")
+
+    def __init__(self, path: Optional[str] = None,
+                 max_events: int = _DEFAULT_MAX_EVENTS):
+        self.path = path
+        self.max_events = max_events
+        self.collector: Optional[TraceCollector] = None
+
+    def __enter__(self) -> TraceCollector:
+        global _ACTIVE
+        self._prev = _ACTIVE
+        self.collector = TraceCollector(max_events=self.max_events)
+        _ACTIVE = self.collector
+        return self.collector
+
+    def __exit__(self, *exc) -> None:
+        global _ACTIVE
+        _ACTIVE = self._prev
+        if self.path and self.collector is not None:
+            self.collector.write(self.path)
+
+
+class trace_span:
+    """``with trace_span("descent.step", cat="descent", sweep=0) as sp:``
+
+    Measures wall-clock into ``sp.seconds`` ALWAYS (so instrumented code can
+    drop its hand-rolled ``perf_counter`` pairs); emits a complete event only
+    when a collector is active. The span's ``trace_id`` defaults to the
+    thread's current context (:func:`trace_context`); pass one explicitly at
+    trace roots. ``sp.set(key=value)`` adds result attributes (iteration
+    counts, row counts) before exit. An escaping exception is recorded as
+    ``args["error"]``.
+    """
+
+    __slots__ = ("name", "cat", "args", "trace_id", "seconds", "_t0")
+
+    def __init__(self, name: str, cat: str = "app",
+                 trace_id: Optional[str] = None, **args):
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.trace_id = trace_id
+        self.seconds = 0.0
+
+    def set(self, **args) -> "trace_span":
+        self.args.update(args)
+        return self
+
+    def __enter__(self) -> "trace_span":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.seconds = time.perf_counter() - self._t0
+        col = _ACTIVE
+        if col is None:
+            return
+        args = self.args
+        tid = self.trace_id or current_trace_id()
+        if tid is not None:
+            args = {"trace_id": tid, **args}
+        if exc_type is not None:
+            args = {**args, "error": exc_type.__name__}
+        col.complete(self.name, self.cat, self._t0, self.seconds,
+                     {**args, "span_id": next(_span_ids)})
+
+
+def instant(name: str, cat: str = "event", **args) -> None:
+    """Emit an instant event (no duration) if tracing is active — fault
+    firings, retrace warnings, admission rejections."""
+    col = _ACTIVE
+    if col is None:
+        return
+    tid = current_trace_id()
+    if tid is not None:
+        args = {"trace_id": tid, **args}
+    col.instant(name, cat, args)

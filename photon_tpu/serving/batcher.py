@@ -37,6 +37,8 @@ from concurrent.futures import Future
 from typing import Optional
 
 from photon_tpu.faults import fault_point
+from photon_tpu.obs import trace as obs_trace
+from photon_tpu.obs.trace import current_trace_id, trace_span
 
 
 class Overloaded(RuntimeError):
@@ -48,13 +50,19 @@ class DeadlineExceeded(RuntimeError):
 
 
 class _Pending:
-    __slots__ = ("version", "row", "deadline", "future")
+    __slots__ = ("version", "row", "deadline", "future", "trace_id",
+                 "enqueued_at")
 
     def __init__(self, version, row, deadline=None):
         self.version = version
         self.row = row
         self.deadline = deadline  # time.monotonic() value, or None
         self.future: Future = Future()
+        # Trace propagation across the thread boundary (Dapper-style): the
+        # submitting request's trace id rides the queue item so the worker
+        # can correlate queue wait + kernel time back to the request.
+        self.trace_id = current_trace_id()
+        self.enqueued_at = time.perf_counter()
 
 
 class MicroBatcher:
@@ -216,10 +224,28 @@ class MicroBatcher:
             v0 = items[0].version
             batch = [it for it in items if it.version is v0]
             self._carry = [it for it in items if it.version is not v0]
+            col = obs_trace.active_collector()
+            if col is not None:
+                # Queue-wait spans, one per admitted row, stamped with the
+                # ORIGINATING request's trace id: the span starts at submit
+                # time (producer thread) and ends here (worker thread) —
+                # exactly the cross-thread hop the timeline must bridge.
+                now = time.perf_counter()
+                for it in batch:
+                    col.complete(
+                        "serve.queue_wait", "serving", it.enqueued_at,
+                        now - it.enqueued_at,
+                        {"trace_id": it.trace_id} if it.trace_id else {},
+                    )
             try:
-                scores, flags = v0.scorer.score_rows_flagged(
-                    [it.row for it in batch]
-                )
+                with trace_span(
+                    "serve.batch", cat="serving", rows=len(batch),
+                    trace_ids=[it.trace_id for it in batch
+                               if it.trace_id is not None] or None,
+                ):
+                    scores, flags = v0.scorer.score_rows_flagged(
+                        [it.row for it in batch]
+                    )
                 for it, s, fl in zip(batch, scores, flags):
                     it.future.set_result(ScoreResult(float(s), fl))
             except Exception as e:  # noqa: BLE001 - routed to the waiter

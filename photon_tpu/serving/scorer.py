@@ -25,8 +25,12 @@ from photon_tpu.estimators.config import (
     FixedEffectDataConfig,
     RandomEffectDataConfig,
 )
-from photon_tpu.estimators.game_transformer import additive_score_rows
+from photon_tpu.estimators.game_transformer import (
+    SCORE_KERNEL_NAME,
+    additive_score_rows,
+)
 from photon_tpu.game.coordinates import FixedEffectModel
+from photon_tpu.obs import retrace, trace_span
 from photon_tpu.game.random_effect import RandomEffectModel
 from photon_tpu.serving.circuit import CircuitBreaker
 from photon_tpu.serving.coefficient_store import (
@@ -239,23 +243,29 @@ class RowScorer:
             cache = self._caches[cid]
             keys = [row.entity_keys[cid] for row in rows]
             keys += [None] * (bp - b)  # pad rows → fallback zero row
-            slots, degraded = cache.resolve(keys)
+            with trace_span("serve.store_resolve", cat="serving",
+                            coordinate=cid, keys=b):
+                slots, degraded = cache.resolve(keys)
             if degraded.any():
                 for r in np.flatnonzero(degraded[:b]):
                     degraded_rows[int(r)].append(cid)
             re_proj[cid], re_coef[cid] = cache.gather(slots)
 
-        scores = additive_score_rows(
-            jnp.asarray(offsets),
-            shard_idx,
-            shard_val,
-            self._fixed_ws,
-            re_proj,
-            re_coef,
-            fixed_parts=self.fixed_parts,
-            re_parts=self.re_parts,
-        )
-        return np.asarray(scores)[:b], [tuple(d) for d in degraded_rows]
+        with trace_span("serve.kernel", cat="serving", rows=b, bucket=bp):
+            scores = additive_score_rows(
+                jnp.asarray(offsets),
+                shard_idx,
+                shard_val,
+                self._fixed_ws,
+                re_proj,
+                re_coef,
+                fixed_parts=self.fixed_parts,
+                re_parts=self.re_parts,
+            )
+            # The D2H fetch below is the sync point; inside the span so the
+            # kernel span reports completed compute, not async dispatch.
+            host_scores = np.asarray(scores)
+        return host_scores[:b], [tuple(d) for d in degraded_rows]
 
     def warmup(self) -> int:
         """Compile every row-bucket shape once (empty rows, fallback
@@ -282,8 +292,17 @@ class RowScorer:
             sizes.append(b)
             b <<= 1
         sizes.append(self.config.max_batch)  # reachable even when not pow2
-        for size in sizes:
-            self._score_chunk([dummy] * size)
+        # A NEW version's warmup legitimately compiles new shapes (hot swap
+        # to different max_batch/nnz). Suppress the sentinel for THIS
+        # thread only: the old version keeps serving during a swap, and a
+        # genuine retrace on a serving thread must still warn.
+        with retrace.expected_compiles():
+            for size in sizes:
+                self._score_chunk([dummy] * size)
+        # Shape ladder fully compiled: from here on, any further trace of
+        # the scoring kernel is a hot-path retrace — the sentinel counts it
+        # and warns (log + trace event + Prometheus counter).
+        retrace.mark_warm(SCORE_KERNEL_NAME)
         return len(sizes)
 
     def cache_snapshot(self) -> dict:

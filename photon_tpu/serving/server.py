@@ -7,9 +7,12 @@ Routes (docs/serving.md §schema):
   behind an open coefficient-store circuit breaker)
 * ``GET  /healthz``     — liveness + current model version; 503 once the
   batcher worker has died
-* ``GET  /metrics``     — latency histogram (p50/p95/p99), throughput +
-  shed/expired counters, batcher + coefficient-cache + breaker stats,
-  kernel compile count
+* ``GET  /metrics``     — latency histogram (p50/p95/p99), lifetime +
+  interval throughput, shed/expired counters, batcher + coefficient-cache
+  + breaker stats, per-kernel compile/retrace counts (JSON)
+* ``GET  /metrics?format=prom`` — the same state as Prometheus text
+  exposition (docs/observability.md §scrape): latency summary, request
+  counters, queue depth, device-memory watermark, kernel retrace counters
 * ``POST /admin/swap``  — ``{"model_dir": ..}`` → hot-swap; blocking,
   atomic, in-flight requests unaffected
 
@@ -27,11 +30,20 @@ from __future__ import annotations
 import json
 import threading
 import time
+import urllib.parse
 from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from photon_tpu.estimators.game_transformer import SCORE_KERNEL_STATS
+from photon_tpu.estimators.game_transformer import SCORE_KERNEL_NAME
+from photon_tpu.obs import (
+    MetricsRegistry,
+    REGISTRY as GLOBAL_REGISTRY,
+    new_trace_id,
+    retrace,
+    trace_context,
+    trace_span,
+)
 from photon_tpu.serving.batcher import (
     DeadlineExceeded,
     MicroBatcher,
@@ -39,7 +51,7 @@ from photon_tpu.serving.batcher import (
 )
 from photon_tpu.serving.registry import ModelRegistry
 from photon_tpu.serving.scorer import RequestError
-from photon_tpu.utils import LatencyHistogram, write_metrics_jsonl
+from photon_tpu.utils import write_metrics_jsonl
 
 _REQUEST_TIMEOUT_S = 30.0
 
@@ -63,13 +75,48 @@ class ScoringServer:
         self.logger = logger
         self.metrics_path = metrics_path
         self.request_timeout_s = float(request_timeout_s)
-        self.latency = LatencyHistogram()
-        self.counters = {
-            "requests": 0, "errors": 0, "swaps": 0,
-            "shed": 0, "expired": 0, "degraded": 0,
+        # Per-server metrics registry (docs/observability.md): the old
+        # hand-rolled counter dict, the latency histogram, and the batcher/
+        # cache/breaker snapshots all live here now, giving one state with
+        # two exports — the JSON snapshot below and the Prometheus text
+        # exposition at /metrics?format=prom. Per-instance (not the process
+        # global) so multiple servers in one process never collide; the
+        # process-global registry (kernel retrace counters, device-memory
+        # watermark) is merged at exposition time.
+        self.metrics = MetricsRegistry()
+        self._counters = {
+            name: self.metrics.counter(
+                f"serve_{name}_total", f"scoring requests: {name}")
+            for name in (
+                "requests", "errors", "swaps", "shed", "expired", "degraded",
+            )
         }
+        self._latency = self.metrics.histogram(
+            "serve_request_latency_seconds",
+            "end-to-end /score latency (successful requests)",
+        )
+        self.metrics.gauge_fn(
+            "serve_queue_depth", lambda: self.batcher.snapshot()["queued"],
+            "requests waiting in the micro-batcher admission queue",
+        )
+        self.metrics.gauge_fn(
+            "serve_batch_rows_mean",
+            lambda: self.batcher.snapshot()["mean_batch_rows"],
+            "mean coalesced micro-batch size",
+        )
+        self.metrics.gauge_fn(
+            "serve_uptime_seconds", lambda: time.time() - self._started_at,
+            "seconds since server start",
+        )
+        retrace.install_device_memory_gauges(self.metrics)
         self._started_at = time.time()
-        self._counters_lock = threading.Lock()
+        # Interval-rate state (satellite fix): lifetime requests/uptime
+        # understates the current rate after any idle period, so each
+        # snapshot also reports the rate over the window since the previous
+        # snapshot/flush.
+        self._rate_lock = threading.Lock()
+        self._rate_prev_t = self._started_at
+        self._rate_prev_requests = 0
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -110,6 +157,27 @@ class ScoringServer:
                     raise RequestError("request body is not valid JSON")
 
             def do_GET(self):
+                path, _, query = self.path.partition("?")
+                if path == "/metrics":
+                    q = urllib.parse.parse_qs(query)
+                    if q.get("format", ["json"])[0] in ("prom", "prometheus"):
+                        # Prometheus text exposition: this server's registry
+                        # merged with the process-global one (kernel
+                        # retraces, device memory).
+                        body = server.metrics.to_prometheus(
+                            extra=GLOBAL_REGISTRY
+                        ).encode("utf-8")
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._reply(200, server.metrics_snapshot())
+                    return
                 if self.path == "/healthz":
                     v = server.registry.current
                     if not server.batcher.healthy:
@@ -127,8 +195,6 @@ class ScoringServer:
                         "uptime_s": round(
                             time.time() - server._started_at, 1),
                     })
-                elif self.path == "/metrics":
-                    self._reply(200, server.metrics_snapshot())
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 
@@ -149,15 +215,26 @@ class ScoringServer:
                     self._reply(404, {"error": f"no route {self.path}"})
 
             def _score(self):
+                # Trace root: one trace id per request, attached to this
+                # thread for the admission spans and carried across the
+                # batcher boundary on the queue item (docs/observability.md).
+                with trace_context(new_trace_id()), \
+                        trace_span("serve.request", cat="serving") as req_span:
+                    self._score_traced(req_span)
+
+            def _score_traced(self, req_span):
                 t0 = time.perf_counter()
                 try:
-                    payload = self._read_json()
-                    version = server.registry.current
-                    row = version.scorer.parse_request(payload)
-                    deadline = time.monotonic() + server.request_timeout_s
-                    fut = server.batcher.submit(
-                        version, row, deadline=deadline
-                    )
+                    with trace_span("serve.admission", cat="serving"):
+                        payload = self._read_json()
+                        version = server.registry.current
+                        row = version.scorer.parse_request(payload)
+                        deadline = (
+                            time.monotonic() + server.request_timeout_s
+                        )
+                        fut = server.batcher.submit(
+                            version, row, deadline=deadline
+                        )
                     # The batcher fails the future at the deadline; the
                     # +1s slack only covers a dead worker missed by the
                     # crash drain — a waiter must NEVER outlive its budget
@@ -167,26 +244,31 @@ class ScoringServer:
                     )
                 except RequestError as e:
                     server._count(errors=1)
+                    req_span.set(status=400)
                     self._reply(400, {"error": str(e)})
                     return
                 except Overloaded as e:
                     # Load shed: bounded queue full. 503 + Retry-After is
                     # the contract a client-side retry policy needs.
                     server._count(shed=1)
+                    req_span.set(status=503, shed=True)
                     self._reply(503, {"error": str(e), "shed": True},
                                 headers=(("Retry-After", "1"),))
                     return
                 except (DeadlineExceeded, FuturesTimeout, TimeoutError):
                     server._count(expired=1)
+                    req_span.set(status=503, expired=True)
                     self._reply(503, {"error": "request deadline exceeded"},
                                 headers=(("Retry-After", "1"),))
                     return
                 except Exception as e:  # noqa: BLE001 - a 500, not a crash
                     server._count(errors=1)
+                    req_span.set(status=500)
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
                 server.latency.observe(time.perf_counter() - t0)
                 server._count(requests=1)
+                req_span.set(status=200)
                 out = {"score": score, "model_version": version.version}
                 degraded = getattr(score, "degraded", ())
                 if degraded:
@@ -245,26 +327,59 @@ class ScoringServer:
         return self.httpd.server_address[:2]
 
     def _count(self, **deltas) -> None:
-        with self._counters_lock:
-            for k, d in deltas.items():
-                self.counters[k] += d
+        for k, d in deltas.items():
+            self._counters[k].inc(d)
 
-    def metrics_snapshot(self) -> dict:
+    @property
+    def counters(self) -> dict:
+        """Back-compat view of the old counter dict (registry-backed)."""
+        return {k: int(c.value()) for k, c in self._counters.items()}
+
+    @property
+    def latency(self):
+        """The live latency histogram — resolved through the registry
+        metric so a registry reset can never orphan the server's view."""
+        return self._latency.histogram
+
+    def metrics_snapshot(self, advance_interval: bool = False) -> dict:
+        """Live metrics. ``advance_interval`` moves the interval-rate
+        window forward; only the periodic JSONL flush passes True, so an
+        external scraper polling ``GET /metrics`` cannot shrink the window
+        the persisted interval rate covers — scrapes see the rate since
+        the last flush, read-only."""
         v = self.registry.current
-        with self._counters_lock:
-            counters = dict(self.counters)
-        elapsed = max(time.time() - self._started_at, 1e-9)
+        now = time.time()
+        elapsed = max(now - self._started_at, 1e-9)
+        # Interval rate (deltas between flushes): the lifetime
+        # requests/uptime figure understates the CURRENT rate after any
+        # idle period — a server idle overnight then serving 1k rows/s
+        # would report ~0. Both are reported; dashboards want the interval
+        # figure, capacity ledgers the lifetime one. Counter reads happen
+        # INSIDE the lock so two concurrent snapshots can never observe a
+        # window whose request delta went backwards (negative rate).
+        with self._rate_lock:
+            counters = self.counters
+            dt = now - self._rate_prev_t
+            dreq = counters["requests"] - self._rate_prev_requests
+            if advance_interval:
+                self._rate_prev_t = now
+                self._rate_prev_requests = counters["requests"]
+        interval_rate = round(dreq / dt, 2) if dt > 1e-3 else None
         return {
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "model_version": v.version,
             "latency": self.latency.snapshot(),
             "throughput_rows_per_sec": round(
                 counters["requests"] / elapsed, 2),
+            "throughput_interval_rows_per_sec": interval_rate,
+            "interval_s": round(dt, 3),
             **counters,
             "batcher": self.batcher.snapshot(),
             "coefficient_caches": v.scorer.cache_snapshot(),
             "breakers": v.scorer.breaker_snapshot(),
-            "kernel_traces": SCORE_KERNEL_STATS["traces"],
+            "kernel_traces": retrace.traces(SCORE_KERNEL_NAME),
+            "kernel_retraces_after_warmup": retrace.retraces_after_warmup(
+                SCORE_KERNEL_NAME),
         }
 
     def _metrics_loop(self, interval_s: float) -> None:
@@ -273,7 +388,10 @@ class ScoringServer:
 
     def flush_metrics(self) -> None:
         if self.metrics_path:
-            write_metrics_jsonl(self.metrics_path, [self.metrics_snapshot()])
+            write_metrics_jsonl(
+                self.metrics_path,
+                [self.metrics_snapshot(advance_interval=True)],
+            )
 
     def start(self) -> None:
         """Serve in a background thread (tests / embedded use)."""

