@@ -290,6 +290,32 @@ SPARK_MODEL_CORES = 64          # reference-era production cluster size
 SPARK_MODEL_SCALING_EFF = 0.7   # treeAggregate sync-reduce scaling efficiency
 SPARK_MODEL_PERCORE_FACTOR = 0.5  # JVM+scheduler per-core throughput vs NumPy
 
+# Pinned per-core NumPy baseline (VERDICT r5 weak #3: the live baseline
+# swings with host load — r3 403K, r4 309K, r5 162K samples/s on the same
+# box — so ``vs_modeled_spark_cluster`` crossing 1.0 measured only that the
+# host was busy during the baseline stage). The DENOMINATOR comes from this
+# checked-in file (value + date + load note); the live measurement is still
+# taken every run and reported ALONGSIDE (`numpy_percore_live_...`,
+# `vs_modeled_spark_cluster_live`) without moving the pinned ratio.
+PINNED_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BASELINE_PINNED.json")
+
+
+def load_pinned_baseline():
+    """The blessed per-core NumPy baseline dict, or None if the file is
+    missing/unreadable (the bench then falls back to the live measurement
+    and says so in the artifact)."""
+    try:
+        with open(PINNED_BASELINE_PATH) as f:
+            pinned = json.load(f)
+        # Coerce in place: a hand-edited quoted value must not survive
+        # validation only to string-multiply in the ratio arithmetic later.
+        pinned["numpy_percore_samples_per_sec"] = float(
+            pinned["numpy_percore_samples_per_sec"])
+        return pinned
+    except (OSError, KeyError, TypeError, ValueError):
+        return None
+
 
 def _make_data(n_rows: int, dim: int, k: int, seed: int = 0):
     rng = np.random.default_rng(seed)
@@ -1012,19 +1038,58 @@ def bench_game_scale():
             regularization=RegularizationContext(RegularizationType.L2),
             reg_weight=1.0, max_iterations=15),
     }
-    # Warm-up fit so the timed run reports steady-state step times, not XLA
-    # compile (same discipline as bench_game); the cold-start delta is
-    # reported separately.
-    t0 = time.perf_counter()
-    r = estimator.fit(bundle, None, [gcfg])
-    np.asarray(r[0].model["fixed"].model.coefficients.means)
-    cold = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    r = estimator.fit(bundle, None, [gcfg])
-    np.asarray(r[0].model["fixed"].model.coefficients.means)
-    total = time.perf_counter() - t0
+    # This stage runs under MEASURED solver routing (docs/scaling.md
+    # §"Solver routing"): the cold fit pays the one-time calibration race +
+    # kernel compiles, the warm fit routes straight to the measured winner
+    # — so the steady-state step times below are the routed production
+    # numbers, and compile/calibration time is reported as its own column
+    # instead of contaminating a bucket's solve figure (VERDICT r5 weak #6).
+    from photon_tpu.game import random_effect as re_mod
+    from photon_tpu.game import solver_routing
+    from photon_tpu.obs.metrics import REGISTRY
+
+    rows_c = REGISTRY.counter("re_rows_routed_total")
+    compile_c = REGISTRY.counter("re_solver_compile_seconds_total")
+    calib_c = REGISTRY.counter("re_calibration_seconds_total")
+
+    def _counters():
+        rows = {lbl.get("solver", ""): v for lbl, v in rows_c.collect() if lbl}
+        comp = sum(v for _, v in compile_c.collect())
+        return rows, comp, calib_c.value()
+
+    old_routing = os.environ.get("PHOTON_RE_ROUTING")
+    os.environ["PHOTON_RE_ROUTING"] = "measured"
+    # Isolate the cost table as well as the routing mode: an inherited
+    # PHOTON_RE_COST_TABLE would both skip the fresh race this stage's
+    # cold/warm split depends on AND overwrite the user's persisted
+    # production table with bench-shape measurements.
+    old_table = os.environ.pop("PHOTON_RE_COST_TABLE", None)
+    solver_routing.reset_process_table()  # a fresh race per bench run
+    try:
+        rows0, comp0, cal0 = _counters()
+        t0 = time.perf_counter()
+        r = estimator.fit(bundle, None, [gcfg])
+        np.asarray(r[0].model["fixed"].model.coefficients.means)
+        cold = time.perf_counter() - t0
+        rows1, comp1, cal1 = _counters()
+        t0 = time.perf_counter()
+        r = estimator.fit(bundle, None, [gcfg])
+        np.asarray(r[0].model["fixed"].model.coefficients.means)
+        total = time.perf_counter() - t0
+        rows2, comp2, cal2 = _counters()
+    finally:
+        if old_routing is None:
+            os.environ.pop("PHOTON_RE_ROUTING", None)
+        else:
+            os.environ["PHOTON_RE_ROUTING"] = old_routing
+        if old_table is not None:
+            os.environ["PHOTON_RE_COST_TABLE"] = old_table
+        solver_routing.reset_process_table()  # drop bench-shape entries
     steps = {rec.coordinate_id: rec.seconds for rec in r[0].tracker}
     re_secs = steps.get("perUser", float("nan"))
+    warm_rows = {k: rows2.get(k, 0) - rows1.get(k, 0) for k in rows2}
+    total_rows = sum(warm_rows.values())
+    free_rows = sum(v for k, v in warm_rows.items() if k.startswith("newton"))
     return {
         "game_scale_users": n_users,
         "game_scale_rows": n_users * rows_per_user,
@@ -1034,6 +1099,20 @@ def bench_game_scale():
         "game_scale_re_step_seconds": round(re_secs, 3),
         "game_scale_re_entities_per_sec": round(n_users / re_secs, 1),
         "game_scale_samples_per_sec": round(n_users * rows_per_user / total, 1),
+        # Compile/solve split + routing provenance (BENCH schema note in
+        # docs/scaling.md): *_cold covers calibration + first-trace XLA
+        # compiles; the warm columns prove the steady state pays neither.
+        "game_scale_re_routing": "measured",
+        "game_scale_re_solvers": sorted({
+            t["solver"] + (f"@{t['chunk']}" if t.get("chunk") else "")
+            for t in re_mod.LAST_BUCKET_TIMINGS
+        }),
+        "game_scale_re_compile_seconds_cold": round(comp1 - comp0, 2),
+        "game_scale_re_calibration_seconds_cold": round(cal1 - cal0, 2),
+        "game_scale_re_compile_seconds_warm": round(comp2 - comp1, 2),
+        "game_scale_re_calibration_seconds_warm": round(cal2 - cal1, 2),
+        "game_scale_re_history_free_row_fraction": round(
+            free_rows / total_rows, 4) if total_rows else None,
     }
 
 
@@ -1564,6 +1643,15 @@ def main():
             bm["vs_baseline_1core_raw"] = round(
                 head["samples_per_sec"] / raw["np_percore"], 2
             )
+            if "np_percore_live" in raw:
+                # Live-denominator ratio alongside, clearly labeled — the
+                # PINNED ratio above is the trend-worthy number.
+                bm["vs_modeled_spark_cluster_live"] = round(
+                    head["samples_per_sec"]
+                    / (raw["np_percore_live"] * SPARK_MODEL_CORES
+                       * SPARK_MODEL_SCALING_EFF
+                       * SPARK_MODEL_PERCORE_FACTOR), 3
+                )
         if "hbm_gbps" in raw:
             roofline_s = raw["bytes_per_pass"] / (raw["hbm_gbps"] * 1e9)
             achieved_s = head["seconds"] / head["data_passes"]
@@ -1599,6 +1687,8 @@ def main():
         bm = details["baseline_model"]
         raw["np_percore"] = bm["numpy_percore_samples_per_sec"]
         raw["modeled_cluster"] = bm["modeled_cluster_samples_per_sec"]
+        if "numpy_percore_live_samples_per_sec" in bm:
+            raw["np_percore_live"] = bm["numpy_percore_live_samples_per_sec"]
     if "roofline" in details:
         raw["hbm_gbps"] = details["roofline"]["measured_hbm_gbps"]
         raw["bytes_per_pass"] = details["roofline"]["bytes_per_pass"]
@@ -1635,7 +1725,15 @@ def main():
     # local multi-process NumPy run; ``vs_modeled_spark_cluster`` is the
     # north-star ratio against the modeled 64-core cluster.
     if "baseline_model" not in details:  # resume reuses the banked model
-        raw["np_percore"] = np_samples_per_sec / max(nproc, 1)
+        raw["np_percore_live"] = np_samples_per_sec / max(nproc, 1)
+        pinned = load_pinned_baseline()
+        # The DENOMINATOR is the checked-in pinned baseline (VERDICT r5
+        # weak #3 / round-6 ask #4): the ratio must not move with host load
+        # during the baseline stage. The live measurement rides alongside.
+        raw["np_percore"] = (
+            pinned["numpy_percore_samples_per_sec"] if pinned
+            else raw["np_percore_live"]
+        )
         raw["modeled_cluster"] = (
             raw["np_percore"]
             * SPARK_MODEL_CORES
@@ -1644,12 +1742,18 @@ def main():
         )
         details["baseline_model"] = {
             "numpy_percore_samples_per_sec": round(raw["np_percore"], 1),
+            "numpy_percore_pinned": pinned is not None,
+            "pinned_measured_at": (pinned or {}).get("measured_at"),
+            "pinned_load_note": (pinned or {}).get("load_note"),
+            "numpy_percore_live_samples_per_sec": round(
+                raw["np_percore_live"], 1),
             "modeled_cluster_cores": SPARK_MODEL_CORES,
             "modeled_scaling_efficiency": SPARK_MODEL_SCALING_EFF,
             "modeled_spark_percore_factor": SPARK_MODEL_PERCORE_FACTOR,
             "modeled_cluster_samples_per_sec": round(
                 raw["modeled_cluster"], 1),
-            "note": "model + arithmetic documented in BASELINE.md",
+            "note": "model + arithmetic documented in BASELINE.md; "
+                    "denominator pinned in BASELINE_PINNED.json",
         }
     _refresh_derived()
     flush()

@@ -150,11 +150,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     from photon_tpu.cli.params import (
         add_compilation_cache_flag,
         add_fault_plan_flag,
+        add_re_routing_flags,
         add_trace_flag,
     )
 
     add_compilation_cache_flag(p)
     add_fault_plan_flag(p)
+    add_re_routing_flags(p)
     add_trace_flag(p)
     return p
 
@@ -213,11 +215,13 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     from photon_tpu.cli.params import (
         enable_compilation_cache,
         enable_fault_plan,
+        enable_re_routing,
         enable_trace,
     )
 
     enable_compilation_cache(args.compilation_cache_dir)
     enable_fault_plan(args.fault_plan)
+    enable_re_routing(args, output_dir=args.output_dir)
     enable_trace(args.trace_out)
     # Join the multi-host runtime first (no-op single-process) so
     # jax.devices() below sees the whole pod slice (SURVEY.md §5.8).

@@ -325,6 +325,64 @@ def finish_trace(path) -> None:
         )
 
 
+def add_re_routing_flags(parser) -> None:
+    """Shared random-effect solver-routing flags (docs/scaling.md §"Solver
+    routing"): ``--re-routing`` picks between the deterministic static gate
+    ladder and the measured cost-model router; ``--re-cost-table`` persists
+    the calibration results alongside the model so a warm restart skips
+    the race AND reproduces the original routing decisions (a re-raced
+    timing winner could differ and break bit-identical resume)."""
+    import os
+
+    parser.add_argument(
+        "--re-routing", choices=["static", "measured"],
+        default=os.environ.get("PHOTON_RE_ROUTING") or "static",
+        help="random-effect bucket solver routing: 'static' = deterministic "
+             "eligibility gates (primal/dual Newton, chunked tiers, vmapped "
+             "fallback); 'measured' = per-bucket-shape cost table seeded by "
+             "a one-time calibration race on the first sweep "
+             "(game/solver_routing.py; default: $PHOTON_RE_ROUTING or "
+             "static)")
+    parser.add_argument(
+        "--re-cost-table",
+        default=os.environ.get("PHOTON_RE_COST_TABLE") or None,
+        help="JSON file for the measured-routing cost table (loaded at "
+             "startup if present, saved after every calibration race); "
+             "defaults to <output-dir>/solver_costs.json under "
+             "--re-routing measured (default: $PHOTON_RE_COST_TABLE)")
+    parser.add_argument(
+        "--clear-caches-per-config", action="store_true",
+        default=os.environ.get("PHOTON_CLEAR_CACHES_PER_CONFIG") == "1",
+        help="drop jax's compiled-executable caches at every optimization-"
+             "config (λ) boundary: bounds the mmap'd JIT code-page growth "
+             "that otherwise creeps toward vm.max_map_count and segfaults "
+             "multi-day runs (supervisor.MapCountWatchdog warns; this flag "
+             "acts). Off by default — in-core sweeps reuse executables "
+             "across λ values when shapes repeat")
+
+
+def enable_re_routing(args, output_dir=None) -> None:
+    """Install the routing flags process-wide (env is the contract the
+    bucket solver reads — see game/solver_routing.py). Under measured
+    routing with no explicit table path, the table persists alongside the
+    model in ``output_dir``."""
+    import logging
+    import os
+
+    os.environ["PHOTON_RE_ROUTING"] = args.re_routing
+    table = args.re_cost_table
+    if table is None and args.re_routing == "measured" and output_dir:
+        table = os.path.join(output_dir, "solver_costs.json")
+    if table:
+        os.environ["PHOTON_RE_COST_TABLE"] = table
+        logging.getLogger("photon_tpu.cli").info(
+            "RE solver routing: %s (cost table: %s%s)", args.re_routing,
+            table, ", resuming" if os.path.exists(table) else "",
+        )
+    if getattr(args, "clear_caches_per_config", False):
+        os.environ["PHOTON_CLEAR_CACHES_PER_CONFIG"] = "1"
+
+
 def add_fault_plan_flag(parser) -> None:
     """Shared --fault-plan flag (default: $PHOTON_FAULT_PLAN): run the
     driver under a deterministic fault-injection plan for chaos drills

@@ -166,6 +166,18 @@ class CoordinateDescent:
                     f"validation scorers missing for coordinates {missing}"
                 )
 
+        # Retrace-sentinel contract for the RE bucket solvers: sweep 0
+        # compiles the whole blessed shape ladder (full-bucket shapes,
+        # chunk-ladder shapes, calibration probes — all closed sets), so
+        # after the first sweep the kernels are marked warm and ANY further
+        # compile is a watched retrace-after-warmup. A new run() (new
+        # config / new λ) legitimately re-compiles, so warm state is
+        # cleared on entry.
+        from photon_tpu.obs import retrace as _retrace
+
+        for k in _retrace.RE_SOLVER_KERNELS:
+            _retrace.clear_warm(k)
+
         step = step_base
         for sweep in range(self.n_sweeps):
             # Manual span, not ``with`` (the inner loop body is long): on a
@@ -253,6 +265,14 @@ class CoordinateDescent:
                     )
                 step += 1
             sweep_span.__exit__(None, None, None)
+            # Arm after the first sweep that executed EVERY coordinate step
+            # (a resumed run's first sweep may be partial, leaving later
+            # coordinates' shapes uncompiled — warming then would turn their
+            # legitimate first compiles into false retrace alarms).
+            first_full = (0 if resumed_pos is None else resumed_pos[0] + 1)
+            if sweep == first_full:
+                for k in _retrace.RE_SOLVER_KERNELS:
+                    _retrace.mark_warm(k)
 
         final = best_models if best_models is not None else models
         return GameModel(dict(final)), tracker

@@ -45,6 +45,30 @@ treatment), no normalization context, dense buffers within
 ``PHOTON_RE_NEWTON_BUDGET_MB``. Everything else falls back to the general
 vmapped path; ``PHOTON_RE_NEWTON=0`` forces the fallback.
 
+**Entity sub-batching** (``fit_bucket_in_chunks``): the per-entity solves
+are embarrassingly parallel over the entity axis, so a bucket whose
+``[E,P]``/``[E,S]`` probe footprint exceeds the budget gate no longer
+surrenders to the vmapped L-BFGS fallback — it is split into entity chunks
+drawn from a small CLOSED ladder of blessed sizes (``chunk_ladder()``),
+each chunk solved through the same jitted kernel (one XLA compile per
+ladder size, so the retrace sentinel stays quiet across sweeps), and the
+results restacked. The last partial chunk is padded with inert lanes
+(weight-0 rows, ghost columns, mask 1, precision-0 priors) — the same
+convention as ``_pad_bucket`` — so chunking never adds compiled shapes
+beyond the ladder. Chunking also *decouples convergence*: each chunk's
+``while_loop`` stops when ITS slowest lane converges, instead of every
+entity in the bucket iterating until the bucket-wide straggler is done.
+
+**CPU/TPU kernel shape discipline**: the hot contractions (Gram build,
+Hessian assembly) are written as explicit batched ``matmul``s over
+``optimization_barrier``-materialized operands. Measured on the CPU
+backend at the ``game_scale`` bench shape ([100K,16,256]): letting XLA
+fuse the scatter/scale producers into the dot turns a 1.4 s batched GEMM
+into a 9 s fused loop — the barrier forces the operands into contiguous
+buffers the fast GEMM path can consume. Newton systems are solved via
+batched Cholesky (the damped Hessian is symmetric PD by construction),
+which halves the per-iteration factorization cost vs generic LU.
+
 Parity: reference ⟦RandomEffectCoordinate.scala⟧ + ⟦SingleNodeOptimizationProblem⟧
 (SURVEY.md §3.5) run one Breeze L-BFGS per entity; these solvers reach the
 same optimum of the same objective, re-shaped for a batched accelerator.
@@ -74,8 +98,32 @@ Array = jax.Array
 NEWTON_MAX_P = 64           # [P,P] solves stay tiny; beyond this, fall back
                             # (documented gate: module doc, docs/scaling.md,
                             # docs/round5.md all say P <= 64 — keep in sync)
+NEWTON_CHUNK_MAX_P = 128    # wider P admitted for CHUNKED primal candidates
+                            # under MEASURED routing only — at P in (64,128]
+                            # the dense Hessian may or may not beat L-BFGS
+                            # depending on S, so the calibration race (not a
+                            # static gate) decides (game/solver_routing.py)
 DUAL_MAX_T = 80  # S + U cap; beyond this the (S+U)^2 systems stop being tiny
 _DEFAULT_BUDGET_MB = 2048   # dense X + H + probe buffers cap
+
+# Blessed entity-chunk sizes for sub-batched solves. A CLOSED set on
+# purpose: every chunked solve compiles at one of these sizes (last chunk
+# padded up), so the number of XLA executables per (solver, S, P, dtype)
+# class is bounded by the ladder length and the retrace sentinel stays
+# quiet across sweeps. Override: PHOTON_RE_CHUNK_LADDER=256,1024,...
+_DEFAULT_CHUNK_LADDER = (256, 1024, 4096, 16384)
+
+
+def chunk_ladder() -> tuple:
+    raw = os.environ.get("PHOTON_RE_CHUNK_LADDER", "")
+    if raw:
+        sizes = tuple(sorted({int(x) for x in raw.split(",") if x.strip()}))
+        if not sizes or min(sizes) < 1:
+            raise ValueError(
+                f"PHOTON_RE_CHUNK_LADDER must be positive ints, got {raw!r}"
+            )
+        return sizes
+    return _DEFAULT_CHUNK_LADDER
 
 
 def _budget_bytes() -> float:
@@ -122,6 +170,21 @@ def u_max_for(d_pen) -> int:
     return int(jnp.max(jnp.sum(d_pen <= 0.0, axis=1)))
 
 
+def _primal_need_bytes(e: int, s: int, p: int, esize: float) -> float:
+    """Dominant dense buffers of an E-entity primal solve (in the data
+    dtype): X [E,S,P+1], H [E,P,P], and the probe batch's [L,E,S] margins +
+    [L,E,S] loss temporary + [L,E,P] trial parameters (L capped at 12)."""
+    return esize * (e * s * (p + 1) + e * p * p + 12 * e * (2 * s + p))
+
+
+def _dual_need_bytes(e: int, s: int, p: int, u: int, esize: float) -> float:
+    """Dominant dense buffers of an E-entity dual solve: dense X [E,S,P+1]
+    + G/J [E,S,S+U] + the probe batch's [12,E,S] margins + [12,E,S] loss
+    temporary + [12,E,S+U] trial parameters. Dense X dominates at wide P."""
+    return esize * (e * s * (p + 1) + 2 * e * s * (s + u)
+                    + 12 * e * (2 * s + s + u))
+
+
 def newton_eligible(problem, bucket, normalization) -> bool:
     """True when this bucket's solve may take the PRIMAL dense-Newton path."""
     if os.environ.get("PHOTON_RE_NEWTON", "") == "dual":
@@ -132,12 +195,65 @@ def newton_eligible(problem, bucket, normalization) -> bool:
     p = bucket.local_dim
     if p > NEWTON_MAX_P:
         return False
-    # Dominant dense buffers (solvers run in the data dtype): X [E,S,P+1],
-    # H [E,P,P], and the probe batch's [L,E,S] margins + [L,E,S] loss
-    # temporary + [L,E,P] trial parameters (L capped at 12).
     esize = float(np.dtype(bucket.val.dtype).itemsize)
-    need = esize * (e * s * (p + 1) + e * p * p + 12 * e * (2 * s + p))
-    return need <= _budget_bytes()
+    return _primal_need_bytes(e, s, p, esize) <= _budget_bytes()
+
+
+def _largest_fitting_chunk(need_at, e: int):
+    """Best blessed chunk size for an E-entity bucket, or None when even
+    the smallest ladder size busts the budget. Padding lanes do FULL
+    solver work, so a 2000-entity bucket should solve as 2x1024, not one
+    4096-padded chunk — but shaving the last few padding percent is not
+    worth an order of magnitude more dispatches (100K entities at chunk
+    256 is 391 kernel calls). Rule: the LARGEST budget-fitting size whose
+    total padded lanes ``ceil(E/C)*C`` stay within 12.5% of E; if none
+    qualifies (tiny buckets), the size minimizing padded lanes."""
+    budget = _budget_bytes()
+    fitting = []
+    for c in chunk_ladder():
+        if need_at(c) > budget:
+            break  # ladder is sorted: larger sizes only need more
+        fitting.append(c)
+        if c >= e:
+            break  # larger sizes only add padding
+    if not fitting:
+        return None
+    for c in reversed(fitting):
+        if -(-e // c) * c <= e + (e >> 3):
+            return c
+    return min(fitting, key=lambda c: (-(-e // c) * c, -c))
+
+
+def newton_chunk_size(problem, bucket, normalization,
+                      max_p: int = NEWTON_MAX_P):
+    """Blessed chunk size for an entity-sub-batched PRIMAL solve of this
+    bucket, or None when the primal path is shape-excluded or even the
+    smallest chunk busts the budget. ``max_p`` lets MEASURED routing admit
+    wider subspaces (NEWTON_CHUNK_MAX_P) than the static gate."""
+    if os.environ.get("PHOTON_RE_NEWTON", "") == "dual":
+        return None
+    if not _smooth_ok(problem, normalization):
+        return None
+    e, s, _ = bucket.idx.shape
+    p = bucket.local_dim
+    if p > max_p:
+        return None
+    esize = float(np.dtype(bucket.val.dtype).itemsize)
+    return _largest_fitting_chunk(
+        lambda c: _primal_need_bytes(c, s, p, esize), e)
+
+
+def dual_chunk_size(problem, bucket, normalization, u_max: int):
+    """Blessed chunk size for an entity-sub-batched DUAL solve, or None."""
+    if not dual_precheck(problem, bucket, normalization):
+        return None
+    e, s, _ = bucket.idx.shape
+    p = bucket.local_dim
+    if s + u_max > DUAL_MAX_T:
+        return None
+    esize = float(np.dtype(bucket.val.dtype).itemsize)
+    return _largest_fitting_chunk(
+        lambda c: _dual_need_bytes(c, s, p, u_max, esize), e)
 
 
 def dual_precheck(problem, bucket, normalization) -> bool:
@@ -166,13 +282,8 @@ def dual_eligible(problem, bucket, normalization, u_max: int) -> bool:
     p = bucket.local_dim
     if s + u_max > DUAL_MAX_T:
         return False
-    # Dominant buffers (in the data dtype): dense X [E,S,P+1] + G/J
-    # [E,S,S+U] + the probe batch's [12,E,S] margins + [12,E,S] loss
-    # temporary + [12,E,S+U] trial parameters. Dense X dominates at wide P.
     esize = float(np.dtype(bucket.val.dtype).itemsize)
-    need = esize * (e * s * (p + 1) + 2 * e * s * (s + u_max)
-                    + 12 * e * (2 * s + s + u_max))
-    return need <= _budget_bytes()
+    return _dual_need_bytes(e, s, p, u_max, esize) <= _budget_bytes()
 
 
 def _dense_design(batches, dtype):
@@ -187,6 +298,10 @@ def _dense_design(batches, dtype):
     ei = jnp.arange(e)[:, None, None]
     si = jnp.arange(s)[None, :, None]
     x_ext = jnp.zeros((e, s, p + 1), dtype).at[ei, si, idx].add(val)
+    # Materialization boundary: without it XLA fuses the scatter into every
+    # downstream dot, and the batched GEMMs degrade to a scalar loop
+    # (measured 6x slower at the game_scale shape on CPU — module doc).
+    x_ext = jax.lax.optimization_barrier(x_ext)
     return (
         x_ext,
         batches.labels.astype(dtype),
@@ -247,13 +362,27 @@ def _newton_loop(x0, z0, cfg, value_at, grad_at, hess_at, lin_map,
 
         h = hess_at(x, z)
         scale = 1.0 + jax.vmap(jnp.trace)(h) / t_dim
-        d = -jnp.linalg.solve(
-            h + (ridge * scale)[:, None, None] * eye, g[..., None]
-        )[..., 0]
+        h_damped = h + (ridge * scale)[:, None, None] * eye
+        # The damped Hessian is symmetric PD by construction, so a batched
+        # Cholesky halves the factorization cost vs generic LU (measured
+        # 2x on the [E,17,17] dual systems, CPU backend). Under --debug-nans
+        # take LU instead: a lane whose Hessian lost PD to rounding makes
+        # Cholesky EMIT NaN by design (caught by the fallback below), which
+        # debug_nans would escalate to FloatingPointError on an otherwise
+        # healthy run — LU returns a finite non-descent direction the same
+        # guard handles. Trace-time read: the flag is process-static.
+        if jax.config.jax_debug_nans:
+            d = -jnp.linalg.solve(h_damped, g[..., None])[..., 0]
+        else:
+            chol = jnp.linalg.cholesky(h_damped)
+            d = -jax.scipy.linalg.cho_solve(
+                (chol, True), g[..., None])[..., 0]
         dg = jnp.sum(d * g, axis=1)
-        # H is PD(+ridge) so d is descent; a numerically non-descent lane
-        # falls back to steepest descent (mirrors the L-BFGS restart rule).
-        bad = dg >= 0.0
+        # H is PD(+ridge) so d is descent; a numerically non-descent lane —
+        # including a failed factorization (NaN Cholesky of a lane whose
+        # Hessian lost PD to rounding) — falls back to steepest descent
+        # (mirrors the L-BFGS restart rule).
+        bad = (dg >= 0.0) | ~jnp.isfinite(dg)
         d = jnp.where(bad[:, None], -g, d)
         dg = jnp.where(bad, -jnp.sum(g * g, axis=1), dg)
 
@@ -317,7 +446,10 @@ def fit_bucket_newton(problem, batches, w0, local_mask, local_prior):
     dt = w0.dtype
     loss = loss_for_task(problem.task)
     x_ext, y, off, tw = _dense_design(batches, dt)
-    x = x_ext[..., : batches.features.dim]
+    # Contiguous copy of the ghost-stripped design: the batched GEMMs below
+    # need a materialized operand, not a strided slice fused per-element.
+    x = jax.lax.optimization_barrier(x_ext[..., : batches.features.dim])
+    xt = jnp.swapaxes(x, 1, 2)                              # [E, P, S]
     l2v, pm, pp, _ = penalty_terms(problem, local_mask, local_prior, dt)
 
     def value_at(w, z):
@@ -329,15 +461,19 @@ def fit_bucket_newton(problem, batches, w0, local_mask, local_prior):
 
     def grad_at(w, z):
         d1 = tw * loss.d1(z, y)
-        return jnp.einsum("es,esp->ep", d1, x) + l2v * w + pp * (w - pm)
+        return (jnp.matmul(d1[:, None, :], x)[:, 0]
+                + l2v * w + pp * (w - pm))
 
     def hess_at(w, z):
         d2 = tw * loss.d2(z, y)
-        h = jnp.einsum("es,esp,esq->epq", d2, x, x)
+        # Xᵀ diag(d2) X as one batched GEMM over a materialized weighted
+        # design (barrier: keep XLA from re-fusing the scale into the dot).
+        xw = jax.lax.optimization_barrier(x * d2[..., None])
+        h = jnp.matmul(xt, xw)
         return h + jax.vmap(jnp.diag)(l2v + pp)
 
     def lin_map(d):
-        return jnp.einsum("esp,ep->es", x, d)
+        return jnp.matmul(x, d[..., None])[..., 0]
 
     def probe_values(w, z, d, zd, ts):
         zt = z[None] + ts[:, None, None] * zd[None]            # [L, E, S]
@@ -410,7 +546,8 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
     x_ext, y, off, tw = _dense_design(batches, dt)
     e, s, _ = x_ext.shape
     p = batches.features.dim
-    x = x_ext[..., :p]
+    # Contiguous ghost-stripped design for the batched GEMMs (module doc).
+    x = jax.lax.optimization_barrier(x_ext[..., :p])
 
     _, pm, pp, d_pen = penalty_terms(problem, local_mask, local_prior, dt)
     d_pinv = jnp.where(d_pen > 0.0, 1.0 / jnp.maximum(d_pen, 1e-30), 0.0)
@@ -432,14 +569,25 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
         u_idx = jnp.zeros((e, 0), jnp.int32)
         x_u = jnp.zeros((e, s, 0), dt)
 
-    xd = x * d_pinv[:, None, :]                            # X·D⁺  [E,S,P]
-    gram = jnp.einsum("esp,etp->est", xd, x)               # G = XD⁺Xᵀ [E,S,S]
-    j_mat = jnp.concatenate([gram, x_u], axis=2)           # [E, S, T]
-    z0 = off + jnp.einsum("esp,ep->es", xd, q)             # margins at θ=0
-    # Primal-objective constant: reg(w(θ)) = ½αᵀGα + c_reg (module doc).
-    c_reg = 0.5 * jnp.sum(pp * pm * pm, axis=1) - 0.5 * jnp.sum(
-        d_pinv * q * q, axis=1
+    xd = jax.lax.optimization_barrier(
+        x * d_pinv[:, None, :]                             # X·D⁺  [E,S,P]
     )
+    gram = jnp.matmul(xd, jnp.swapaxes(x, 1, 2))           # G = XD⁺Xᵀ [E,S,S]
+    j_mat = jax.lax.optimization_barrier(
+        jnp.concatenate([gram, x_u], axis=2)               # [E, S, T]
+    )
+    j_t = jnp.swapaxes(j_mat, 1, 2)                        # [E, T, S]
+    if local_prior is None:
+        # q ≡ 0: the θ=0 margins are just the offsets and the primal
+        # regularization constant vanishes — skip two [E,S,P] matvecs.
+        z0 = off
+        c_reg = jnp.zeros((e,), dt)
+    else:
+        z0 = off + jnp.matmul(xd, q[..., None])[..., 0]    # margins at θ=0
+        # Primal-objective constant: reg(w(θ)) = ½αᵀGα + c_reg (module doc).
+        c_reg = 0.5 * jnp.sum(pp * pm * pm, axis=1) - 0.5 * jnp.sum(
+            d_pinv * q * q, axis=1
+        )
 
     def ga_of(alpha):
         return jnp.einsum("est,...et->...es", gram, alpha)
@@ -451,16 +599,18 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
 
     def grad_at(theta, z):
         d1 = tw * loss.d1(z, y)
-        g = jnp.einsum("es,est->et", d1, j_mat)
+        g = jnp.matmul(d1[:, None, :], j_mat)[:, 0]
         return g.at[:, :s].add(ga_of(theta[:, :s]))
 
     def hess_at(theta, z):
         d2 = tw * loss.d2(z, y)
-        h = jnp.einsum("es,est,esu->etu", d2, j_mat, j_mat)
+        # Jᵀ diag(d2) J as one batched GEMM (barrier: module doc).
+        jw = jax.lax.optimization_barrier(j_mat * d2[..., None])
+        h = jnp.matmul(j_t, jw)
         return h.at[:, :s, :s].add(gram)
 
     def lin_map(d):
-        return jnp.einsum("est,et->es", j_mat, d)
+        return jnp.matmul(j_mat, d[..., None])[..., 0]
 
     def probe_values(theta, z, d, zd, ts):
         zt = z[None] + ts[:, None, None] * zd[None]          # [L, E, S]
@@ -481,7 +631,7 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
 
     # Recover primal coefficients: w = D⁺(Xᵀα + q) + scatter(β at u_idx).
     alpha, beta = theta[:, :s], theta[:, s:]
-    w = d_pinv * (jnp.einsum("esp,es->ep", x, alpha) + q)
+    w = d_pinv * (jnp.matmul(alpha[:, None, :], x)[:, 0] + q)
     if u_max > 0:
         w_full = jnp.concatenate([w, jnp.zeros((e, 1), dt)], axis=1)
         w_full = w_full.at[jnp.arange(e)[:, None], u_idx].add(beta)
@@ -489,9 +639,9 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
 
     # Primal gradient norm for the reported result (θ-space norms steer
     # the loop; the artifact-facing number matches the other solvers).
-    z_w = off + jnp.einsum("esp,ep->es", x, w)
+    z_w = off + jnp.matmul(x, w[..., None])[..., 0]
     d1 = tw * loss.d1(z_w, y)
-    g_primal = jnp.einsum("es,esp->ep", d1, x) + d_pen * w - q
+    g_primal = jnp.matmul(d1[:, None, :], x)[:, 0] + d_pen * w - q
 
     variances = None
     if problem.variance_type == VarianceComputationType.SIMPLE:
@@ -514,3 +664,72 @@ def fit_bucket_newton_dual(problem, batches, w0, local_mask, local_prior,
         problem.task,
     )
     return model, result
+
+
+# ------------------------------------------------------- entity sub-batching
+
+
+def _slice_pad_batches(batches, lo: int, hi: int, chunk: int):
+    """``batches[lo:hi]`` padded on the entity axis to exactly ``chunk``
+    lanes. Padding lanes are inert by the same convention as
+    ``_pad_bucket``: ghost feature columns (== local dim, dropped by the
+    dense scatter), value/label/offset 0, weight 0."""
+    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+
+    f = batches.features
+
+    def pz(a, fill=0):
+        return _slice_pad_lanes(a, lo, hi, chunk, fill)
+
+    return LabeledBatch(
+        features=SparseFeatures(idx=pz(f.idx, f.dim), val=pz(f.val),
+                                dim=f.dim),
+        labels=pz(batches.labels),
+        offsets=pz(batches.offsets),
+        weights=pz(batches.weights),
+    )
+
+
+def _slice_pad_lanes(a, lo: int, hi: int, chunk: int, fill=0):
+    """One [E, ...] per-entity leaf sliced and padded to ``chunk`` lanes."""
+    a = a[lo:hi]
+    pad = chunk - (hi - lo)
+    if pad:
+        widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        a = jnp.pad(a, widths, constant_values=fill)
+    return a
+
+
+def fit_bucket_in_chunks(fit_one, chunk: int, batches, w0, local_mask,
+                         local_prior):
+    """Solve one bucket in entity chunks of a blessed size and restack.
+
+    ``fit_one(batches, w0, local_mask, local_prior) -> (model, result)`` is
+    a closure over the solver + its static arguments (problem, u_max, ...).
+    Every chunk — including the padded tail — has EXACTLY ``chunk`` lanes,
+    so the underlying jitted kernel compiles once per ladder size and the
+    retrace sentinel stays quiet across sweeps. Padded lanes carry weight-0
+    rows, mask 1 (so the ridge keeps their Hessians PD), and precision-0
+    priors; they converge at the zero model on the first iteration and are
+    sliced away before the restack.
+    """
+    e = w0.shape[0]
+    outs = []
+    for lo in range(0, e, chunk):
+        hi = min(lo + chunk, e)
+        sl_prior = (
+            jax.tree.map(lambda a: _slice_pad_lanes(a, lo, hi, chunk),
+                         local_prior)
+            if local_prior is not None else None
+        )
+        model, result = fit_one(
+            _slice_pad_batches(batches, lo, hi, chunk),
+            _slice_pad_lanes(w0, lo, hi, chunk),
+            _slice_pad_lanes(local_mask, lo, hi, chunk, fill=1),
+            sl_prior,
+        )
+        n = hi - lo
+        outs.append(jax.tree.map(lambda a: a[:n], (model, result)))
+    if len(outs) == 1:
+        return outs[0]
+    return jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *outs)

@@ -35,6 +35,8 @@ from photon_tpu.obs import trace as _trace
 from photon_tpu.obs.metrics import REGISTRY, MetricsRegistry
 
 __all__ = [
+    "RE_SOLVER_KERNELS",
+    "compile_watch",
     "expected_compiles",
     "note_trace",
     "mark_warm",
@@ -45,6 +47,15 @@ __all__ = [
     "reset",
     "install_device_memory_gauges",
 ]
+
+# The registered random-effect bucket-solver kernels (game/newton_re.py +
+# game/random_effect.py). One place so the compile/solve timing split, the
+# descent-loop warmup marking, and the tests all watch the same names.
+RE_SOLVER_KERNELS = (
+    "fit_bucket_newton",
+    "fit_bucket_newton_dual",
+    "fit_bucket_vmapped",
+)
 
 logger = logging.getLogger("photon_tpu.obs")
 
@@ -79,6 +90,51 @@ class expected_compiles:
 
     def __exit__(self, *exc) -> None:
         _tls.expected -= 1
+
+
+class compile_watch:
+    """``with compile_watch() as cw: out = jitted(...)`` — split first-trace
+    compile time from solve time via the sentinel's trace counters.
+
+    Wrap the UNSYNCED dispatch only: jit tracing + XLA compilation run
+    synchronously in the calling thread before dispatch returns, while
+    execution is enqueued asynchronously — so when ``cw.compiled`` is
+    non-empty the dispatch wall time is (to enqueue overhead, microseconds)
+    the compile time, and when it is empty the wall time is pure dispatch.
+    This is how ``train_random_effects`` stamps ``compile_seconds`` into
+    ``LAST_BUCKET_TIMINGS`` / bench artifacts / trace spans WITHOUT the two
+    blocking device syncs per bucket that full timing mode needs.
+
+    ``cw.seconds`` — dispatch wall. ``cw.compiled`` — {kernel: new traces}
+    for watched kernels that compiled inside the block. ``cw.compile_seconds``
+    — ``seconds`` if anything compiled, else 0.0.
+    """
+
+    def __init__(self, kernels=RE_SOLVER_KERNELS) -> None:
+        self.kernels = tuple(kernels)
+        self.seconds = 0.0
+        self.compiled: dict = {}
+
+    def __enter__(self) -> "compile_watch":
+        import time as _time
+
+        self._before = {k: int(_TRACES.value(kernel=k)) for k in self.kernels}
+        self._t0 = _time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        import time as _time
+
+        self.seconds = _time.perf_counter() - self._t0
+        self.compiled = {
+            k: int(_TRACES.value(kernel=k)) - b
+            for k, b in self._before.items()
+            if int(_TRACES.value(kernel=k)) > b
+        }
+
+    @property
+    def compile_seconds(self) -> float:
+        return self.seconds if self.compiled else 0.0
 
 
 def note_trace(kernel: str) -> None:

@@ -60,7 +60,127 @@ __all__ = [
     "PeerReport",
     "PeerWatchdog",
     "WATCHDOG_EXIT_CODE",
+    "MapCountWatchdog",
+    "clear_executable_caches",
+    "install_map_count_gauge",
 ]
+
+
+# --------------------------------------------------- executable-cache bound
+#
+# jax's per-process executable caches hold mmap'd JIT code pages that are
+# never released in-process; a long-lived driver compiling many distinct
+# shapes (λ-sweep × bucketed RE shapes × restarts, or the autopilot looping
+# bench stages) creeps toward ``vm.max_map_count``, at which point LLVM's
+# code-page mmap ENOMEMs and jaxlib SEGFAULTS instead of raising — the
+# round-5 1-in-2 suite crash, which conftest.py bounds for pytest ONLY
+# (VERDICT r5 weak #5). These are the production-process equivalents: a
+# watchdog that warns while there is still headroom to act, and an explicit
+# cache-clear for config/λ boundaries where no live computation references
+# the old executables.
+
+
+class MapCountWatchdog:
+    """Warn when this process's memory-map count nears ``vm.max_map_count``.
+
+    ``check()`` reads ``/proc/self/maps`` (cheap: one readlines pass) and
+    logs a loud warning once the used fraction crosses ``warn_fraction``
+    (default 0.5 — half the budget gone means the next few thousand
+    compiles are a countdown to a segfault, not an exception). Re-warns at
+    most every ``rewarn_seconds`` and only while above the threshold, so a
+    heartbeat-driven caller can check every beat for free. On platforms
+    without procfs, ``check()`` reports ``maps=-1`` and never warns.
+    """
+
+    #: Linux default when /proc/sys/vm/max_map_count is unreadable.
+    DEFAULT_MAX_MAP_COUNT = 65530
+
+    def __init__(self, warn_fraction: float = 0.5,
+                 rewarn_seconds: float = 300.0):
+        if not 0.0 < warn_fraction <= 1.0:
+            raise ValueError(f"warn_fraction must be in (0, 1], got "
+                             f"{warn_fraction}")
+        self.warn_fraction = warn_fraction
+        self.rewarn_seconds = rewarn_seconds
+        self._last_warn = 0.0
+
+    @staticmethod
+    def map_count() -> int:
+        """Live memory-map count of this process, or -1 without procfs."""
+        try:
+            with open("/proc/self/maps", "rb") as f:
+                return sum(1 for _ in f)
+        except OSError:
+            return -1
+
+    @staticmethod
+    def map_limit() -> int:
+        try:
+            with open("/proc/sys/vm/max_map_count") as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return MapCountWatchdog.DEFAULT_MAX_MAP_COUNT
+
+    def check(self) -> dict:
+        """One watchdog pass: ``{maps, limit, fraction, warned}``."""
+        import logging
+
+        maps = self.map_count()
+        limit = self.map_limit()
+        frac = (maps / limit) if (maps >= 0 and limit > 0) else 0.0
+        warned = False
+        now = time.monotonic()
+        if frac >= self.warn_fraction and (
+            now - self._last_warn >= self.rewarn_seconds
+        ):
+            self._last_warn = now
+            warned = True
+            logging.getLogger("photon_tpu.supervisor").warning(
+                "memory-map count %d is %.0f%% of vm.max_map_count=%d — "
+                "compiled-executable mmap growth is heading for an "
+                "un-catchable jaxlib segfault (ENOMEM in LLVM's code-page "
+                "mmap). Clear caches at the next config/λ boundary "
+                "(supervisor.clear_executable_caches) or raise the sysctl.",
+                maps, 100.0 * frac, limit,
+            )
+        return {"maps": maps, "limit": limit, "fraction": round(frac, 4),
+                "warned": warned}
+
+
+def install_map_count_gauge() -> None:
+    """Register ``process_memory_maps`` callback gauge (idempotent)."""
+    from photon_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.gauge_fn(
+        "process_memory_maps",
+        lambda: float(max(MapCountWatchdog.map_count(), 0)),
+        "Live /proc/self/maps count (vm.max_map_count budget for mmap'd "
+        "JIT code pages; see supervisor.MapCountWatchdog)",
+    )
+
+
+def clear_executable_caches(reason: str = "") -> None:
+    """Drop jax's compiled-executable caches (and the retrace sentinel's
+    warm state, so the recompiles that follow are expected, not alarms).
+
+    Call ONLY at config/λ boundaries — points where no live computation
+    references the old executables and the next program is a different
+    static configuration anyway, so the recompile was going to happen
+    regardless and the mmap'd code pages of the previous config are pure
+    map-count growth.
+    """
+    import logging
+
+    import jax
+
+    from photon_tpu.obs import retrace
+
+    jax.clear_caches()
+    retrace.clear_warm()
+    logging.getLogger("photon_tpu.supervisor").info(
+        "cleared jax executable caches%s (map count now %d)",
+        f" ({reason})" if reason else "", MapCountWatchdog.map_count(),
+    )
 
 
 def _default_retryable() -> tuple:
@@ -310,6 +430,13 @@ class Heartbeat:
             return self
         self.beat_once()
         self._stop = threading.Event()
+        # Executable-cache growth watch rides the liveness loop: every
+        # long-lived training process already heartbeats, so the map-count
+        # check (one /proc read) costs nothing extra and warns from the
+        # same thread that survives a wedged main thread. The gauge makes
+        # the same number scrapeable wherever /metrics is served.
+        map_watch = MapCountWatchdog()
+        install_map_count_gauge()
 
         def loop():
             while not self._stop.wait(self.interval_seconds):
@@ -317,6 +444,7 @@ class Heartbeat:
                     self.beat_once()
                 except OSError:
                     pass  # shared fs hiccup; next beat retries
+                map_watch.check()
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -233,6 +233,13 @@ def test_heartbeat_background_thread(tmp_path):
     assert payload["process_id"] == 7
     assert payload["beats"] >= 2
     assert hb.check_peers([7], max_age_seconds=30.0).healthy
+    # Starting the heartbeat installs the map-count gauge so the same
+    # number the watchdog warns on is scrapeable from /metrics.
+    from photon_tpu.obs.metrics import REGISTRY
+
+    gauge = REGISTRY.gauge_fn("process_memory_maps", lambda: 0.0)
+    series = gauge.collect()  # callback gauge reads live /proc/self/maps
+    assert series and series[0][1] > 0
 
 
 def test_driver_fails_fast_on_dead_peer(tmp_path, monkeypatch):
@@ -531,3 +538,67 @@ def test_injected_heartbeat_outage_reads_as_dead_peer(tmp_path):
     me.beat_once()
     report = me.check_peers([0, 1], max_age_seconds=1.0)
     assert report.dead == [1] and report.alive == [0]
+
+
+# ------------------------------------------------ executable-cache watchdog
+
+
+def test_map_count_watchdog_reads_live_process():
+    from photon_tpu.supervisor import MapCountWatchdog
+
+    wd = MapCountWatchdog()
+    out = wd.check()
+    assert set(out) == {"maps", "limit", "fraction", "warned"}
+    # this very process has mapped libraries, so procfs platforms report a
+    # real count; non-procfs platforms report the documented -1 sentinel
+    assert out["maps"] == -1 or out["maps"] > 10
+    assert out["limit"] > 0
+
+
+def test_map_count_watchdog_warns_over_threshold(monkeypatch, caplog):
+    import logging
+
+    from photon_tpu.supervisor import MapCountWatchdog
+
+    monkeypatch.setattr(MapCountWatchdog, "map_count",
+                        staticmethod(lambda: 40_000))
+    monkeypatch.setattr(MapCountWatchdog, "map_limit",
+                        staticmethod(lambda: 65_530))
+    wd = MapCountWatchdog(warn_fraction=0.5, rewarn_seconds=3600.0)
+    with caplog.at_level(logging.WARNING, logger="photon_tpu.supervisor"):
+        first = wd.check()
+        second = wd.check()            # throttled: no second warning yet
+    assert first["warned"] and 0.60 < first["fraction"] < 0.62
+    assert not second["warned"]
+    assert sum("vm.max_map_count" in r.message for r in caplog.records) == 1
+
+    # below threshold: never warns, and the throttle clock is irrelevant
+    monkeypatch.setattr(MapCountWatchdog, "map_count",
+                        staticmethod(lambda: 10))
+    wd2 = MapCountWatchdog(warn_fraction=0.5, rewarn_seconds=0.0)
+    assert not wd2.check()["warned"]
+
+
+def test_map_count_watchdog_rejects_bad_fraction():
+    from photon_tpu.supervisor import MapCountWatchdog
+
+    with pytest.raises(ValueError):
+        MapCountWatchdog(warn_fraction=0.0)
+
+
+def test_clear_executable_caches_resets_warm_state():
+    """The λ-boundary clear must also forget retrace warm marks — the next
+    config's first compiles are expected, not alarms."""
+    from photon_tpu.obs import retrace
+    from photon_tpu.supervisor import clear_executable_caches
+
+    kernel = "fit_bucket_newton"
+    before = retrace.retraces_after_warmup(kernel)  # counters are
+    retrace.mark_warm(kernel)                       # process-global: delta
+    clear_executable_caches("test")
+    retrace.note_trace(kernel)  # would count as a retrace if still warm
+    assert retrace.retraces_after_warmup(kernel) == before
+    retrace.mark_warm(kernel)
+    retrace.note_trace(kernel)  # sanity: warm marks do count
+    assert retrace.retraces_after_warmup(kernel) == before + 1
+    retrace.clear_warm(kernel)
