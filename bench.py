@@ -61,6 +61,11 @@ if SMOKE:
 
 BACKEND_FALLBACK = None  # set when the accelerator probe fails (see below)
 
+# Parsed --slo-config / PHOTON_SLO_CONFIG (obs.analysis.slo.SloConfig):
+# judged against the live serve-stage snapshot and, at end of run, the
+# details artifact. None = no SLO judgment.
+SLO_CONFIG = None
+
 # Probe-verdict cache (VERDICT round-3 weak #7): a wedged chip makes every
 # probe burn the full timeout before falling back. Cache FAILURE verdicts
 # (only failures — a healthy chip must be re-probed so a fresh wedge is
@@ -964,6 +969,17 @@ def bench_serve():
             conn.close()
         deg_snap = server.metrics_snapshot()
         breaker = deg_snap["breakers"].get("perUser", {})
+        # SLO judgment against the LIVE snapshot, tracing active (the
+        # pass/fail instants belong in the --trace-out timeline; the
+        # violation counter lands in the global registry either way).
+        slo_metrics = {}
+        if SLO_CONFIG is not None:
+            slo_report = SLO_CONFIG.evaluate(deg_snap, where="bench.serve")
+            slo_metrics = {
+                "serve_slo_checked": slo_report.checked,
+                "serve_slo_violations": [
+                    r.name for r in slo_report.violations],
+            }
         server.shutdown()
     if worker_errors:
         # A dead worker's rows never reach `lat`; reporting the surviving
@@ -1003,6 +1019,7 @@ def bench_serve():
         "serve_trace_overhead_p50_ms": round(
             (ovh["on"][len(ovh["on"]) // 2]
              - ovh["off"][len(ovh["off"]) // 2]) * 1e3, 3),
+        **slo_metrics,
     }
 
 
@@ -1398,6 +1415,56 @@ def _git_head() -> str:
     return _GIT_HEAD
 
 
+_GIT_SHA = None
+
+
+def _git_sha() -> str:
+    """The actual commit sha (provenance, human-traceable), distinct from
+    the committed-tree fingerprint ``_git_head()`` uses for resume."""
+    global _GIT_SHA
+    if _GIT_SHA is None:
+        import subprocess
+
+        try:
+            p = subprocess.run(
+                ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+                 "rev-parse", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+            )
+            _GIT_SHA = (
+                p.stdout.strip() if p.returncode == 0 and p.stdout.strip()
+                else "unknown"
+            )
+        except Exception:  # noqa: BLE001
+            _GIT_SHA = "unknown"
+    return _GIT_SHA
+
+
+def _provenance(details: dict) -> dict:
+    """Top-level artifact provenance (read back by bench_compare.py for
+    comparability checks): git sha, backend summary, jax version, host."""
+    import socket
+
+    try:
+        import jax
+
+        jax_version = jax.__version__
+    except Exception:  # noqa: BLE001
+        jax_version = "unknown"
+    backends = sorted(set((details.get("stage_backends") or {}).values()))
+    return {
+        "git_sha": _git_sha(),
+        "code_fingerprint": _git_head(),
+        "jax_version": jax_version,
+        "hostname": socket.gethostname(),
+        "backend_summary": {
+            "backend": details.get("backend"),
+            "stage_backends_distinct": backends,
+            "mixed_backends": len(backends) > 1,
+        },
+    }
+
+
 def _load_resume(path: str) -> dict:
     """Prior real-hardware artifact to RESUME from, else {}.
 
@@ -1441,9 +1508,21 @@ def main():
              "Chrome trace-event JSON (docs/observability.md). The serve "
              "stage's headline p50/p99 are ALWAYS measured with tracing "
              "off; its tracing-overhead sub-measurement is separate.")
+    ap.add_argument(
+        "--slo-config",
+        default=os.environ.get("PHOTON_SLO_CONFIG") or None,
+        help="JSON SLO rules (docs/observability.md §SLO) judged against "
+             "the serve stage's live snapshot and the end-of-run details "
+             "artifact; violations bump slo_violations_total and emit "
+             "trace instants (advisory: never fails the bench).")
     # parse_known_args: other flags (--force-probe) are consulted straight
     # from sys.argv by the stages and must keep working.
     bench_args, _ = ap.parse_known_args()
+    if bench_args.slo_config:
+        from photon_tpu.obs.analysis.slo import SloConfig
+
+        global SLO_CONFIG
+        SLO_CONFIG = SloConfig.from_file(bench_args.slo_config)
     if bench_args.trace_out:
         import atexit
 
@@ -1621,6 +1700,7 @@ def main():
             "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
         )
         details["git_head"] = _git_head()  # resume requires same-code match
+        details["provenance"] = _provenance(details)
         details["stage_seconds"] = {k: round(v, 1) for k, v in stage_seconds.items()}
         with open(target, "w") as f:
             json.dump(details, f, indent=2)
@@ -1839,6 +1919,23 @@ def main():
             print(f"bench: stage {name} failed: {e}", file=sys.stderr, flush=True)
         stage_seconds[name] = time.perf_counter() - t0
         flush()
+
+    # End-of-run SLO judgment over the whole artifact (game_scale
+    # throughput floors, retraces-after-warmup == 0 via the global
+    # registry) — rules whose metrics live only in the serve snapshot
+    # were judged there and skip here.
+    if SLO_CONFIG is not None:
+        from photon_tpu.obs.metrics import REGISTRY
+
+        slo_report = SLO_CONFIG.evaluate(
+            {**REGISTRY.snapshot(), **details}, where="bench")
+        details["slo"] = slo_report.to_dict()
+        if not slo_report.ok:
+            print(
+                "bench: SLO violations: "
+                f"{[r.name for r in slo_report.violations]}",
+                file=sys.stderr, flush=True,
+            )
 
     # A bench killed mid-run (stalled compile on a dying tunnel) leaves a
     # partial artifact; the sentinel lets tpu_autopilot tell partial from

@@ -68,6 +68,17 @@ echo "== obs smoke (tracing + Prometheus exposition; docs/observability.md) =="
 # text-format grammar (latency, throughput, queue depth, kernel retraces).
 python scripts/obs_smoke.py
 
+echo "== bench analysis (advisory compare of newest artifacts + doc sync) =="
+# Backend-aware regression gate over the two newest checked-in bench
+# artifacts (docs/observability.md §gate). ADVISORY: verdicts print on
+# every run (same-backend deltas scored, cross-backend pairs marked
+# incomparable per the ROADMAP bench-trajectory caveat) but only a schema
+# error — an artifact the tooling can no longer parse — fails CI. The
+# doc-figure staleness check rides the same stage: generated bench blocks
+# in README/docs must match the newest artifact.
+python scripts/bench_compare.py --newest 2
+python scripts/sync_bench_docs.py --check
+
 echo "== multichip dryrun (8-device mesh: dp, dp x mp, RE, dcn x dp) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 

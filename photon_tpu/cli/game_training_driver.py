@@ -249,11 +249,34 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     from photon_tpu.supervisor import Heartbeat, RestartPolicy, run_with_recovery
 
     heartbeat = None
+    # SLO rules (docs/observability.md §SLO) ride the beat loop when a
+    # config is provided: judged against the global registry snapshot
+    # from the daemon thread, at most once a minute, surviving a wedged
+    # main thread. The heartbeat IS the training driver's evaluation
+    # point, so a config without a heartbeat dir must warn, not go
+    # silent — silence is indistinguishable from "all SLOs passing".
+    slo_watchdog = None
+    slo_path = os.environ.get("PHOTON_SLO_CONFIG")
+    if slo_path and not args.heartbeat_dir:
+        import logging
+
+        logging.getLogger("photon_tpu").warning(
+            "PHOTON_SLO_CONFIG=%s is set but --heartbeat-dir is not: the "
+            "training driver judges SLOs on the heartbeat loop, so this "
+            "run will evaluate none of them", slo_path)
     if args.heartbeat_dir:
+        if slo_path:
+            from photon_tpu.obs.analysis.slo import SloConfig, SloWatchdog
+
+            slo_watchdog = SloWatchdog(
+                SloConfig.from_file(slo_path), min_interval_s=60.0)
         # Short interval: a retry must be able to tell "peer died with me"
         # from "peer is fine", so the staleness window (3x interval) has to
         # fit inside a restart backoff, not dwarf it.
-        heartbeat = Heartbeat(args.heartbeat_dir, interval_seconds=2.0).start()
+        heartbeat = Heartbeat(
+            args.heartbeat_dir, interval_seconds=2.0,
+            slo_watchdog=slo_watchdog,
+        ).start()
 
     def attempt(i: int) -> dict:
         if i > 0 and heartbeat is not None:

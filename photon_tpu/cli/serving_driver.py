@@ -68,6 +68,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="photon.log + serving-metrics.jsonl land here")
     p.add_argument("--metrics-interval", type=float, default=60.0,
                    help="seconds between JSONL metrics snapshots")
+    p.add_argument("--slo-config",
+                   default=os.environ.get("PHOTON_SLO_CONFIG") or None,
+                   help="JSON SLO rules (docs/observability.md §SLO) "
+                        "judged at every metrics flush; violations bump "
+                        "slo_violations_total and emit trace instants")
     from photon_tpu.cli.params import (
         add_compilation_cache_flag,
         add_fault_plan_flag,
@@ -128,6 +133,7 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
         metrics_path=metrics_path,
         metrics_interval_s=args.metrics_interval,
         request_timeout_s=config.request_timeout_s,
+        slo_config=args.slo_config,
     )
     v = registry.current
     logger.info(

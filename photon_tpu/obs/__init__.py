@@ -18,6 +18,13 @@ global):
 
 Both hooks follow ``faults.fault_point``'s cost model: one module-global
 read when inactive, so the instrumentation is always-on in production code.
+
+The CONSUMERS of these artifacts live in ``photon_tpu.obs.analysis``
+(imported on demand, not re-exported here): the trace-timeline analyzer
+(``python -m photon_tpu.obs.analysis``), the backend-aware bench
+regression gate (``scripts/bench_compare.py``), and the declarative SLO
+watchdog (``obs.analysis.slo``) evaluated at serving flushes, supervisor
+heartbeats, and bench end.
 """
 from photon_tpu.obs.metrics import (
     Counter,

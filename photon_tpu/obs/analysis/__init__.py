@@ -1,0 +1,80 @@
+"""Analysis layer over the PR-3 observability artifacts: the consumers.
+
+The instrumentation layer (``photon_tpu/obs/``) emits three artifact
+families — Chrome-trace timelines (``--trace-out``), metrics snapshots
+(JSONL / Prometheus), and bench details (``BENCH_DETAILS*.json``). This
+package turns them into decisions:
+
+* ``timeline``      — span-tree / critical-path / queue-wait / overlap
+  analyzer for trace artifacts; CLI at
+  ``python -m photon_tpu.obs.analysis <trace.json>``.
+* ``artifacts``     — bench-artifact loading + per-metric backend
+  attribution (the comparability rules).
+* ``bench_compare`` — backend-aware regression gate; CLI at
+  ``scripts/bench_compare.py`` (advisory ci.sh stage).
+* ``slo``           — declarative SLO rules evaluated against metrics
+  snapshots (serving flush, supervisor heartbeat, bench end), emitting
+  trace instants and ``slo_violations_total``.
+
+docs/observability.md §"Reading the telemetry" documents all three CLIs
+and schemas.
+"""
+from photon_tpu.obs.analysis.artifacts import (
+    ArtifactError,
+    BenchArtifact,
+    flatten_metrics,
+    load_bench_artifact,
+    load_bench_details,
+    metric_backend,
+    newest_artifacts,
+    normalize_backend,
+)
+from photon_tpu.obs.analysis.bench_compare import (
+    compare_artifacts,
+    compare_pair,
+    format_verdict,
+    metric_direction,
+)
+from photon_tpu.obs.analysis.slo import (
+    SloConfig,
+    SloConfigError,
+    SloReport,
+    SloRule,
+    SloWatchdog,
+)
+from photon_tpu.obs.analysis.timeline import (
+    Span,
+    TimelineReport,
+    TraceParseError,
+    analyze_events,
+    analyze_trace,
+    load_trace,
+    roofline_attribution,
+)
+
+__all__ = [
+    "ArtifactError",
+    "BenchArtifact",
+    "Span",
+    "SloConfig",
+    "SloConfigError",
+    "SloReport",
+    "SloRule",
+    "SloWatchdog",
+    "TimelineReport",
+    "TraceParseError",
+    "analyze_events",
+    "analyze_trace",
+    "compare_artifacts",
+    "compare_pair",
+    "flatten_metrics",
+    "format_verdict",
+    "load_bench_artifact",
+    "load_bench_details",
+    "load_trace",
+    "metric_backend",
+    "metric_direction",
+    "newest_artifacts",
+    "normalize_backend",
+    "roofline_attribution",
+]

@@ -1,0 +1,78 @@
+"""Timeline-analyzer CLI (docs/observability.md §"Reading the telemetry").
+
+    python -m photon_tpu.obs.analysis run-trace.json
+    python -m photon_tpu.obs.analysis bench-trace.json \\
+        --bench BENCH_DETAILS.json --json report.json
+
+Prints the critical-path table, per-layer wall shares, the queue-wait
+breakdown, and the ingest/compute overlap fraction (the measured answer
+to "is ingest still serializing with compute"); ``--bench`` joins the
+bench roofline numbers to name the bottleneck stage. Exit 2 on a
+malformed trace, 0 otherwise (the analyzer reports, it does not gate —
+gating lives in scripts/bench_compare.py and the SLO configs).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from photon_tpu.obs.analysis.artifacts import (
+    ArtifactError,
+    load_bench_details,
+)
+from photon_tpu.obs.analysis.timeline import (
+    TraceParseError,
+    analyze_trace,
+    roofline_attribution,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m photon_tpu.obs.analysis",
+        description="Analyze a --trace-out Chrome-trace artifact.",
+    )
+    ap.add_argument("trace", help="trace JSON written via --trace-out")
+    ap.add_argument("--bench", default=None,
+                    help="bench artifact (BENCH_DETAILS*.json / BENCH_r*."
+                         "json) to join for roofline attribution")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the full report as JSON to this path "
+                         "('-' for stdout)")
+    ap.add_argument("--top", type=int, default=12,
+                    help="critical-path rows to print (default 12)")
+    args = ap.parse_args(argv)
+
+    try:
+        report = analyze_trace(args.trace)
+    except TraceParseError as e:
+        print(f"analysis: schema error: {e}", file=sys.stderr)
+        return 2
+
+    print(report.format_text(top=args.top))
+
+    doc = report.to_dict()
+    if args.bench:
+        try:
+            details = load_bench_details(args.bench)
+        except ArtifactError as e:
+            print(f"analysis: schema error: {e}", file=sys.stderr)
+            return 2
+        attribution = roofline_attribution(report, details)
+        doc["roofline_attribution"] = attribution
+        print("\nroofline attribution:")
+        for k, v in attribution.items():
+            print(f"  {k}: {v}")
+
+    if args.json_out == "-":
+        print(json.dumps(doc, indent=2))
+    elif args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(doc, f, indent=2)
+        print(f"\nreport written to {args.json_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

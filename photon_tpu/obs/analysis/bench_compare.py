@@ -1,0 +1,293 @@
+"""Backend-aware bench regression gate (core; CLI in
+``scripts/bench_compare.py``).
+
+Compares two or more bench artifacts pairwise (oldest→newest in the given
+order) and emits a machine-readable verdict. The one rule the repo's bench
+history demands (ROADMAP "bench trajectory caveat"): **a delta is only a
+delta on one backend.** r2 ran on the accelerator, r3/r5 on CPU fallback —
+diffing them produces a 20× "regression" that is really a hardware swap.
+So every metric resolves its measurement backend
+(``artifacts.metric_backend``) and a pair whose backends differ — or
+cannot be established on either side — is marked ``incomparable`` instead
+of scored.
+
+Per-metric verdicts:
+
+* ``improved`` / ``regressed`` — same backend, relative change beyond the
+  metric's noise threshold, signed by the metric's direction (throughput
+  up = improved, latency up = regressed);
+* ``unchanged``   — same backend, within the noise threshold;
+* ``incomparable``— backends differ or unknown on either side;
+* ``informational`` — no known better-direction (stage wall timings,
+  request counts): delta reported, never scored;
+* ``missing``     — present on one side only.
+
+The pair verdict is ``regressed`` iff any comparable metric regressed;
+the overall verdict aggregates pairs. The CLI is ADVISORY by default
+(exit 0 regardless of verdicts, exit 2 on schema errors) so ci.sh can
+print verdicts on every run without going red over a slow box; ``--strict``
+turns regressions into exit 1 for release gates.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Sequence
+
+from photon_tpu.obs.analysis.artifacts import (
+    BenchArtifact,
+    load_bench_artifact,
+)
+
+__all__ = [
+    "MetricDelta",
+    "PairVerdict",
+    "compare_artifacts",
+    "compare_pair",
+    "metric_direction",
+    "DEFAULT_REL_THRESHOLD",
+    "NOISE_THRESHOLDS",
+]
+
+# Default relative noise threshold: |delta| <= 10% is "unchanged".
+DEFAULT_REL_THRESHOLD = 0.10
+
+# Per-metric overrides where 10% is the wrong noise model: the roofline
+# fraction is a ratio of two same-box measurements (tight), while tail
+# latency and tiny stage timings jitter hard on shared hosts.
+NOISE_THRESHOLDS = {
+    "roofline.fraction_of_roofline": 0.05,
+    "serve_p99_ms": 0.30,
+    "serve_degraded_p99_ms": 0.30,
+    "serve_p50_ms": 0.20,
+    "serve_trace_overhead_p50_ms": 0.50,
+    "vs_modeled_spark_cluster": 0.05,
+    "vs_baseline_1core_raw": 0.05,
+}
+
+_HIGHER_BETTER_SUFFIXES = (
+    "_per_sec", "_rows_per_sec", "_samples_per_sec", "_gbps",
+    "_best_auc", "_mb_per_sec",
+)
+_HIGHER_BETTER_EXACT = (
+    "roofline.fraction_of_roofline", "vs_baseline",
+    "vs_modeled_spark_cluster", "vs_modeled_spark_cluster_live",
+    "vs_baseline_1core_raw",
+)
+_LOWER_BETTER_SUFFIXES = ("_seconds", "_ms", "_p50_ms", "_p99_ms")
+_LOWER_BETTER_EXACT = (
+    "serve_shed", "serve_expired", "serve_breaker_opens",
+)
+# Stage wall timings and run-shape counts: honest numbers, no "better".
+_INFORMATIONAL_PREFIXES = ("stage_seconds.", "tuner_trial")
+_INFORMATIONAL_SUFFIXES = (
+    "_requests", "_users", "_rows", "_n_users", "_trials", "_concurrency",
+    "_host_cores", "_workers", "_nnz_per_row", "bytes_per_pass",
+)
+
+
+def metric_direction(name: str) -> Optional[str]:
+    """'higher' | 'lower' | None (informational)."""
+    if name.startswith(_INFORMATIONAL_PREFIXES) or name.endswith(
+            _INFORMATIONAL_SUFFIXES):
+        return None
+    if name in _HIGHER_BETTER_EXACT or name.endswith(
+            _HIGHER_BETTER_SUFFIXES):
+        return "higher"
+    if name in _LOWER_BETTER_EXACT or name.endswith(_LOWER_BETTER_SUFFIXES):
+        return "lower"
+    if name.endswith("_fraction"):
+        return None  # direction depends on the fraction's meaning
+    return None
+
+
+@dataclasses.dataclass
+class MetricDelta:
+    metric: str
+    old: Optional[float]
+    new: Optional[float]
+    backend_old: str
+    backend_new: str
+    verdict: str                 # improved|regressed|unchanged|incomparable|
+    #                              informational|missing
+    delta_pct: Optional[float] = None
+    threshold_pct: Optional[float] = None
+    direction: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None or k in ("old", "new")}
+
+
+@dataclasses.dataclass
+class PairVerdict:
+    old: str
+    new: str
+    verdict: str                 # ok|regressed|incomparable
+    deltas: list
+    notes: list
+
+    def summary(self) -> dict:
+        out: dict[str, int] = {}
+        for d in self.deltas:
+            out[d.verdict] = out.get(d.verdict, 0) + 1
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "old": self.old,
+            "new": self.new,
+            "verdict": self.verdict,
+            "summary": self.summary(),
+            "notes": self.notes,
+            "metrics": {d.metric: d.to_dict() for d in self.deltas},
+        }
+
+
+def _threshold_for(metric: str, overrides: Optional[Mapping]) -> float:
+    if overrides and metric in overrides:
+        return float(overrides[metric])
+    return NOISE_THRESHOLDS.get(metric, DEFAULT_REL_THRESHOLD)
+
+
+def compare_pair(
+    old: BenchArtifact,
+    new: BenchArtifact,
+    thresholds: Optional[Mapping] = None,
+) -> PairVerdict:
+    om, nm = old.metrics(), new.metrics()
+    deltas: list[MetricDelta] = []
+    notes: list[str] = []
+
+    po, pn = old.provenance, new.provenance
+    for key, label in (("jax_version", "jax version"),
+                       ("hostname", "host")):
+        vo, vn = po.get(key), pn.get(key)
+        if vo and vn and vo != vn:
+            notes.append(
+                f"{label} differs: {vo} (old) vs {vn} (new) — same-backend "
+                f"deltas still reported, but treat absolute levels with "
+                f"care")
+    if not (po.get("hostname") and pn.get("hostname")):
+        # Pre-provenance artifacts can't prove the two runs shared a box;
+        # the ROADMAP trajectory caveat says cross-box absolutes mislead
+        # (the r5→r6 box swap alone was ~11x on the fixed step), so every
+        # verdict on such a pair ships with this warning attached.
+        notes.append(
+            "host provenance missing on "
+            + ("both artifacts" if not (po.get("hostname")
+                                        or pn.get("hostname"))
+               else "one artifact")
+            + " (predates the provenance stamp) — same-backend deltas may "
+              "reflect a host swap, not a code change; prefer same-box "
+              "A/Bs for absolute claims")
+
+    for metric in sorted(set(om) | set(nm)):
+        vo, vn = om.get(metric), nm.get(metric)
+        bo = old.backend_for(metric) if metric in om else "unknown"
+        bn = new.backend_for(metric) if metric in nm else "unknown"
+        if vo is None or vn is None:
+            deltas.append(MetricDelta(
+                metric, vo, vn, bo, bn, "missing"))
+            continue
+        if bo == "unknown" or bn == "unknown" or bo != bn:
+            # A cross-backend (or unplaceable) delta is not a regression
+            # and not an improvement — it is a hardware change.
+            deltas.append(MetricDelta(metric, vo, vn, bo, bn, "incomparable"))
+            continue
+        # delta_pct is None when old == 0 (no relative change exists, and
+        # float('inf') would make the --json verdict invalid JSON); the
+        # change is then scored on the raw difference alone.
+        pct = (vn - vo) / abs(vo) * 100.0 if vo != 0 else None
+        direction = metric_direction(metric)
+        thr = _threshold_for(metric, thresholds)
+        if direction is None:
+            deltas.append(MetricDelta(
+                metric, vo, vn, bo, bn, "informational",
+                delta_pct=round(pct, 2) if pct is not None else None))
+            continue
+        if vn == vo or (pct is not None and abs(pct) <= thr * 100.0):
+            verdict = "unchanged"
+        elif (vn > vo) == (direction == "higher"):
+            verdict = "improved"
+        else:
+            verdict = "regressed"
+        deltas.append(MetricDelta(
+            metric, vo, vn, bo, bn, verdict,
+            delta_pct=round(pct, 2) if pct is not None else None,
+            threshold_pct=round(thr * 100.0, 1),
+            direction=direction))
+
+    scored = [d for d in deltas if d.verdict in
+              ("improved", "regressed", "unchanged")]
+    if any(d.verdict == "regressed" for d in scored):
+        verdict = "regressed"
+    elif scored:
+        verdict = "ok"
+    else:
+        verdict = "incomparable"
+        notes.append(
+            "no metric pair shares an established backend — deltas "
+            "withheld (see ROADMAP bench-trajectory caveat)")
+    return PairVerdict(
+        old=old.name, new=new.name, verdict=verdict, deltas=deltas,
+        notes=notes)
+
+
+def compare_artifacts(
+    paths: Sequence[str],
+    thresholds: Optional[Mapping] = None,
+) -> dict:
+    """Pairwise verdicts over ``paths`` in the given (oldest→newest)
+    order; the machine-readable document ci.sh's advisory stage prints."""
+    arts = [load_bench_artifact(p) for p in paths]
+    pairs = [
+        compare_pair(arts[i], arts[i + 1], thresholds=thresholds)
+        for i in range(len(arts) - 1)
+    ]
+    overall = (
+        "regressed" if any(p.verdict == "regressed" for p in pairs)
+        else "ok" if any(p.verdict == "ok" for p in pairs)
+        else "incomparable" if pairs else "nothing-to-compare"
+    )
+    return {
+        "schema": "photon-bench-compare/1",
+        "artifacts": [
+            {"path": a.path, "round": a.round, "written_at": a.written_at}
+            for a in arts
+        ],
+        "pairs": [p.to_dict() for p in pairs],
+        "overall": overall,
+    }
+
+
+def format_verdict(doc: dict, top: int = 14) -> str:
+    """Human-readable rendering of a compare_artifacts() document."""
+    lines = []
+    for pair in doc["pairs"]:
+        lines.append(f"{pair['old']}  →  {pair['new']}:  "
+                     f"{pair['verdict'].upper()}  {pair['summary']}")
+        for note in pair["notes"]:
+            lines.append(f"  note: {note}")
+        shown = 0
+        for name, d in pair["metrics"].items():
+            if d["verdict"] in ("unchanged", "missing"):
+                continue
+            if shown >= top:
+                lines.append("  ...")
+                break
+            shown += 1
+            if d["verdict"] == "incomparable":
+                lines.append(
+                    f"  {name}: INCOMPARABLE "
+                    f"({d['backend_old']} vs {d['backend_new']}) "
+                    f"[{d['old']} vs {d['new']}]")
+            else:
+                arrow = {"improved": "+", "regressed": "!",
+                         "informational": "."}[d["verdict"]]
+                pct = d.get("delta_pct")
+                lines.append(
+                    f"  {arrow} {name}: {d['old']} → {d['new']} "
+                    + (f"({pct:+.1f}%) " if pct is not None else "")
+                    + d["verdict"])
+    lines.append(f"overall: {doc['overall']}")
+    return "\n".join(lines)

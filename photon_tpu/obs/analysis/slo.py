@@ -1,0 +1,304 @@
+"""Declarative SLOs evaluated against metrics snapshots.
+
+The missing half of the PR-3 metrics layer: counters and histograms are
+exported, but nothing *judges* them. An :class:`SloConfig` is a small JSON
+document of rules; evaluating one against any metrics snapshot (a serving
+``metrics_snapshot()``, the process-global ``REGISTRY.snapshot()``, or the
+bench's details dict) produces a pass/fail report and — the observable
+contract — emits one ``slo.pass`` / ``slo.violation`` instant per rule
+into the active trace and bumps the process-global
+``slo_violations_total{slo=...}`` counter per violation, so SLO state
+rides the same Prometheus scrape and Chrome-trace timeline as everything
+else.
+
+Config schema (docs/observability.md §SLO)::
+
+    {"slos": [
+      {"name": "serve_p99",
+       "metric": "latency.p99_ms",        # dotted path into the snapshot
+       "op": "<=",                        # <=, <, >=, >, ==, !=
+       "threshold": 50.0,
+       "description": "p99 under 50ms",   # optional
+       "on_missing": "skip"}              # or "violate"; default skip
+    ]}
+
+``metric`` paths resolve dict-by-dict; when the resolved value is itself
+a dict (a labeled counter like ``kernel_retraces_after_warmup_total``'s
+per-kernel map, or a histogram snapshot), its numeric leaves are SUMMED —
+so ``{"metric": "kernel_retraces_after_warmup_total", "op": "==",
+"threshold": 0}`` expresses "no retraces after warmup, on any kernel".
+A rule whose metric is absent from the snapshot being evaluated is
+``skipped`` by default (one config can carry serving rules and bench
+rules; each evaluation judges the rules it can see) — set
+``on_missing: "violate"`` for rules where silence is itself a failure.
+
+Evaluation points wired in this PR: the serving server's periodic metrics
+flush + shutdown (``ScoringServer(slo_config=...)``), the supervisor
+heartbeat (:class:`SloWatchdog` riding :class:`supervisor.Heartbeat`),
+and the bench (``--slo-config``: the serve stage evaluates against the
+live server snapshot, the end of the run against the details artifact).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import operator
+import time
+from typing import Callable, Mapping, Optional, Sequence
+
+from photon_tpu.obs.metrics import MetricsRegistry, REGISTRY
+from photon_tpu.obs.trace import instant
+
+__all__ = [
+    "SloConfigError",
+    "SloRule",
+    "SloResult",
+    "SloReport",
+    "SloConfig",
+    "SloWatchdog",
+    "VIOLATIONS_COUNTER",
+]
+
+VIOLATIONS_COUNTER = "slo_violations_total"
+
+_OPS: dict[str, Callable[[float, float], bool]] = {
+    "<=": operator.le,
+    "<": operator.lt,
+    ">=": operator.ge,
+    ">": operator.gt,
+    "==": operator.eq,
+    "!=": operator.ne,
+}
+
+_log = logging.getLogger("photon_tpu.obs.slo")
+
+
+class SloConfigError(ValueError):
+    """The SLO config document violates the schema."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SloRule:
+    name: str
+    metric: str
+    op: str
+    threshold: float
+    description: str = ""
+    on_missing: str = "skip"  # "skip" | "violate"
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "SloRule":
+        if not isinstance(d, Mapping):
+            raise SloConfigError(f"rule must be an object, got {d!r}")
+        missing = [k for k in ("name", "metric", "op", "threshold")
+                   if k not in d]
+        if missing:
+            raise SloConfigError(
+                f"rule {d.get('name', d)!r} missing keys: {missing}")
+        if d["op"] not in _OPS:
+            raise SloConfigError(
+                f"rule {d['name']!r}: unknown op {d['op']!r} "
+                f"(allowed: {sorted(_OPS)})")
+        try:
+            threshold = float(d["threshold"])
+        except (TypeError, ValueError):
+            raise SloConfigError(
+                f"rule {d['name']!r}: threshold {d['threshold']!r} "
+                f"is not a number")
+        on_missing = d.get("on_missing", "skip")
+        if on_missing not in ("skip", "violate"):
+            raise SloConfigError(
+                f"rule {d['name']!r}: on_missing must be 'skip' or "
+                f"'violate', got {on_missing!r}")
+        return cls(
+            name=str(d["name"]), metric=str(d["metric"]), op=str(d["op"]),
+            threshold=threshold, description=str(d.get("description", "")),
+            on_missing=on_missing,
+        )
+
+
+def _resolve(snapshot: Mapping, path: str):
+    """Dotted lookup; dict leaves sum their numeric values; None if the
+    path (or any numeric interpretation of its leaf) is absent."""
+    cur = snapshot
+    for part in path.split("."):
+        if not isinstance(cur, Mapping) or part not in cur:
+            return None
+        cur = cur[part]
+    if isinstance(cur, bool):
+        return float(cur)
+    if isinstance(cur, (int, float)):
+        return float(cur)
+    if isinstance(cur, Mapping):
+        vals = [v for v in cur.values()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)]
+        return float(sum(vals)) if vals else None
+    return None
+
+
+@dataclasses.dataclass
+class SloResult:
+    name: str
+    metric: str
+    op: str
+    threshold: float
+    value: Optional[float]
+    status: str  # "pass" | "violation" | "skipped"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class SloReport:
+    where: str
+    results: list
+
+    @property
+    def violations(self) -> list:
+        return [r for r in self.results if r.status == "violation"]
+
+    @property
+    def checked(self) -> int:
+        return sum(1 for r in self.results if r.status != "skipped")
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def to_dict(self) -> dict:
+        return {
+            "where": self.where,
+            "ok": self.ok,
+            "checked": self.checked,
+            "violations": [r.name for r in self.violations],
+            "results": [r.to_dict() for r in self.results],
+        }
+
+
+class SloConfig:
+    """A parsed set of :class:`SloRule`\\ s."""
+
+    def __init__(self, rules: Sequence[SloRule]):
+        self.rules = list(rules)
+        names = [r.name for r in self.rules]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise SloConfigError(f"duplicate rule names: {sorted(dupes)}")
+
+    @classmethod
+    def from_dict(cls, doc: Mapping) -> "SloConfig":
+        if not isinstance(doc, Mapping) or not isinstance(
+                doc.get("slos"), list):
+            raise SloConfigError(
+                'SLO config must be {"slos": [rule, ...]}')
+        return cls([SloRule.from_dict(r) for r in doc["slos"]])
+
+    @classmethod
+    def from_file(cls, path: str) -> "SloConfig":
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except OSError as e:
+            raise SloConfigError(f"{path}: {e}") from e
+        except ValueError as e:
+            raise SloConfigError(f"{path}: not valid JSON ({e})") from e
+        return cls.from_dict(doc)
+
+    def evaluate(
+        self,
+        snapshot: Mapping,
+        where: str = "",
+        registry: Optional[MetricsRegistry] = None,
+        emit: bool = True,
+    ) -> SloReport:
+        """Judge every rule against ``snapshot``.
+
+        ``emit=True`` (the default) produces the observable side effects:
+        a ``slo.pass``/``slo.violation`` trace instant per judged rule,
+        a ``slo_violations_total{slo=...}`` bump per violation (in
+        ``registry``, default the process-global one), and a log warning
+        naming the rule. ``emit=False`` is the pure-judgment mode the
+        analyzer CLI and tests use."""
+        reg = REGISTRY if registry is None else registry
+        results = []
+        for rule in self.rules:
+            value = _resolve(snapshot, rule.metric)
+            if value is None:
+                status = ("violation" if rule.on_missing == "violate"
+                          else "skipped")
+            else:
+                status = ("pass" if _OPS[rule.op](value, rule.threshold)
+                          else "violation")
+            results.append(SloResult(
+                name=rule.name, metric=rule.metric, op=rule.op,
+                threshold=rule.threshold, value=value, status=status,
+            ))
+            if not emit or status == "skipped":
+                continue
+            if status == "violation":
+                reg.counter(
+                    VIOLATIONS_COUNTER,
+                    "SLO rule violations observed at evaluation points "
+                    "(serving flush, heartbeat, bench end)",
+                ).inc(slo=rule.name)
+                instant(
+                    "slo.violation", cat="slo", slo=rule.name,
+                    metric=rule.metric, op=rule.op,
+                    threshold=rule.threshold, value=value, where=where,
+                )
+                _log.warning(
+                    "SLO violation [%s]%s: %s = %s, want %s %s%s",
+                    rule.name, f" at {where}" if where else "",
+                    rule.metric, value, rule.op, rule.threshold,
+                    f" ({rule.description})" if rule.description else "",
+                )
+            else:
+                instant(
+                    "slo.pass", cat="slo", slo=rule.name,
+                    metric=rule.metric, value=value, where=where,
+                )
+        return SloReport(where=where, results=results)
+
+
+class SloWatchdog:
+    """Periodic SLO evaluation against a live snapshot source.
+
+    Built to ride :class:`supervisor.Heartbeat`'s beat loop (pass one as
+    ``Heartbeat(slo_watchdog=...)``): each ``check()`` call evaluates at
+    most once per ``min_interval_s`` (0 = every call) so a fast beat
+    interval doesn't turn every beat into an evaluation. Snapshot source
+    defaults to the process-global registry."""
+
+    def __init__(
+        self,
+        config: SloConfig,
+        snapshot_fn: Optional[Callable[[], Mapping]] = None,
+        where: str = "heartbeat",
+        min_interval_s: float = 0.0,
+        registry: Optional[MetricsRegistry] = None,
+    ):
+        self.config = config
+        self.snapshot_fn = (
+            snapshot_fn if snapshot_fn is not None else REGISTRY.snapshot
+        )
+        self.where = where
+        self.min_interval_s = float(min_interval_s)
+        self.registry = registry
+        self.last_report: Optional[SloReport] = None
+        self._last_eval = 0.0
+
+    def check(self) -> Optional[SloReport]:
+        now = time.monotonic()
+        if self._last_eval and now - self._last_eval < self.min_interval_s:
+            return None
+        self._last_eval = now
+        try:
+            snapshot = self.snapshot_fn()
+        except Exception as e:  # noqa: BLE001 - a sick probe must not kill
+            _log.warning("SLO snapshot source failed: %s", e)  # the beat loop
+            return None
+        self.last_report = self.config.evaluate(
+            snapshot, where=self.where, registry=self.registry)
+        return self.last_report

@@ -1,0 +1,525 @@
+"""Trace-timeline analyzer: from Chrome-trace JSON to machine verdicts.
+
+PR 3 made the stack *emit* spans (``--trace-out`` on every driver and the
+bench); this module *reads* them. Given one trace artifact it answers the
+questions the raw Perfetto view leaves to eyeballing:
+
+* **Critical path** — which (cat, name) owns each instant of wall clock.
+  A sweep line walks every elementary interval between span boundaries and
+  attributes it to the *innermost* open span (max nesting depth; ties to
+  the latest-started span, then highest tid — deterministic). Attributed
+  ("owned") shares therefore PARTITION the wall: they sum to ≤ 1.0 by
+  construction, with the remainder reported as ``idle``. This is the table
+  that names the bottleneck stage.
+* **Wall-clock share per layer** — the union of each ``cat``'s span
+  intervals over the trace wall. Unlike owned shares these may overlap
+  across layers (that overlap is the point — see below), so they do NOT
+  sum to 1.
+* **Queue-wait breakdown** — aggregate of the explicit wait spans
+  (``serve.queue_wait`` and anything else matching ``*queue_wait*``):
+  count, total, mean, max per name.
+* **Overlap report** — the measured answer to ROADMAP item 4's
+  "ingest no longer serializing with compute" claim: the fraction of
+  device-compute time (``optim``/``descent`` spans by default) during
+  which an ``ingest`` span is concurrently open, plus the dual (fraction
+  of ingest hidden under compute). A fully pipelined data path pushes the
+  first number toward 1; today's serialize-then-solve path reads ~0.
+
+Robustness contract (tested in tests/test_analysis.py): unclosed ``B``
+events from crashed runs are clamped to the trace end and flagged (never a
+negative duration), negative ``dur`` values are clamped to 0 and counted
+in ``warnings``, zero-length traces produce an empty report instead of a
+crash, and spans whose intervals straddle other threads' spans (the
+micro-batcher's cross-thread queue-wait spans) are handled by the sweep
+line like any other interval.
+"""
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import json
+from typing import Iterable, Mapping, Optional, Sequence
+
+__all__ = [
+    "Span",
+    "TimelineReport",
+    "TraceParseError",
+    "analyze_trace",
+    "analyze_events",
+    "load_trace",
+]
+
+# Layers treated as "device compute" / "ingest" for the overlap report.
+DEFAULT_COMPUTE_CATS = frozenset({"optim", "descent"})
+DEFAULT_INGEST_CATS = frozenset({"ingest"})
+
+# Fraction below which ingest/compute are called serialized outright.
+SERIALIZED_BELOW = 0.05
+OVERLAPPED_ABOVE = 0.80
+
+
+class TraceParseError(ValueError):
+    """The artifact is not a readable Chrome trace-event document."""
+
+
+@dataclasses.dataclass
+class Span:
+    """One complete span, times in seconds relative to the trace clock."""
+
+    name: str
+    cat: str
+    start: float
+    dur: float
+    pid: int
+    tid: int
+    args: dict
+    unclosed: bool = False
+    depth: int = 0
+
+    @property
+    def end(self) -> float:
+        return self.start + self.dur
+
+
+def load_trace(path: str) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise TraceParseError(f"{path}: {e}") from e
+    except ValueError as e:
+        raise TraceParseError(f"{path}: not valid JSON ({e})") from e
+    if isinstance(doc, list):  # bare event-array form is legal Chrome trace
+        doc = {"traceEvents": doc}
+    if not isinstance(doc, dict) or not isinstance(
+            doc.get("traceEvents"), list):
+        raise TraceParseError(f"{path}: no traceEvents array")
+    return doc
+
+
+def parse_events(
+    events: Iterable[Mapping],
+) -> tuple[list[Span], list[dict], list[str]]:
+    """Events → (spans, instants, warnings).
+
+    Accepts the collector's ``X`` (complete) events plus ``B``/``E`` pairs
+    from foreign tools; an unmatched ``B`` (crashed run) becomes a span
+    clamped to the trace end, flagged ``unclosed``.
+    """
+    spans: list[Span] = []
+    instants: list[dict] = []
+    warnings: list[str] = []
+    open_stacks: dict[tuple, list] = {}  # (pid, tid) -> [B events]
+    max_ts = 0.0
+    for e in events:
+        if not isinstance(e, Mapping) or "ph" not in e or "ts" not in e:
+            warnings.append(f"malformed event skipped: {e!r}")
+            continue
+        ph = e["ph"]
+        try:
+            ts = float(e["ts"]) / 1e6
+        except (TypeError, ValueError):
+            warnings.append(f"non-numeric ts skipped: {e!r}")
+            continue
+        pid = int(e.get("pid", 0))
+        tid = int(e.get("tid", 0))
+        if ph == "X":
+            try:
+                dur = float(e.get("dur", 0.0)) / 1e6
+            except (TypeError, ValueError):
+                dur = 0.0
+                warnings.append(f"non-numeric dur clamped to 0: {e!r}")
+            if dur < 0:
+                warnings.append(
+                    f"negative dur clamped to 0: {e.get('name')!r} ({dur})"
+                )
+                dur = 0.0
+            spans.append(Span(
+                name=str(e.get("name", "?")), cat=str(e.get("cat", "")),
+                start=ts, dur=dur, pid=pid, tid=tid,
+                args=dict(e.get("args") or {}),
+            ))
+            max_ts = max(max_ts, ts + dur)
+        elif ph == "B":
+            open_stacks.setdefault((pid, tid), []).append(e)
+            max_ts = max(max_ts, ts)
+        elif ph == "E":
+            stack = open_stacks.get((pid, tid))
+            if not stack:
+                warnings.append(f"unmatched E event skipped: {e.get('name')!r}")
+                continue
+            b = stack.pop()
+            b_ts = float(b["ts"]) / 1e6
+            dur = ts - b_ts
+            if dur < 0:
+                warnings.append(
+                    f"E before B clamped to 0: {b.get('name')!r}")
+                dur = 0.0
+            spans.append(Span(
+                name=str(b.get("name", "?")), cat=str(b.get("cat", "")),
+                start=b_ts, dur=dur, pid=pid, tid=tid,
+                args=dict(b.get("args") or {}),
+            ))
+            max_ts = max(max_ts, ts)
+        elif ph == "i":
+            instants.append(dict(e))
+            max_ts = max(max_ts, ts)
+        # other phases (M metadata, counters) are ignored
+    # Unclosed B events: a crashed run never wrote the E. Clamp to the
+    # trace end so the span exists with a NON-NEGATIVE duration, flagged.
+    for (pid, tid), stack in open_stacks.items():
+        for b in stack:
+            b_ts = float(b["ts"]) / 1e6
+            warnings.append(
+                f"unclosed span clamped to trace end: {b.get('name')!r}")
+            spans.append(Span(
+                name=str(b.get("name", "?")), cat=str(b.get("cat", "")),
+                start=b_ts, dur=max(0.0, max_ts - b_ts), pid=pid, tid=tid,
+                args=dict(b.get("args") or {}), unclosed=True,
+            ))
+    return spans, instants, warnings
+
+
+def _assign_depths(spans: Sequence[Span]) -> None:
+    """Nesting depth per (pid, tid) lane (innermost = deepest)."""
+    lanes: dict[tuple, list[Span]] = {}
+    for s in spans:
+        lanes.setdefault((s.pid, s.tid), []).append(s)
+    for lane in lanes.values():
+        lane.sort(key=lambda s: (s.start, -s.dur))
+        stack: list[Span] = []
+        for s in lane:
+            while stack and stack[-1].end <= s.start + 1e-12:
+                stack.pop()
+            s.depth = len(stack)
+            stack.append(s)
+
+
+def _union_seconds(intervals: Iterable[tuple[float, float]]) -> float:
+    ivs = sorted(i for i in intervals if i[1] > i[0])
+    total = 0.0
+    cur_lo = cur_hi = None
+    for lo, hi in ivs:
+        if cur_hi is None or lo > cur_hi:
+            if cur_hi is not None:
+                total += cur_hi - cur_lo
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    if cur_hi is not None:
+        total += cur_hi - cur_lo
+    return total
+
+
+def _intersection_seconds(
+    a: Iterable[tuple[float, float]], b: Iterable[tuple[float, float]]
+) -> float:
+    """|union(a) ∩ union(b)| via a two-pointer merge of the unions."""
+
+    def merged(ivs):
+        out = []
+        for lo, hi in sorted(i for i in ivs if i[1] > i[0]):
+            if out and lo <= out[-1][1]:
+                out[-1][1] = max(out[-1][1], hi)
+            else:
+                out.append([lo, hi])
+        return out
+
+    ma, mb = merged(a), merged(b)
+    i = j = 0
+    total = 0.0
+    while i < len(ma) and j < len(mb):
+        lo = max(ma[i][0], mb[j][0])
+        hi = min(ma[i][1], mb[j][1])
+        if hi > lo:
+            total += hi - lo
+        if ma[i][1] <= mb[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def _attribute_wall(spans: Sequence[Span]) -> dict[tuple[str, str], float]:
+    """Sweep line: every elementary interval goes to the innermost open
+    span — (depth, start, tid) max, one owner per instant — so the owned
+    totals partition the busy wall exactly."""
+    timed = [s for s in spans if s.dur > 0]
+    if not timed:
+        return {}
+    bounds = sorted({s.start for s in timed} | {s.end for s in timed})
+    by_start = sorted(timed, key=lambda s: s.start)
+    owned: dict[tuple[str, str], float] = {}
+    open_spans: dict[int, Span] = {}
+    end_heap: list[tuple[float, int]] = []
+    nxt = 0
+    for k in range(len(bounds) - 1):
+        seg_lo, seg_hi = bounds[k], bounds[k + 1]
+        while nxt < len(by_start) and by_start[nxt].start <= seg_lo + 1e-12:
+            s = by_start[nxt]
+            open_spans[id(s)] = s
+            heapq.heappush(end_heap, (s.end, id(s)))
+            nxt += 1
+        while end_heap and end_heap[0][0] <= seg_lo + 1e-12:
+            _, sid = heapq.heappop(end_heap)
+            open_spans.pop(sid, None)
+        if open_spans:
+            owner = max(
+                open_spans.values(),
+                key=lambda s: (s.depth, s.start, s.tid),
+            )
+            key = (owner.cat, owner.name)
+            owned[key] = owned.get(key, 0.0) + (seg_hi - seg_lo)
+    return owned
+
+
+@dataclasses.dataclass
+class TimelineReport:
+    """Everything the analyzer derives from one trace artifact."""
+
+    wall_seconds: float
+    n_spans: int
+    n_instants: int
+    # (cat, name) -> owned wall seconds (partition; sums to <= wall)
+    owned: dict
+    idle_seconds: float
+    # cat -> {"busy_seconds", "busy_share", "owned_seconds", "owned_share",
+    #         "spans"}
+    layers: dict
+    # name -> {"count", "total_s", "mean_ms", "max_ms"}
+    queue_wait: dict
+    # overlap report (None values when either side has no spans)
+    overlap: dict
+    warnings: list
+    unclosed_spans: int
+
+    @property
+    def owned_shares(self) -> dict:
+        if self.wall_seconds <= 0:
+            return {}
+        return {
+            f"{cat}:{name}": secs / self.wall_seconds
+            for (cat, name), secs in self.owned.items()
+        }
+
+    def critical_path(self, top: int = 12) -> list[dict]:
+        """Owned-wall table rows, biggest owner first."""
+        rows = sorted(
+            self.owned.items(), key=lambda kv: kv[1], reverse=True
+        )[:top]
+        wall = self.wall_seconds or 1.0
+        return [
+            {"cat": cat, "name": name, "owned_s": round(secs, 6),
+             "share": round(secs / wall, 4)}
+            for (cat, name), secs in rows
+        ]
+
+    def bottleneck(self) -> Optional[dict]:
+        cp = self.critical_path(top=1)
+        return cp[0] if cp else None
+
+    def to_dict(self) -> dict:
+        return {
+            "schema": "photon-timeline/1",
+            "wall_seconds": round(self.wall_seconds, 6),
+            "n_spans": self.n_spans,
+            "n_instants": self.n_instants,
+            "unclosed_spans": self.unclosed_spans,
+            "idle_seconds": round(self.idle_seconds, 6),
+            "critical_path": self.critical_path(),
+            "layers": self.layers,
+            "queue_wait": self.queue_wait,
+            "overlap": self.overlap,
+            "warnings": self.warnings,
+        }
+
+    def format_text(self, top: int = 12) -> str:
+        lines = [
+            f"trace wall: {self.wall_seconds * 1e3:.2f} ms, "
+            f"{self.n_spans} spans, {self.n_instants} instants"
+            + (f", {self.unclosed_spans} UNCLOSED (crashed run?)"
+               if self.unclosed_spans else ""),
+            "",
+            "critical path (owned wall share; innermost span owns each "
+            "instant):",
+            f"  {'share':>7}  {'owned':>10}  span",
+        ]
+        for row in self.critical_path(top):
+            lines.append(
+                f"  {row['share'] * 100:6.1f}%  "
+                f"{row['owned_s'] * 1e3:8.2f}ms  "
+                f"{row['cat']}:{row['name']}"
+            )
+        if self.wall_seconds > 0:
+            lines.append(
+                f"  {self.idle_seconds / self.wall_seconds * 100:6.1f}%  "
+                f"{self.idle_seconds * 1e3:8.2f}ms  (idle: no span open)"
+            )
+        lines += ["", "per-layer wall share (unions; may overlap):"]
+        for cat, d in sorted(self.layers.items(),
+                             key=lambda kv: -kv[1]["busy_seconds"]):
+            lines.append(
+                f"  {cat:<10} busy {d['busy_share'] * 100:5.1f}%  "
+                f"owned {d['owned_share'] * 100:5.1f}%  "
+                f"({d['spans']} spans)"
+            )
+        if self.queue_wait:
+            lines += ["", "queue-wait breakdown:"]
+            for name, d in sorted(self.queue_wait.items()):
+                lines.append(
+                    f"  {name}: {d['count']} waits, total "
+                    f"{d['total_s'] * 1e3:.2f}ms, mean {d['mean_ms']:.3f}ms, "
+                    f"max {d['max_ms']:.3f}ms"
+                )
+        ov = self.overlap
+        lines += ["", "ingest/compute overlap:"]
+        if ov.get("compute_overlapped_fraction") is None:
+            lines.append("  n/a (no "
+                         + ("compute" if ov.get("compute_busy_s") in (0, None)
+                            else "ingest")
+                         + " spans in this trace)")
+        else:
+            lines.append(
+                f"  compute busy {ov['compute_busy_s'] * 1e3:.2f}ms, ingest "
+                f"busy {ov['ingest_busy_s'] * 1e3:.2f}ms, concurrent "
+                f"{ov['overlap_s'] * 1e3:.2f}ms"
+            )
+            lines.append(
+                f"  fraction of compute with ingest concurrently open: "
+                f"{ov['compute_overlapped_fraction']:.4f}  -> "
+                f"{ov['verdict']}"
+            )
+            lines.append(
+                f"  fraction of ingest hidden under compute: "
+                f"{ov['ingest_hidden_fraction']:.4f}"
+            )
+        if self.warnings:
+            lines += ["", f"warnings ({len(self.warnings)}):"]
+            lines += [f"  {w}" for w in self.warnings[:10]]
+            if len(self.warnings) > 10:
+                lines.append(f"  ... {len(self.warnings) - 10} more")
+        return "\n".join(lines)
+
+
+def analyze_events(
+    events: Iterable[Mapping],
+    compute_cats: frozenset = DEFAULT_COMPUTE_CATS,
+    ingest_cats: frozenset = DEFAULT_INGEST_CATS,
+) -> TimelineReport:
+    spans, instants, warnings = parse_events(events)
+    if not spans:
+        return TimelineReport(
+            wall_seconds=0.0, n_spans=0, n_instants=len(instants),
+            owned={}, idle_seconds=0.0, layers={}, queue_wait={},
+            overlap={"compute_busy_s": None, "ingest_busy_s": None,
+                     "overlap_s": None,
+                     "compute_overlapped_fraction": None,
+                     "ingest_hidden_fraction": None, "verdict": "empty"},
+            warnings=warnings, unclosed_spans=0,
+        )
+    _assign_depths(spans)
+    t_lo = min(s.start for s in spans)
+    t_hi = max(s.end for s in spans)
+    wall = max(0.0, t_hi - t_lo)
+    owned = _attribute_wall(spans)
+    idle = max(0.0, wall - sum(owned.values()))
+
+    layers: dict[str, dict] = {}
+    for cat in {s.cat for s in spans}:
+        cat_spans = [s for s in spans if s.cat == cat]
+        busy = _union_seconds((s.start, s.end) for s in cat_spans)
+        owned_cat = sum(v for (c, _), v in owned.items() if c == cat)
+        layers[cat] = {
+            "busy_seconds": round(busy, 6),
+            "busy_share": round(busy / wall, 4) if wall else 0.0,
+            "owned_seconds": round(owned_cat, 6),
+            "owned_share": round(owned_cat / wall, 4) if wall else 0.0,
+            "spans": len(cat_spans),
+        }
+
+    queue_wait: dict[str, dict] = {}
+    for s in spans:
+        if "queue_wait" not in s.name:
+            continue
+        d = queue_wait.setdefault(
+            s.name, {"count": 0, "total_s": 0.0, "max_ms": 0.0})
+        d["count"] += 1
+        d["total_s"] += s.dur
+        d["max_ms"] = max(d["max_ms"], s.dur * 1e3)
+    for d in queue_wait.values():
+        d["mean_ms"] = round(d["total_s"] * 1e3 / d["count"], 3)
+        d["total_s"] = round(d["total_s"], 6)
+        d["max_ms"] = round(d["max_ms"], 3)
+
+    compute_ivs = [(s.start, s.end) for s in spans if s.cat in compute_cats]
+    ingest_ivs = [(s.start, s.end) for s in spans if s.cat in ingest_cats]
+    compute_busy = _union_seconds(compute_ivs)
+    ingest_busy = _union_seconds(ingest_ivs)
+    if compute_busy > 0 and ingest_busy > 0:
+        both = _intersection_seconds(compute_ivs, ingest_ivs)
+        frac = both / compute_busy
+        verdict = (
+            "serialized" if frac < SERIALIZED_BELOW
+            else "overlapped" if frac > OVERLAPPED_ABOVE
+            else "partially-overlapped"
+        )
+        overlap = {
+            "compute_busy_s": round(compute_busy, 6),
+            "ingest_busy_s": round(ingest_busy, 6),
+            "overlap_s": round(both, 6),
+            "compute_overlapped_fraction": round(frac, 4),
+            "ingest_hidden_fraction": round(both / ingest_busy, 4),
+            "verdict": verdict,
+        }
+    else:
+        overlap = {
+            "compute_busy_s": round(compute_busy, 6),
+            "ingest_busy_s": round(ingest_busy, 6),
+            "overlap_s": None,
+            "compute_overlapped_fraction": None,
+            "ingest_hidden_fraction": None,
+            "verdict": "one-sided" if (compute_busy or ingest_busy)
+            else "empty",
+        }
+
+    return TimelineReport(
+        wall_seconds=wall, n_spans=len(spans), n_instants=len(instants),
+        owned=owned, idle_seconds=idle, layers=layers,
+        queue_wait=queue_wait, overlap=overlap, warnings=warnings,
+        unclosed_spans=sum(1 for s in spans if s.unclosed),
+    )
+
+
+def analyze_trace(path: str, **kw) -> TimelineReport:
+    """Load one ``--trace-out`` artifact and analyze it."""
+    return analyze_events(load_trace(path)["traceEvents"], **kw)
+
+
+def roofline_attribution(
+    report: TimelineReport, bench_details: Mapping
+) -> dict:
+    """Join the bench roofline numbers with the timeline: name the stage
+    that owns the gap. ``bench_details`` is a BENCH_DETAILS*-shaped dict
+    (see ``obs.analysis.artifacts.load_bench_details``)."""
+    roof = (bench_details or {}).get("roofline") or {}
+    bn = report.bottleneck()
+    ov = report.overlap.get("compute_overlapped_fraction")
+    out = {
+        "fraction_of_roofline": roof.get("fraction_of_roofline"),
+        "roofline_backend": roof.get("backend"),
+        "bottleneck": f"{bn['cat']}:{bn['name']}" if bn else None,
+        "bottleneck_share": bn["share"] if bn else None,
+        "ingest_compute_overlap": ov,
+    }
+    frac = roof.get("fraction_of_roofline")
+    if frac is not None and bn is not None:
+        out["note"] = (
+            f"fraction_of_roofline={frac}: the headline pass runs at "
+            f"{frac:.0%} of the memory roofline; the timeline says "
+            f"{bn['cat']}:{bn['name']} owns {bn['share']:.0%} of wall"
+            + (f" and ingest/compute overlap is {ov:.2f} "
+               f"({report.overlap.get('verdict')})" if ov is not None
+               else " (no ingest/compute overlap measurable in this trace)")
+            + "."
+        )
+    return out

@@ -69,12 +69,25 @@ class ScoringServer:
         metrics_path: Optional[str] = None,
         metrics_interval_s: float = 60.0,
         request_timeout_s: float = _REQUEST_TIMEOUT_S,
+        slo_config=None,
     ):
         self.registry = registry
         self.batcher = batcher
         self.logger = logger
         self.metrics_path = metrics_path
         self.request_timeout_s = float(request_timeout_s)
+        # Declarative SLOs (docs/observability.md §SLO): a config path or
+        # SloConfig, judged against each periodic metrics flush and the
+        # shutdown flush — violations bump the process-global
+        # slo_violations_total{slo=...} (visible on this server's
+        # /metrics?format=prom via the registry merge) and emit trace
+        # instants; the last report rides the JSON snapshot under "slo".
+        if isinstance(slo_config, str):
+            from photon_tpu.obs.analysis.slo import SloConfig
+
+            slo_config = SloConfig.from_file(slo_config)
+        self.slo_config = slo_config
+        self._slo_last = None
         # Per-server metrics registry (docs/observability.md): the old
         # hand-rolled counter dict, the latency histogram, and the batcher/
         # cache/breaker snapshots all live here now, giving one state with
@@ -311,7 +324,10 @@ class ScoringServer:
         self._serve_thread: Optional[threading.Thread] = None
         self._metrics_stop = threading.Event()
         self._metrics_thread: Optional[threading.Thread] = None
-        if metrics_path:
+        # The flush loop runs for EITHER consumer: a JSONL path to append
+        # to, or SLOs to judge on the flush cadence (an SLO-only server
+        # must still evaluate periodically, not just at shutdown).
+        if metrics_path or self.slo_config is not None:
             self._metrics_thread = threading.Thread(
                 target=self._metrics_loop,
                 args=(float(metrics_interval_s),),
@@ -380,18 +396,47 @@ class ScoringServer:
             "kernel_traces": retrace.traces(SCORE_KERNEL_NAME),
             "kernel_retraces_after_warmup": retrace.retraces_after_warmup(
                 SCORE_KERNEL_NAME),
+            # getattr: harness fakes build servers via __new__ and only
+            # set what they exercise
+            **({"slo": self._slo_last.to_dict()}
+               if getattr(self, "_slo_last", None) is not None else {}),
         }
 
     def _metrics_loop(self, interval_s: float) -> None:
         while not self._metrics_stop.wait(interval_s):
             self.flush_metrics()
 
+    def check_slos(self, snapshot: Optional[dict] = None) -> Optional[dict]:
+        """Judge the configured SLOs against ``snapshot`` (or a fresh one;
+        called at every flush + shutdown, and directly by benches/tests).
+        Returns the report dict, or None without a config."""
+        if self.slo_config is None:
+            return None
+        if snapshot is None:
+            snapshot = self.metrics_snapshot()
+        self._slo_last = self.slo_config.evaluate(snapshot, where="serving")
+        if not self._slo_last.ok and self.logger is not None:
+            self.logger.warning(
+                "serving SLO violations: %s",
+                [r.name for r in self._slo_last.violations])
+        return self._slo_last.to_dict()
+
     def flush_metrics(self) -> None:
+        # SLO judgment happens on the flush cadence whether or not a JSONL
+        # path is configured — the violation counter and trace instants
+        # are the contract; the JSONL record is one more consumer. ONE
+        # snapshot serves both, so the persisted record and the SLO values
+        # written beside it can never disagree (and the interval window
+        # only advances when a record is actually persisted).
+        if self.slo_config is None and not self.metrics_path:
+            return
+        snap = self.metrics_snapshot(
+            advance_interval=bool(self.metrics_path))
+        slo = self.check_slos(snapshot=snap)
+        if slo is not None:
+            snap = {**snap, "slo": slo}
         if self.metrics_path:
-            write_metrics_jsonl(
-                self.metrics_path,
-                [self.metrics_snapshot(advance_interval=True)],
-            )
+            write_metrics_jsonl(self.metrics_path, [snap])
 
     def start(self) -> None:
         """Serve in a background thread (tests / embedded use)."""

@@ -337,6 +337,7 @@ class Heartbeat:
         directory: str,
         process_id: Optional[int] = None,
         interval_seconds: float = 10.0,
+        slo_watchdog=None,
     ):
         if process_id is None:
             import jax
@@ -345,6 +346,11 @@ class Heartbeat:
         self.directory = directory
         self.process_id = int(process_id)
         self.interval_seconds = interval_seconds
+        # Optional obs.analysis.slo.SloWatchdog: SLO rules judged on the
+        # beat cadence (rate-limited by the watchdog's own min_interval_s)
+        # from the same surviving daemon thread as the map-count check, so
+        # a wedged main thread still reports SLO state.
+        self.slo_watchdog = slo_watchdog
         self.epoch = 0
         self._stop = None
         self._thread = None
@@ -445,6 +451,11 @@ class Heartbeat:
                 except OSError:
                     pass  # shared fs hiccup; next beat retries
                 map_watch.check()
+                if self.slo_watchdog is not None:
+                    try:
+                        self.slo_watchdog.check()
+                    except Exception:  # noqa: BLE001 - SLO judgment must
+                        pass  # never take the liveness beacon down with it
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -86,8 +86,65 @@ class Timed:
         self.logger.info("%s: %s in %.3fs", self.stage, status, self.seconds)
 
 
+# Rotation defaults for write_metrics_jsonl (overridable per call or via
+# env): a long-run serve bench flushing every minute must not fill the
+# disk, so growth is bounded at max_bytes x (max_rotated + 1) per path.
+DEFAULT_METRICS_MAX_BYTES = 64 << 20
+DEFAULT_METRICS_MAX_ROTATED = 3
+
+
+def _rotate_metrics_file(path: str, max_bytes: int, max_rotated: int) -> None:
+    """Size-gated rotation: ``path`` → ``path.1`` → ... → ``path.N``.
+
+    Serialized across processes by an flock on ``path.rotate.lock`` (the
+    size is re-checked under the lock, so the losing racer sees the fresh
+    file and does nothing). A writer that already holds an O_APPEND
+    descriptor to the renamed file keeps appending to ``path.1`` — whole
+    lines, still atomic — and its next call lands on the fresh file.
+    """
+    try:
+        if os.path.getsize(path) < max_bytes:
+            return
+    except OSError:
+        return  # nothing to rotate
+    try:
+        import fcntl
+
+        lock = open(path + ".rotate.lock", "a")
+    except (ImportError, OSError):
+        lock = None
+    try:
+        if lock is not None:
+            try:
+                fcntl.flock(lock, fcntl.LOCK_EX)
+            except OSError:
+                pass
+        try:
+            if os.path.getsize(path) < max_bytes:
+                return  # another writer rotated while we waited
+        except OSError:
+            return
+        try:
+            if max_rotated <= 0:
+                os.remove(path)
+                return
+            for i in range(max_rotated - 1, 0, -1):
+                src = f"{path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{path}.{i + 1}")
+            os.replace(path, f"{path}.1")
+        except OSError:
+            pass  # rotation is best-effort; the append below still works
+    finally:
+        if lock is not None:
+            lock.close()
+
+
 def write_metrics_jsonl(
-    path: str, records: Iterable[Mapping[str, Any]]
+    path: str,
+    records: Iterable[Mapping[str, Any]],
+    max_bytes: int = None,
+    max_rotated: int = None,
 ) -> None:
     """Append metric records as JSON lines (one object per line).
 
@@ -100,8 +157,32 @@ def write_metrics_jsonl(
     crash mid-flush can lose at most the not-yet-written records, never
     corrupt previously-written lines. Readers may therefore tail the file
     while it grows and treat every complete line as a valid JSON object.
+
+    Growth is bounded: once the file reaches ``max_bytes`` (default 64 MB;
+    env ``PHOTON_METRICS_MAX_BYTES``, 0 disables) it rotates to ``path.1``
+    .. ``path.N`` (``max_rotated``, default 3; env
+    ``PHOTON_METRICS_MAX_ROTATED``) BEFORE this call's appends, so every
+    line within one call lands in one file and rotation never tears a
+    record — the whole-line contract above holds across rotations.
     """
+    # Malformed env values fall back to the defaults: a typo'd override
+    # must degrade rotation, never kill the periodic metrics thread that
+    # calls this on every flush.
+    if max_bytes is None:
+        try:
+            max_bytes = int(os.environ.get(
+                "PHOTON_METRICS_MAX_BYTES", DEFAULT_METRICS_MAX_BYTES))
+        except (TypeError, ValueError):
+            max_bytes = DEFAULT_METRICS_MAX_BYTES
+    if max_rotated is None:
+        try:
+            max_rotated = int(os.environ.get(
+                "PHOTON_METRICS_MAX_ROTATED", DEFAULT_METRICS_MAX_ROTATED))
+        except (TypeError, ValueError):
+            max_rotated = DEFAULT_METRICS_MAX_ROTATED
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    if max_bytes > 0:
+        _rotate_metrics_file(path, max_bytes, max_rotated)
     with open(path, "ab", buffering=0) as f:
         for rec in records:
             f.write((json.dumps(dict(rec)) + "\n").encode("utf-8"))

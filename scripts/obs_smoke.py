@@ -187,6 +187,32 @@ def main() -> None:
                 n_faults_expected=1)
     print(f"obs_smoke: training trace ok ({trace_path})")
 
+    # ---- timeline analyzer over the smoke trace (obs/analysis/) ---------
+    # End-to-end contract: the analyzer must produce a critical path and
+    # an overlap report from a real --trace-out artifact, and both must be
+    # internally consistent — owned shares partition the wall (sum <= 1),
+    # and no clamping path may ever yield a negative duration.
+    from photon_tpu.obs.analysis import analyze_trace
+
+    report = analyze_trace(trace_path)
+    if report.wall_seconds <= 0 or not report.critical_path():
+        fail(f"analyzer: no critical path from {trace_path}")
+    share_sum = sum(report.owned_shares.values())
+    if share_sum > 1.0 + 1e-6:
+        fail(f"analyzer: owned shares sum {share_sum} > 1.0")
+    if report.idle_seconds < 0 or any(
+            secs < 0 for secs in report.owned.values()):
+        fail("analyzer: negative duration in attribution")
+    ov = report.overlap["compute_overlapped_fraction"]
+    if ov is None:
+        fail(f"analyzer: no ingest/compute overlap report "
+             f"(layers: {sorted(report.layers)})")
+    if not 0.0 <= ov <= 1.0:
+        fail(f"analyzer: overlap fraction {ov} outside [0, 1]")
+    print(f"obs_smoke: timeline analyzer ok (bottleneck "
+          f"{report.bottleneck()['cat']}:{report.bottleneck()['name']}, "
+          f"ingest/compute overlap {ov}, shares sum {share_sum:.4f})")
+
     # ---- serving: traced requests + Prometheus exposition ----------------
     from photon_tpu.cli.params import enable_trace, finish_trace
     from photon_tpu.serving import (
@@ -220,6 +246,27 @@ def main() -> None:
         if resp.status != 200 or "text/plain" not in ctype:
             fail(f"/metrics?format=prom: status {resp.status}, "
                  f"content-type {ctype!r}")
+        # ---- SLO evaluation against the live snapshot -------------------
+        # One deliberately impossible rule + one trivially true rule: the
+        # violation must bump slo_violations_total and land an instant in
+        # the active trace; the pass must not.
+        from photon_tpu.obs.analysis import SloConfig
+        from photon_tpu.obs.metrics import REGISTRY
+
+        slo = SloConfig.from_dict({"slos": [
+            {"name": "smoke_p99_impossible", "metric": "latency.p99_ms",
+             "op": "<=", "threshold": 0.0},
+            {"name": "smoke_requests_floor", "metric": "requests",
+             "op": ">=", "threshold": 1},
+        ]})
+        slo_report = slo.evaluate(server.metrics_snapshot(), where="smoke")
+        if [r.name for r in slo_report.violations] != [
+                "smoke_p99_impossible"]:
+            fail(f"slo: expected exactly the impossible rule to violate, "
+                 f"got {[r.to_dict() for r in slo_report.results]}")
+        if REGISTRY.counter("slo_violations_total").value(
+                slo="smoke_p99_impossible") < 1:
+            fail("slo: violation did not bump slo_violations_total")
     finally:
         server.shutdown()
         finish_trace(serve_trace)
@@ -254,6 +301,21 @@ def main() -> None:
              f"queue_wait carries {qw_ids - req_ids} unknown ids")
     print(f"obs_smoke: serve trace ok ({len(events)} events, "
           f"{len(req_ids)} request traces propagated)")
+    # Analyzer over the SERVE trace: the queue-wait breakdown must see the
+    # batcher's cross-thread serve.queue_wait spans, and the SLO judgment
+    # above must have landed exactly one violation instant in the timeline.
+    serve_report = analyze_trace(serve_trace)
+    qw = serve_report.queue_wait.get("serve.queue_wait")
+    if not qw or qw["count"] < 1:
+        fail(f"analyzer: no serve.queue_wait breakdown "
+             f"(got {serve_report.queue_wait})")
+    slo_events = [e for e in events if e.get("cat") == "slo"]
+    viol = [e for e in slo_events if e["name"] == "slo.violation"]
+    if len(viol) != 1 or viol[0]["args"].get("slo") != "smoke_p99_impossible":
+        fail(f"slo: expected one slo.violation instant in the serve "
+             f"trace, got {slo_events}")
+    print(f"obs_smoke: analyzer queue-wait + slo instants ok "
+          f"({qw['count']} waits, mean {qw['mean_ms']}ms)")
     print("obs_smoke: OK")
 
 
