@@ -58,7 +58,7 @@ echo "== chaos smoke (deterministic fault injection; docs/robustness.md) =="
 # plans must answer every request (success, degraded, or 503) — no hangs.
 # (Named files, not tests/: an unrelated collection error — e.g. a missing
 # optional dependency in another test module — must not mask chaos results.)
-python -m pytest tests/test_chaos.py tests/test_serving.py -q -m chaos
+python -m pytest tests/test_chaos.py tests/test_serving.py tests/test_prefetch.py -q -m chaos
 
 echo "== obs smoke (tracing + Prometheus exposition; docs/observability.md) =="
 # A tiny traced training + scoring pass: validates the --trace-out artifact

@@ -142,6 +142,25 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "(native block decoder per worker, file-sharded; "
                         "the reference's per-executor-core split decode, "
                         "SURVEY.md §2.3/§2.6); 0/1 = in-process")
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="chunks the ingest pipeline decodes AHEAD on a "
+                        "background thread (io/prefetch.py): block decode of "
+                        "chunk N+1 overlaps downstream work on chunk N. "
+                        "Default PHOTON_PREFETCH_DEPTH (2); 0 = sequential "
+                        "decode (the pre-pipeline behavior)")
+    p.add_argument("--sweep-cache-mb", type=float, default=None,
+                   help="device-resident sweep cache budget in MB "
+                        "(data/device_cache.py): multi-sweep training pins "
+                        "host-resident coordinate data on device after "
+                        "sweep 0 instead of re-uploading per sweep. Default "
+                        "PHOTON_SWEEP_CACHE_MB (2048); 0 disables")
+    p.add_argument("--bf16-feed", action="store_true",
+                   help="transfer feature VALUES host->device as bfloat16 "
+                        "(half the hot-path transfer bytes); solves "
+                        "accumulate in float32 via dtype promotion. Opt-in: "
+                        "continuous features round to 8 mantissa bits "
+                        "(tolerance documented in tests/test_prefetch.py). "
+                        "Incompatible with --dtype float64")
     p.add_argument("--feature-summary", action="store_true",
                    help="write per-feature summary statistics (mean/var/min/"
                         "max/nnz) for every shard to <output-dir>/summary/"
@@ -429,25 +448,55 @@ def _run_inner(args, task) -> dict:
         )
 
         read_dtype = np.float64 if args.dtype == "float64" else np.float32
+        if args.bf16_feed and args.dtype == "float64":
+            raise ValueError(
+                "--bf16-feed narrows the device feed below float32; it "
+                "cannot honor --dtype float64 (pick one)"
+            )
+        feed_dtype = "bfloat16" if args.bf16_feed else None
+
+        # ONE streaming reader for every pipelined read: its compiled decode
+        # programs + per-shard probe tables are config-determined and reused
+        # across the train AND validation reads (the old AvroDataReader path
+        # made the same guarantee via its cached self._streaming).
+        from photon_tpu.io.streaming import StreamingAvroReader
+
+        stream_reader = StreamingAvroReader(
+            index_maps, shard_cfgs, reader.columns, id_tags,
+            capture_uids=False,
+        )
 
         def read_data(paths):
-            if args.ingest_workers > 1:
-                from photon_tpu.io.parallel_ingest import read_parallel
-                from photon_tpu.io.streaming import Unsupported
+            from photon_tpu.io.prefetch import (
+                default_prefetch_depth,
+                read_bundle_pipelined,
+            )
+            from photon_tpu.io.streaming import Unsupported
 
-                try:
-                    return read_parallel(
-                        paths, index_maps, shard_cfgs, reader.columns,
-                        id_tags, n_workers=args.ingest_workers,
-                        dtype=read_dtype, capture_uids=False,
-                    )
-                except Unsupported as e:
-                    logger.info("parallel ingest unavailable (%s); "
-                                "in-process read", e)
+            depth = (default_prefetch_depth() if args.prefetch_depth is None
+                     else max(0, args.prefetch_depth))
             # Training never reads the uid column; skipping it keeps host
             # memory at the numeric floor (10^8 uid strings would dwarf the
             # ELL arrays themselves).
-            return reader.read(paths, dtype=read_dtype, capture_uids=False)
+            try:
+                # Pipelined ingest→device path (io/prefetch.py): background
+                # block decode (+ the worker pool under --ingest-workers)
+                # overlapped with bundle assembly and the device upload;
+                # --bf16-feed narrows feature values on the host first.
+                return read_bundle_pipelined(
+                    index_maps, shard_cfgs, reader.columns, id_tags, paths,
+                    dtype=read_dtype, depth=depth,
+                    workers=args.ingest_workers, capture_uids=False,
+                    feed_dtype=feed_dtype, reader=stream_reader,
+                )
+            except Unsupported as e:
+                logger.info("pipelined ingest unavailable (%s); "
+                            "per-record read", e)
+            bundle = reader.read(paths, dtype=read_dtype, capture_uids=False)
+            if feed_dtype is not None:
+                logger.info("--bf16-feed inactive on the per-record "
+                            "fallback reader (values stay %s)", read_dtype)
+            return bundle
 
         with Timed("read training data", logger) as t:
             train = read_data(args.train_data)
@@ -508,6 +557,7 @@ def _run_inner(args, task) -> dict:
             },
             mesh=mesh,
             model_axis=model_axis,
+            sweep_cache_mb=args.sweep_cache_mb,
         )
 
         if args.tuning:

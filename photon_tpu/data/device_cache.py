@@ -1,0 +1,268 @@
+"""Device-resident sweep cache: stop re-uploading the dataset every sweep.
+
+Multi-sweep GAME training re-enters every coordinate once per sweep, and the
+host-resident data paths paid host→device transfer each time: a
+``host_resident=True`` random-effect dataset re-uploaded every bucket per
+sweep, and the out-of-core fixed-effect solver re-streamed its ELL chunks on
+every optimizer pass. The reference never had this problem — Spark RDDs
+persist across ``CoordinateDescent`` iterations (``.persist()`` on the
+per-coordinate datasets) — and ROADMAP item 4 names the fix: pin the
+dataset on device after sweep 0.
+
+:class:`DeviceSweepCache` is that pin, with a **memory budget**: entries are
+device-array pytrees keyed by the host object they mirror; once the budget
+(``PHOTON_SWEEP_CACHE_MB``, default 2048, ``0`` disables) would be
+exceeded, further datasets SPILL — the build still runs (this sweep's
+transfer happens either way) but nothing is retained, so the next sweep
+streams again, exactly the pre-cache behavior. Budget pressure is therefore
+a throughput regression, never an OOM. Residency and spill are
+gauge-reported (``sweep_cache_*``) so a bench artifact or /metrics scrape
+shows whether the cache actually held.
+
+Identity matters for random effects: ``RandomEffectCoordinate`` compares
+``proj`` arrays BY IDENTITY to detect "model trained on this dataset", so
+the cached device mirror of a dataset must be the SAME object every sweep.
+``dataset_mirror`` guarantees that: one mirror per source dataset for the
+cache's lifetime (spilled datasets return the original host-backed object,
+whose identity is equally stable).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from photon_tpu.obs import trace_span
+from photon_tpu.obs.metrics import REGISTRY
+
+__all__ = ["DeviceSweepCache", "default_budget_bytes"]
+
+_CACHE_BYTES = REGISTRY.gauge(
+    "sweep_cache_bytes",
+    "Device bytes currently pinned by DeviceSweepCache instances",
+)
+_CACHE_ENTRIES = REGISTRY.gauge(
+    "sweep_cache_entries",
+    "Entries currently resident across DeviceSweepCache instances",
+)
+_CACHE_HITS = REGISTRY.counter(
+    "sweep_cache_hits_total",
+    "Sweep-cache lookups served from device-resident arrays",
+)
+_CACHE_MISSES = REGISTRY.counter(
+    "sweep_cache_misses_total",
+    "Sweep-cache lookups that had to upload (first touch)",
+)
+_CACHE_SPILLED = REGISTRY.gauge(
+    "sweep_cache_spilled_bytes",
+    "Bytes that did NOT fit the sweep-cache budget and re-stream per sweep",
+)
+
+
+def default_budget_bytes() -> int:
+    """``PHOTON_SWEEP_CACHE_MB`` (default 2048 MB; 0 disables caching)."""
+    try:
+        mb = float(os.environ.get("PHOTON_SWEEP_CACHE_MB", "2048"))
+    except ValueError:
+        mb = 2048.0
+    return max(0, int(mb * 1e6))
+
+
+def _tree_nbytes(tree) -> int:
+    import jax
+
+    return sum(
+        int(getattr(leaf, "nbytes", 0)) for leaf in jax.tree.leaves(tree)
+    )
+
+
+class DeviceSweepCache:
+    """Budgeted pin of host training data on device across sweeps.
+
+    One instance per fit/estimator (the estimator shares it across a
+    λ-sweep's configurations — same data, one upload). ``release()`` drops
+    every pin and rolls the process-wide gauges back; a cache that simply
+    goes out of scope releases via ``__del__`` as a backstop.
+    """
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self.budget_bytes = (
+            default_budget_bytes() if budget_bytes is None
+            else max(0, int(budget_bytes))
+        )
+        # key -> (device pytree, nbytes, retained-host-referent). The
+        # referent is whatever object the KEY was derived from (an id());
+        # retaining it pins the id, so a freed-and-recycled address can
+        # never alias a different object onto a stale device entry.
+        self._entries: dict = {}
+        self._mirrors: dict = {}
+        # key -> (retained host referent, nbytes), same id-pinning rule as
+        # _entries: spill accounting is once-per-key, so a freed-and-
+        # recycled id matching a stale spill key would silently skip a NEW
+        # key's bytes; nbytes lets discard() roll the accounting back.
+        self._spilled_keys: dict = {}
+        self._bytes = 0
+        self._spilled = 0
+        self._lock = threading.Lock()
+
+    # -- core --------------------------------------------------------------
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    @property
+    def spilled_bytes(self) -> int:
+        return self._spilled
+
+    @property
+    def enabled(self) -> bool:
+        return self.budget_bytes > 0
+
+    def stats(self) -> dict:
+        return {
+            "budget_bytes": self.budget_bytes,
+            "resident_bytes": self._bytes,
+            "spilled_bytes": self._spilled,
+            "entries": len(self._entries),
+        }
+
+    def get_or_put(self, key, nbytes: int, build: Callable, retain=None):
+        """Device pytree for ``key``: cached on hit; on miss ``build()``
+        runs (the upload) and the result is RETAINED only when ``nbytes``
+        fits the remaining budget — else it is returned un-pinned (spill:
+        this use still works, the next sweep re-uploads; spilled bytes are
+        counted ONCE per key, not per re-miss, so the gauge reads dataset
+        size, not dataset × passes). ``retain`` pins the host object the
+        key was derived from (see ``_entries``)."""
+        with self._lock:
+            hit = self._entries.get(key)
+        if hit is not None:
+            _CACHE_HITS.inc()
+            return hit[0]
+        _CACHE_MISSES.inc()
+        fits = self.enabled and self._bytes + nbytes <= self.budget_bytes
+        with trace_span("ingest.device_put", cat="ingest",
+                        bytes=int(nbytes), cached=bool(fits)):
+            built = build()
+        if not fits:
+            with self._lock:
+                if key not in self._spilled_keys:
+                    self._spilled_keys[key] = (retain, int(nbytes))
+                    self._spilled += int(nbytes)
+                    _CACHE_SPILLED.inc(int(nbytes))
+            return built
+        with self._lock:
+            if key not in self._entries:
+                self._entries[key] = (built, int(nbytes), retain)
+                self._bytes += int(nbytes)
+                _CACHE_BYTES.inc(int(nbytes))
+                _CACHE_ENTRIES.inc()
+        return built
+
+    def discard(self, key) -> None:
+        """Forget one key whose host referent was replaced (the pin — or
+        its once-per-key spill accounting — can never be hit again); no-op
+        for unknown keys. Rolls byte accounting back so a replaced-then-
+        re-fed chunk is not double-counted."""
+        with self._lock:
+            entry = self._entries.pop(key, None)
+            spilled = self._spilled_keys.pop(key, None)
+            if entry is not None:
+                self._bytes -= entry[1]
+            if spilled is not None:
+                self._spilled -= spilled[1]
+        if entry is not None:
+            _CACHE_BYTES.inc(-entry[1])
+            _CACHE_ENTRIES.inc(-1)
+        if spilled is not None:
+            _CACHE_SPILLED.inc(-spilled[1])
+
+    def release(self) -> None:
+        """Drop every pinned entry (device memory frees once consumers drop
+        their own references) and roll the process gauges back."""
+        with self._lock:
+            freed = self._bytes
+            n = len(self._entries)
+            spilled = self._spilled
+            self._entries.clear()
+            self._mirrors.clear()
+            self._spilled_keys.clear()
+            self._bytes = 0
+            self._spilled = 0
+        if freed:
+            _CACHE_BYTES.inc(-freed)
+        if n:
+            _CACHE_ENTRIES.inc(-n)
+        if spilled:
+            _CACHE_SPILLED.inc(-spilled)
+
+    def __del__(self):  # pragma: no cover - GC backstop
+        try:
+            self.release()
+        except Exception:
+            pass
+
+    # -- typed helpers -----------------------------------------------------
+
+    def dataset_mirror(self, dataset):
+        """Device-resident mirror of a ``RandomEffectDataset`` whose buckets
+        are host numpy (``host_resident=True`` builds). The SAME mirror
+        object returns for the cache's lifetime (score/train identity
+        checks — see module doc). Datasets already device-backed, or busting
+        the budget, return the ORIGINAL object (streaming re-upload path).
+        """
+        if not self.enabled:
+            # Disabled cache (budget 0): pure pass-through, like the OOC
+            # chunk path — no mirror bookkeeping, no "spill" telemetry for
+            # a cache the operator explicitly turned off.
+            return dataset
+        key = ("re_dataset", id(dataset))
+        with self._lock:
+            hit = self._mirrors.get(key)
+        if hit is not None:
+            # A spilled dataset's "mirror" is the host original: every
+            # lookup still re-uploads downstream, so it counts as a MISS —
+            # the hit counter must only ever mean "device-resident served".
+            (_CACHE_MISSES if key in self._spilled_keys
+             else _CACHE_HITS).inc()
+            return hit
+        buckets = getattr(dataset, "buckets", ())
+        if not buckets or not isinstance(buckets[0].idx, np.ndarray):
+            # Already device-backed (the default build): nothing to pin.
+            return dataset
+        import jax
+
+        nbytes = sum(_tree_nbytes(b) for b in buckets)
+        fits = self.enabled and self._bytes + nbytes <= self.budget_bytes
+        if not fits:
+            # Spill: the ORIGINAL host-backed object is the (identity-
+            # stable) mirror — every sweep re-uploads, as before the cache.
+            _CACHE_MISSES.inc()
+            with self._lock:
+                if key not in self._mirrors:
+                    self._mirrors[key] = dataset
+                    self._spilled_keys[key] = (dataset, int(nbytes))
+                    self._spilled += int(nbytes)
+                    _CACHE_SPILLED.inc(int(nbytes))
+            return dataset
+        _CACHE_MISSES.inc()
+        with trace_span("ingest.device_put", cat="ingest",
+                        bytes=int(nbytes), cached=True,
+                        what=f"re_dataset:{dataset.re_type}"):
+            dev_buckets = tuple(
+                jax.tree.map(jax.numpy.asarray, b) for b in buckets
+            )
+        mirror = dataclasses.replace(dataset, buckets=dev_buckets)
+        with self._lock:
+            if key not in self._mirrors:
+                self._mirrors[key] = mirror
+                self._entries[key] = (dev_buckets, int(nbytes), dataset)
+                self._bytes += int(nbytes)
+                _CACHE_BYTES.inc(int(nbytes))
+                _CACHE_ENTRIES.inc()
+            mirror = self._mirrors[key]
+        return mirror

@@ -95,6 +95,14 @@ def build_re_dataset_from_bundle(
             f"{cfg.re_type!r}; bundle has {sorted(bundle.id_tags)}"
         )
     val_np = np.asarray(jax.device_get(sf.val))
+    # Follow the bundle's feature precision (float64 under --dtype float64)
+    # — EXCEPT sub-f32 feed dtypes: the bf16 feed narrows the fixed-effect
+    # transfer only, while per-entity solves accumulate in f32 (the batched
+    # Cholesky kernels have no bf16 lowering), so RE buckets re-pack the
+    # already-quantized values as f32.
+    re_dtype = val_np.dtype
+    if re_dtype.itemsize < 4:
+        re_dtype = np.dtype(np.float32)
     return build_random_effect_dataset(
         re_type=cfg.re_type,
         entity_keys_per_row=bundle.id_tags[cfg.re_type],
@@ -111,10 +119,7 @@ def build_re_dataset_from_bundle(
         ),
         max_bucket_entities=cfg.max_bucket_entities,
         host_resident=cfg.host_resident,
-        # Follow the bundle's feature precision (float64 under --dtype
-        # float64) so random effects train at the same precision as the
-        # fixed effect.
-        dtype=val_np.dtype,
+        dtype=re_dtype,
     )
 
 
@@ -152,6 +157,11 @@ class GameEstimator:
     # exceeds this threshold train feature-sharded; smaller ones stay
     # data-parallel (coefficients replicated over the model axis).
     auto_p3_threshold: int = 1 << 20
+    # Device-resident sweep cache budget in MB for host-resident coordinate
+    # data (data/device_cache.py): multi-sweep descent pins those datasets
+    # on device after first touch instead of re-uploading every sweep.
+    # None = PHOTON_SWEEP_CACHE_MB (default 2048); 0 disables.
+    sweep_cache_mb: Optional[float] = None
     seed: int = 0
 
     def __post_init__(self):
@@ -325,6 +335,12 @@ class GameEstimator:
         cached = getattr(self, "_prep_cache", None)
         if cached is not None and cached[0] is data:
             return cached[1]
+        if cached is not None:
+            # New bundle: drop the old bundle's device pins (a tuning loop
+            # switching datasets must not hold both residencies).
+            old_cache = cached[1].get("device_cache")
+            if old_cache is not None:
+                old_cache.release()
         prep = self._prepare(data)
         self._prep_cache = (data, prep)
         return prep
@@ -341,7 +357,15 @@ class GameEstimator:
 
     def _prepare(self, data: GameDataBundle) -> dict:
         """Build per-coordinate datasets + per-shard normalization ONCE."""
+        from photon_tpu.data.device_cache import DeviceSweepCache
+
         prep: dict = {"train": {}, "norm": {}, "batches": {}}
+        # One sweep cache per prepared bundle, shared across the whole
+        # config sweep (same data ⇒ one upload for every λ).
+        prep["device_cache"] = DeviceSweepCache(
+            None if self.sweep_cache_mb is None
+            else int(self.sweep_cache_mb * 1e6)
+        )
         shards_used = {
             c.feature_shard for c in self.coordinate_data_configs.values()
         }
@@ -534,6 +558,15 @@ class GameEstimator:
                     global_reg_mask=mask,
                     normalization=prep["norm"][dcfg.feature_shard],
                     priors=priors,
+                    # The sweep cache pins ONLY the shared prepared dataset:
+                    # a down-sampled dataset is a fresh object per config,
+                    # and pinning each would stack one dead mirror per λ in
+                    # device memory for the estimator's lifetime. Those
+                    # configs stream per sweep — the pre-cache behavior.
+                    device_cache=(
+                        prep.get("device_cache")
+                        if ocfg.down_sampling_rate >= 1.0 else None
+                    ),
                 )
         return coordinates
 

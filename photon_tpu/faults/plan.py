@@ -16,6 +16,10 @@ site                        where / what a fired fault simulates
 ==========================  ================================================
 ``io.block_read``           per Avro block in the streaming ingest
                             (transient/permanent read errors)
+``io.prefetch``             per chunk on the prefetch producer thread
+                            (``io/prefetch.py``; a fired error kills the
+                            background decode stage mid-stream and must
+                            surface at the consumer)
 ``io.record_read``          per file on the per-record fallback reader
 ``checkpoint.write``        background checkpoint writer, before the write
                             (disk-full / fs hiccup mid-snapshot)

@@ -110,14 +110,29 @@ class RandomEffectCoordinate:
     # Per-bucket PriorDistribution pytrees for incremental training
     # (RandomEffectModel.project_prior_to output).
     priors: Optional[Sequence] = None
+    # Device-resident sweep cache (data/device_cache.py): host-resident
+    # bucket datasets pin on device at first touch, so sweep 1+ of a
+    # multi-sweep descent (train AND score) stops re-uploading per bucket.
+    # The cache's mirror is identity-stable, so _same_structure keeps
+    # detecting "trained on this dataset" across sweeps.
+    device_cache: Optional[object] = None
+
+    def _data(self) -> RandomEffectDataset:
+        """The dataset every train/score consumes: the device-resident
+        mirror when a sweep cache holds it, else the original (device-backed
+        builds and budget-busted spills are both the original object)."""
+        if self.device_cache is None:
+            return self.dataset
+        return self.device_cache.dataset_mirror(self.dataset)
 
     def _same_structure(self, model: RandomEffectModel) -> bool:
         # A model trained on THIS dataset (every coordinate-descent sweep)
         # shares bucket structure by object identity. Anything else — a
         # loaded model, a model from different data — must be re-projected
         # into this dataset's bucket/subspace structure.
-        return len(model.bucket_coefs) == len(self.dataset.buckets) and all(
-            p is b.proj for p, b in zip(model.bucket_proj, self.dataset.buckets)
+        dataset = self._data()
+        return len(model.bucket_coefs) == len(dataset.buckets) and all(
+            p is b.proj for p, b in zip(model.bucket_proj, dataset.buckets)
         )
 
     def _init_coefs(self, init: Optional[RandomEffectModel]):
@@ -126,12 +141,12 @@ class RandomEffectCoordinate:
         return (
             init.bucket_coefs
             if self._same_structure(init)
-            else init.project_to(self.dataset)
+            else init.project_to(self._data())
         )
 
     def train(self, offsets: Array, init: Optional[RandomEffectModel] = None):
         return train_random_effects(
-            self.problem, self.dataset, offsets,
+            self.problem, self._data(), offsets,
             mesh=self.mesh, entity_axis=self.entity_axis,
             global_reg_mask=self.global_reg_mask,
             init_coefs=self._init_coefs(init),
@@ -140,11 +155,12 @@ class RandomEffectCoordinate:
         )
 
     def score(self, model: RandomEffectModel) -> Array:
+        dataset = self._data()
         if self._same_structure(model):
-            return model.score_dataset(self.dataset)
+            return model.score_dataset(dataset)
         # Foreign model (loaded warm start / locked coordinate): project its
         # per-entity coefficients into this dataset's structure first.
-        return model.score_new_dataset(self.dataset)
+        return model.score_new_dataset(dataset)
 
 
 @dataclasses.dataclass(frozen=True)

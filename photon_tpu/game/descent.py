@@ -23,7 +23,7 @@ import numpy as np
 from photon_tpu.evaluation import EvaluationResults, EvaluationSuite
 from photon_tpu.faults import fault_point
 from photon_tpu.game.coordinates import Coordinate, DatumScoringModel
-from photon_tpu.obs import trace_span
+from photon_tpu.obs import instant, trace_span
 
 Array = jax.Array
 
@@ -265,6 +265,17 @@ class CoordinateDescent:
                     )
                 step += 1
             sweep_span.__exit__(None, None, None)
+            # Sweep-cache residency marker (data/device_cache.py): the
+            # timeline shows per sweep whether the dataset was device-pinned
+            # (sweep 1+ re-uploading here is the regression the cache
+            # exists to kill — docs/scaling.md §"Data path").
+            from photon_tpu.obs.metrics import REGISTRY as _REG
+
+            instant(
+                "cache.sweep_residency", cat="ingest", sweep=sweep,
+                resident_bytes=_REG.gauge("sweep_cache_bytes").value(),
+                spilled_bytes=_REG.gauge("sweep_cache_spilled_bytes").value(),
+            )
             # Arm after the first sweep that executed EVERY coordinate step
             # (a resumed run's first sweep may be partial, leaving later
             # coordinates' shapes uncompiled — warming then would turn their

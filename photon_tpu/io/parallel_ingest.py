@@ -38,7 +38,7 @@ from photon_tpu.index.index_map import (
     feature_key,
 )
 
-__all__ = ["read_parallel"]
+__all__ = ["read_parallel", "iter_chunks_parallel"]
 
 
 def _index_spec(im: IndexMap):
@@ -174,6 +174,99 @@ def _worker_file(args) -> tuple:
         )
     ]
     return pos, payloads
+
+
+def iter_chunks_parallel(
+    paths,
+    index_maps: Mapping[str, IndexMap],
+    shard_configs: Mapping[str, object],
+    columns=None,
+    id_tag_columns: Sequence[str] = (),
+    n_workers: int = 0,
+    chunk_rows: int = 1 << 20,
+    capture_uids: bool = True,
+    dtype=np.float32,
+    require_labels: bool = True,
+):
+    """Stream ``GameDataChunk``s decoded by ``n_workers`` processes, in the
+    exact global order of a sequential read.
+
+    The worker-pool analog of ``StreamingAvroReader.iter_chunks`` — the feed
+    stage ``io/prefetch.py`` builds on: the ORDERED ``imap`` keeps per-file
+    results arriving in submission (= file) order while the pool decodes up
+    to ``n_workers`` files ahead, so the consumer overlaps whatever it does
+    per chunk with the remaining decode. A worker crash (pool teardown,
+    corrupt file) surfaces at the consumer's next pull — fast-fail, never a
+    hang — and abandoning the generator terminates the pool. Falls back to
+    the in-process reader for ``n_workers <= 1``; raises ``Unsupported``
+    when the native decoder is unavailable, like the sequential path.
+    """
+    from photon_tpu import native
+    from photon_tpu.io.data_reader import InputColumnNames, _expand_paths
+    from photon_tpu.io.streaming import StreamingAvroReader, Unsupported
+
+    if native.get_lib() is None:
+        raise Unsupported("native decoder unavailable")
+    columns = columns or InputColumnNames()
+    files = _expand_paths(paths)
+    n_workers = min(int(n_workers), len(files))
+    if n_workers <= 1:
+        yield from StreamingAvroReader(
+            index_maps, shard_configs, columns, id_tag_columns,
+            chunk_rows=chunk_rows, capture_uids=capture_uids,
+        ).iter_chunks(files, dtype=dtype, require_labels=require_labels)
+        return
+
+    cfg = _WorkerConfig(
+        index_specs={s: _index_spec(m) for s, m in index_maps.items()},
+        shard_configs=dict(shard_configs),
+        columns=columns,
+        id_tag_columns=tuple(id_tag_columns),
+        chunk_rows=chunk_rows,
+        capture_uids=capture_uids,
+        dtype=np.dtype(dtype).name,
+        require_labels=require_labels,
+    )
+    jobs = iter((cfg, pos, f) for pos, f in enumerate(files))
+    import collections
+    import concurrent.futures as cf
+    import itertools
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    # ProcessPoolExecutor, NOT mp.Pool: an abruptly-dead worker (OOM kill,
+    # SIGKILL) raises BrokenProcessPool at result() — mp.Pool silently
+    # replaces the worker, loses the job, and a .get() on it hangs forever,
+    # which would wedge the training driver's default ingest.
+    with cf.ProcessPoolExecutor(max_workers=n_workers,
+                                mp_context=ctx) as pool:
+        try:
+            # Bounded submission window, not submit-everything: a slow
+            # streaming consumer must bound parent-side buffering to
+            # ~n_workers+1 files' payloads, never accumulate the whole
+            # decoded dataset (the constant-memory contract this iterator
+            # exists for). Results are consumed in submission (= file =
+            # global row) order; worker exceptions AND worker death
+            # surface at result() — fast-fail, never a hang.
+            pending: collections.deque = collections.deque(
+                pool.submit(_worker_file, job)
+                for job in itertools.islice(jobs, n_workers + 1)
+            )
+            while pending:
+                _pos, payloads = pending.popleft().result()
+                nxt = next(jobs, None)
+                if nxt is not None:
+                    pending.append(pool.submit(_worker_file, nxt))
+                for p in payloads:
+                    yield _payload_chunk(p)
+        except BaseException:
+            # Worker failure OR abandoned consumer: drop queued work so the
+            # with-exit's shutdown(wait=True) only drains files already
+            # RUNNING — without this a corrupt file's error would sit
+            # behind minutes of pointless decode of every queued file.
+            for fut in pending:
+                fut.cancel()
+            raise
 
 
 def read_parallel(

@@ -1,0 +1,334 @@
+"""Pipelined ingest→device data path: prefetched decode + double-buffered
+host→device transfer.
+
+Why: BENCH_r05 put ``fraction_of_roofline`` ≈ 0.15 and the PR 6 timeline
+analyzer's overlap verdict on the smoke bench at ``serialized`` (0.0) —
+after the PR 4 Newton work the optimizers are no longer the bottleneck,
+feeding them is. Upstream photon-ml never paid this cost: spark-avro block
+decode runs inside the executor pipeline, concurrently with the
+``treeAggregate`` passes (PAPER.md survey). photon-tpu decoded blocks,
+uploaded, and computed strictly in sequence. This module is the pipeline:
+
+* :func:`prefetch` — a bounded background stage running any chunk iterator
+  (``StreamingAvroReader.iter_chunks``, or the ``parallel_ingest`` worker
+  pool via :func:`iter_chunks_pipelined`) on a producer thread, so block
+  decode of chunk *N+1* overlaps whatever the consumer does with chunk *N*.
+  The native decoder releases the GIL inside ``ph_decode_block``, so the
+  overlap is real even single-process. Queue depth bounds host memory
+  (``depth`` × chunk size); the consumer's blocking get is traced as an
+  ``ingest.prefetch_queue_wait`` span (the analyzer's ``*queue_wait*``
+  breakdown picks it up), and the producer loop carries an ``io.prefetch``
+  fault point so the chaos suite can kill the stage mid-stream.
+* :func:`pipelined_puts` — double-buffered ``device_put``: the transfer for
+  item *N+1* is issued before item *N* is yielded to the consumer, so on an
+  accelerator backend H2D DMA for the next chunk runs while the current
+  chunk computes. ``donate=True`` is requested where the runtime supports
+  it so the staging buffer's pages move instead of copying.
+* :func:`device_put_chunk` / :func:`read_bundle_pipelined` — the composed
+  path from Avro files to device-backed chunks / a ``GameDataBundle``,
+  with an opt-in **bf16 feed** (``feed_dtype``): feature values are
+  narrowed to bfloat16 ON THE HOST (``ml_dtypes``) before ``device_put``,
+  halving transfer bytes on the hot path, while every consumer kernel
+  accumulates in f32 via dtype promotion (``SparseFeatures.matvec``
+  multiplies bf16 values against an f32 coefficient gather — tolerance-
+  gated in tests/test_prefetch.py like the PR 1 dtype work).
+
+The multi-sweep device-residency half of the data path (pin the dataset on
+device after sweep 0) lives in ``photon_tpu/data/device_cache.py``; the
+out-of-core solver threads both through its streamed passes
+(``optim/out_of_core.py``).
+"""
+from __future__ import annotations
+
+import collections
+import os
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, Optional
+
+import numpy as np
+
+from photon_tpu.faults import fault_point
+from photon_tpu.obs import trace_span
+from photon_tpu.obs.metrics import REGISTRY
+
+__all__ = [
+    "default_prefetch_depth",
+    "prefetch",
+    "pipelined_puts",
+    "device_put_chunk",
+    "iter_chunks_pipelined",
+    "read_bundle_pipelined",
+    "host_feed_array",
+]
+
+_PREFETCHED_CHUNKS = REGISTRY.counter(
+    "ingest_prefetch_chunks_total",
+    "Chunks decoded ahead by the ingest prefetch stage",
+)
+_FEED_BYTES = REGISTRY.counter(
+    "ingest_device_put_bytes_total",
+    "Bytes shipped host->device by the pipelined ingest feed",
+)
+
+
+def default_prefetch_depth() -> int:
+    """Queue bound for the background decode stage (``PHOTON_PREFETCH_DEPTH``;
+    0 disables prefetching entirely)."""
+    try:
+        return max(0, int(os.environ.get("PHOTON_PREFETCH_DEPTH", "2")))
+    except ValueError:
+        return 2
+
+
+def prefetch(iterable: Iterable, depth: Optional[int] = None) -> Iterator:
+    """Yield from ``iterable`` while a background thread runs it ``depth``
+    items ahead.
+
+    Exceptions from the producer (including an ``OSError`` that outlived
+    ``io_retries`` inside ``iter_blocks_with_retry``) re-raise at the
+    consumer's next pull, in order — a failing stream fails the pipeline,
+    never hangs it. Abandoning the generator (``close()`` / GC) stops the
+    producer promptly: it checks a stop flag around every bounded put.
+
+    ``depth <= 0`` degrades to plain iteration (no thread) so callers can
+    thread one knob through unconditionally.
+    """
+    if depth is None:
+        depth = default_prefetch_depth()
+    if depth <= 0:
+        yield from iterable
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    END = object()
+
+    def produce() -> None:
+        try:
+            n = 0
+            for item in iterable:
+                fault_point("io.prefetch", item=n)
+                n += 1
+                _PREFETCHED_CHUNKS.inc()
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            _put_end(None)
+        except BaseException as e:  # noqa: BLE001 - relayed to the consumer
+            _put_end(e)
+
+    def _put_end(err) -> None:
+        while not stop.is_set():
+            try:
+                q.put((END, err), timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    t = threading.Thread(target=produce, name="photon-prefetch", daemon=True)
+    t.start()
+    try:
+        while True:
+            with trace_span("ingest.prefetch_queue_wait", cat="ingest"):
+                item = q.get()
+            if isinstance(item, tuple) and len(item) == 2 and item[0] is END:
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield item
+    finally:
+        stop.set()
+        # Drain so a producer blocked on a full queue can observe the stop
+        # flag and exit before the (bounded) join.
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass
+        t.join(timeout=5.0)
+
+
+def pipelined_puts(items: Iterable, put: Callable, ahead: int = 1) -> Iterator:
+    """Apply ``put`` (typically a ``device_put`` wrapper) to each item,
+    keeping ``ahead`` results in flight: the transfer for item N+1 is issued
+    before item N is yielded, so async backends overlap the next chunk's H2D
+    DMA with the current chunk's compute (double buffer at ``ahead=1``)."""
+    pending: collections.deque = collections.deque()
+    for item in items:
+        pending.append(put(item))
+        while len(pending) > max(ahead, 0):
+            yield pending.popleft()
+    while pending:
+        yield pending.popleft()
+
+
+def host_feed_array(a: np.ndarray, feed_dtype=None) -> np.ndarray:
+    """Narrow a host value array to the feed dtype ON THE HOST (so the wire
+    transfer itself shrinks — casting after ``device_put`` would ship f32).
+    ``ml_dtypes`` supplies the numpy bfloat16; identity when ``feed_dtype``
+    is None or already matches."""
+    if feed_dtype is None:
+        return a
+    import ml_dtypes  # ships with jax
+
+    dt = np.dtype(feed_dtype) if not isinstance(feed_dtype, str) else None
+    if dt is None:
+        dt = np.dtype(
+            ml_dtypes.bfloat16 if feed_dtype == "bfloat16" else feed_dtype
+        )
+    if a.dtype == dt:
+        return a
+    return a.astype(dt)
+
+
+def _device_put(x, donate: bool = True):
+    """``jax.device_put`` requesting input-buffer donation where the runtime
+    accepts it (a donated staging buffer moves instead of copying; numpy
+    inputs that cannot donate fall back to the plain copy path)."""
+    import jax
+
+    if donate:
+        try:
+            return jax.device_put(x, donate=True)
+        except (TypeError, ValueError):
+            pass
+    return jax.device_put(x)
+
+
+def device_put_chunk(chunk, feed_dtype=None, donate: bool = True):
+    """One streamed ``GameDataChunk``, numeric payload moved to device.
+
+    Features (ELL idx/val), labels, offsets, and weights become device
+    arrays; uid/tag dictionary columns stay host (they are never device
+    operands). ``feed_dtype`` narrows the feature VALUES on the host first
+    (bf16 feed). The whole transfer is one ``ingest.device_put`` span so
+    the timeline analyzer sees the feed as ingest work.
+    """
+    import jax.numpy as jnp
+
+    from photon_tpu.data.batch import SparseFeatures
+    from photon_tpu.io.streaming import GameDataChunk
+
+    with trace_span("ingest.device_put", cat="ingest",
+                    rows=chunk.n_rows) as sp:
+        features = {}
+        for s, sf in chunk.features.items():
+            val = host_feed_array(np.asarray(sf.val), feed_dtype)
+            features[s] = SparseFeatures(
+                idx=_device_put(np.asarray(sf.idx), donate=False),  # shared
+                val=_device_put(val, donate=donate and val is not sf.val),
+                dim=sf.dim,
+            )
+        out = GameDataChunk(
+            labels=jnp.asarray(chunk.labels),
+            offsets=jnp.asarray(chunk.offsets),
+            weights=jnp.asarray(chunk.weights),
+            uids=chunk.uids,
+            id_tags=chunk.id_tags,
+            features=features,
+        )
+        # Bytes from the PRODUCED device arrays, not the host inputs: the
+        # runtime narrows f64 row columns to f32 (x64 off) and the bf16
+        # feed halves values — the tracked ingest_to_device figure must
+        # report what actually moved, not the host-side staging size.
+        nbytes = out.labels.nbytes + out.offsets.nbytes + out.weights.nbytes
+        for sf in out.features.values():
+            nbytes += sf.idx.nbytes + sf.val.nbytes
+        sp.set(bytes=int(nbytes))
+    _FEED_BYTES.inc(int(nbytes))
+    return out
+
+
+def iter_chunks_pipelined(
+    reader,
+    paths,
+    dtype=np.float32,
+    require_labels: bool = True,
+    depth: Optional[int] = None,
+    workers: int = 0,
+    to_device: bool = False,
+    feed_dtype=None,
+) -> Iterator:
+    """``StreamingAvroReader.iter_chunks`` behind the prefetch stage.
+
+    ``workers > 1`` decodes file shards on the ``parallel_ingest`` worker
+    pool (chunks stream back in exact file order) instead of in-process;
+    ``to_device=True`` additionally runs the double-buffered device feed so
+    the yielded chunks carry device arrays (chunk *N+1* decodes and uploads
+    while chunk *N* computes).
+    """
+    if workers and workers > 1:
+        from photon_tpu.io.parallel_ingest import iter_chunks_parallel
+
+        src = iter_chunks_parallel(
+            paths,
+            reader.index_maps,
+            reader.shard_configs,
+            reader.columns,
+            reader.id_tag_columns,
+            n_workers=workers,
+            chunk_rows=reader.chunk_rows,
+            capture_uids=reader.capture_uids,
+            dtype=dtype,
+            require_labels=require_labels,
+        )
+    else:
+        src = reader.iter_chunks(paths, dtype=dtype,
+                                 require_labels=require_labels)
+    out = prefetch(src, depth=depth)
+    if to_device:
+        out = pipelined_puts(
+            out, lambda c: device_put_chunk(c, feed_dtype=feed_dtype),
+            ahead=1,
+        )
+    return out
+
+
+def read_bundle_pipelined(
+    index_maps,
+    shard_configs,
+    columns,
+    id_tag_columns,
+    paths,
+    dtype=np.float32,
+    require_labels: bool = True,
+    capture_uids: bool = False,
+    depth: Optional[int] = None,
+    workers: int = 0,
+    feed_dtype=None,
+    chunk_rows: int = 1 << 20,
+    io_retries: int = 2,
+    reader=None,
+):
+    """Full-dataset read through the prefetched decode stage: block decode
+    of chunk N+1 runs on the producer thread while the consumer assembles
+    chunk N into the bundle. Same rows, same order, bit-identical to a
+    sequential ``StreamingAvroReader.read`` (tested); raises
+    ``io.streaming.Unsupported`` exactly when the sequential path would, so
+    callers keep their per-record fallback.
+
+    Pass a ``reader`` (``StreamingAvroReader``) to reuse its compiled decode
+    programs and per-shard hash tables across calls (a train+validation run
+    must not build the 100K+-feature probe tables twice); when given, it
+    overrides the construction args."""
+    from photon_tpu.io.streaming import StreamingAvroReader, chunks_to_bundle
+
+    if reader is None:
+        reader = StreamingAvroReader(
+            index_maps, shard_configs, columns, id_tag_columns,
+            chunk_rows=chunk_rows, capture_uids=capture_uids,
+            io_retries=io_retries,
+        )
+    chunks = list(iter_chunks_pipelined(
+        reader, paths, dtype=dtype, require_labels=require_labels,
+        depth=depth, workers=workers,
+    ))
+    return chunks_to_bundle(
+        chunks, index_maps, id_tag_columns, dtype, feed_dtype=feed_dtype,
+    )

@@ -40,6 +40,7 @@ from __future__ import annotations
 import ctypes
 import dataclasses
 import logging
+import os
 import time
 from typing import Callable, Iterator, Mapping, Optional, Sequence
 
@@ -1013,6 +1014,8 @@ class StreamingAvroReader:
         # uid capture costs one dictionary entry per (typically unique) row;
         # bulk training flows that never write scores back can disable it.
         self.capture_uids = bool(capture_uids)
+        self._uid_rows_seen = 0
+        self._uid_growth_warned = False
         self._intercepts = {
             shard: self.index_maps[shard].get_index(INTERCEPT_NAME, INTERCEPT_TERM)
             for shard, cfg in self.shard_configs.items()
@@ -1083,7 +1086,39 @@ class StreamingAvroReader:
         with trace_span("ingest.chunk", cat="ingest") as sp:
             chunk = self._assemble_chunk(dec, dtype, require_labels)
             sp.set(rows=chunk.n_rows)
+        self._note_uid_growth(dec, chunk.n_rows)
         return chunk
+
+    def _note_uid_growth(self, dec: NativeDecoder, n_rows: int) -> None:
+        """One-time warning when ``capture_uids=True`` has interned enough
+        rows that the uid dictionary plausibly dominates host memory (it
+        grows with UNIQUE uids, i.e. ~every row on training data — the
+        caveat that used to live only in the module docstring). Threshold
+        via ``PHOTON_UID_WARN_ROWS`` (rows; 0 disables)."""
+        if not self.capture_uids or self._uid_growth_warned:
+            return
+        self._uid_rows_seen += int(n_rows)
+        try:
+            threshold = int(os.environ.get("PHOTON_UID_WARN_ROWS",
+                                           str(10_000_000)))
+        except ValueError:
+            threshold = 10_000_000
+        if threshold <= 0 or self._uid_rows_seen < threshold:
+            return
+        self._uid_growth_warned = True
+        try:  # "__uid__" is string column 0 by construction (compile_program)
+            dict_entries = int(dec.lib.ph_dict_size(dec.state, 0))
+        except Exception:  # noqa: BLE001 - the warning must never kill ingest
+            dict_entries = -1
+        logger.warning(
+            "capture_uids=True has streamed %d rows; the uid dictionary "
+            "holds %s unique entries and grows with unique uids for the "
+            "whole read — pass capture_uids=False on bulk training flows "
+            "that never read uids back (PHOTON_UID_WARN_ROWS tunes or "
+            "disables this warning)",
+            self._uid_rows_seen,
+            dict_entries if dict_entries >= 0 else "unknown",
+        )
 
     def _assemble_chunk(self, dec: NativeDecoder, dtype, require_labels) -> GameDataChunk:
         raw = dec.take_chunk(
@@ -1155,9 +1190,15 @@ def chunks_to_bundle(
     index_maps: Mapping[str, IndexMap],
     id_tag_columns: Sequence[str],
     dtype=np.float32,
+    feed_dtype=None,
 ):
     """Concatenate streamed chunks (in order) into one GameDataBundle —
-    shared by in-process reads and the parallel-ingest reassembly."""
+    shared by in-process reads and the parallel-ingest reassembly.
+
+    ``feed_dtype`` (e.g. ``"bfloat16"``) narrows the feature VALUE arrays on
+    the host before the device upload — the bf16 feed: half the transfer
+    bytes, f32 accumulation downstream via dtype promotion (see
+    ``io/prefetch.py``)."""
     import jax.numpy as jnp
 
     from photon_tpu.io.data_reader import GameDataBundle
@@ -1201,6 +1242,10 @@ def chunks_to_bundle(
             iarr[at:at + m, :kk] = sf.idx
             varr[at:at + m, :kk] = sf.val
             at += m
+        if feed_dtype is not None:
+            from photon_tpu.io.prefetch import host_feed_array
+
+            varr = host_feed_array(varr, feed_dtype)
         features[shard] = SparseFeatures(
             idx=jnp.asarray(iarr), val=jnp.asarray(varr), dim=dim
         )

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.data.batch import SparseFeatures
+from photon_tpu.obs import trace_span
 from photon_tpu.optim.base import (
     FUNCTION_VALUES_CONVERGED,
     MAX_ITERATIONS,
@@ -276,6 +277,99 @@ class ChunkedGLMData:
             [np.asarray(x) for x in self.weights])[: self.n_rows]
 
 
+class StreamPrimer:
+    """First optimizer pass computed per chunk AS IT STREAMS IN.
+
+    Pass an instance as ``ChunkedGLMData.from_stream(..., on_chunk=primer)``:
+    the moment chunk *i* is assembled, its ELL arrays go to device (through
+    the sweep cache when given, so pass 1 of the solve reuses the upload)
+    and the chunk's initial scores ``z = X·w0 + offsets`` and data
+    value/gradient contribution are computed inside an
+    ``optim.stream_init_pass`` span — so with a prefetched chunk iterator
+    (``io/prefetch.py``) the solve's init pass overlaps block decode instead
+    of running after it, and ``optimize(..., primed=primer.primed())`` skips
+    its two init passes entirely. The per-chunk kernels and accumulation
+    order are EXACTLY the solver's own (``_kernels_for``; f/g accumulate
+    chunk 0..n−1), so a primed solve is bit-identical to an unprimed one.
+
+    Single-device only: a mesh solve row-shards its resident vectors and
+    ignores ``primed`` (documented in ``optimize``).
+    """
+
+    def __init__(self, loss, dim: int, w0=None, device_cache=None):
+        self.dim = int(dim)
+        self._kernels = _kernels_for(loss, dim)
+        self.w0 = (jnp.zeros((dim,), jnp.float32) if w0 is None
+                   else jnp.asarray(w0, jnp.float32))
+        self.device_cache = device_cache
+        self.z: list = []
+        self.fd = jnp.zeros((), jnp.float32)
+        self.gd = jnp.zeros((dim,), jnp.float32)
+        self._fed_keys: list = []
+        self._chunks_seen: list = []
+        self._ell_width: Optional[int] = None
+
+    def __call__(self, i, host_chunk, labels, offsets, weights) -> None:
+        k_matvec, _k_probe, _k_probe_t, k_grad = self._kernels
+        # from_stream REGROWS already-flushed chunks in place when the ELL
+        # width widens mid-stream: every pin this primer made for the old
+        # (now freed) arrays can never be hit again — discard them so the
+        # budget holds live data, not orphans. The z/f/g already computed
+        # stay exact (regrow only adds ghost padding).
+        width = int(host_chunk.idx.shape[1])
+        if (self.device_cache is not None and self._ell_width is not None
+                and width != self._ell_width):
+            for k in self._fed_keys:
+                self.device_cache.discard(k)
+            self._fed_keys.clear()
+        self._ell_width = width
+        # Feed FIRST, outside the compute span: the timeline analyzer's
+        # overlap report must never count a same-thread H2D nested inside a
+        # compute span as "ingest concurrent with compute".
+        ci, cv = _feed_chunk(host_chunk, self.device_cache,
+                             lambda a: jnp.asarray(a))
+        if self.device_cache is not None:
+            self._fed_keys.append(("ooc_ell", id(host_chunk.idx)))
+        self._chunks_seen.append(host_chunk)
+        with trace_span("optim.stream_init_pass", cat="optim", chunk=i,
+                        rows=int(labels.shape[0])):
+            z = k_matvec(self.w0, ci, cv, offsets)
+            fc, gc = k_grad(z, labels, weights, ci, cv)
+            self.z.append(z)
+            self.fd = self.fd + fc
+            self.gd = self.gd + gc
+
+    def primed(self) -> dict:
+        """State for ``optimize(..., primed=...)``: resident margins plus
+        the DATA-ONLY value/gradient at ``w0`` (the solver adds its own
+        regularizer terms), stamped with the chunk objects the pass ran
+        over so a prime from a DIFFERENT dataset can never be trusted."""
+        return {"z": self.z, "fd": self.fd, "gd": self.gd, "w0": self.w0,
+                "chunks": list(self._chunks_seen)}
+
+
+def _feed_chunk(c: "_HostChunk", cache, put):
+    """(idx, val) of one host chunk on device — through the sweep cache when
+    given (multi-sweep/multi-pass solves stop re-uploading), else a traced
+    one-shot transfer. Keys by the ARRAY identity so a regrown chunk (new
+    arrays) re-uploads instead of serving stale width."""
+    if cache is not None and cache.enabled:
+        return cache.get_or_put(
+            ("ooc_ell", id(c.idx)),
+            c.idx.nbytes + c.val.nbytes,
+            lambda: (put(c.idx), put(c.val)),
+            # Pin the keyed host array: a regrown chunk frees its original
+            # arrays, and a recycled id() must never alias a NEW chunk onto
+            # this (stale) device entry.
+            retain=c.idx,
+        )
+    from photon_tpu.obs import trace_span as _span
+
+    with _span("ingest.device_put", cat="ingest",
+               bytes=int(c.idx.nbytes + c.val.nbytes), cached=False):
+        return put(c.idx), put(c.val)
+
+
 @functools.lru_cache(maxsize=None)
 def _matvec_for(dim: int):
     @jax.jit
@@ -382,6 +476,11 @@ class OutOfCoreLBFGS:
     # re-cast as GSPMD (SURVEY.md §2.2 "Distributed objective").
     mesh: Optional[object] = None
     data_axis: str = "data"
+    # Device-resident sweep cache (photon_tpu/data/device_cache.py): streamed
+    # ELL chunks pin on device after the first pass that touches them, so a
+    # multi-iteration solve (and a multi-sweep GAME fit re-entering it) stops
+    # re-uploading the dataset — budget-gated, spills back to streaming.
+    device_cache: Optional[object] = None
 
     # -- jitted per-chunk kernels -----------------------------------------
 
@@ -407,35 +506,70 @@ class OutOfCoreLBFGS:
         offsets = data.offsets = [put_row(x) for x in data.offsets]
         weights = data.weights = [put_row(x) for x in data.weights]
 
+        # The no-mesh put is an EXPLICIT device commit (jnp.asarray), not
+        # the identity: relying on the kernel call's implicit conversion
+        # would re-upload every pass even when the sweep cache "holds" the
+        # chunk (it would be pinning host numpy). Mesh solves keep the
+        # sharded device_put, which commits directly to the right layout.
+        put_dev = put_ell if self.mesh is not None else jnp.asarray
+
+        def ell_feed():
+            """Per-pass (idx, val) device feed, DOUBLE-BUFFERED: chunk i+1's
+            transfer is issued before chunk i is handed to its kernel, so an
+            async backend overlaps the next H2D with the current compute.
+            Chunks pinned by the sweep cache skip the transfer entirely."""
+            from photon_tpu.io.prefetch import pipelined_puts
+
+            return pipelined_puts(
+                data.chunks,
+                lambda c: _feed_chunk(c, self.device_cache, put_dev),
+                ahead=1,
+            )
+
+        # Per-chunk compute spans (cat "optim") cover ONLY the kernel call;
+        # the feed is pulled from the generator BEFORE the span opens, so
+        # the analyzer's ingest/compute overlap never credits a same-thread
+        # serial H2D as concurrency. (Spans measure dispatch wall, the
+        # repo-wide convention for async backends.)
         def stream_scores(wv, with_offsets=True):
             zero = jnp.zeros_like(offsets[0])
-            return [
-                k_matvec(wv, put_ell(c.idx), put_ell(c.val),
-                         offsets[i] if with_offsets else zero)
-                for i, c in enumerate(data.chunks)
-            ]
+            out = []
+            for i, (ci, cv) in enumerate(ell_feed()):
+                with trace_span("optim.ooc_scores_chunk", cat="optim",
+                                chunk=i):
+                    out.append(
+                        k_matvec(wv, ci, cv,
+                                 offsets[i] if with_offsets else zero)
+                    )
+            return out
 
         def data_value(z_chunks):
-            return sum(
-                k_probe(z, labels[i], weights[i])
-                for i, z in enumerate(z_chunks)
-            )
+            with trace_span("optim.ooc_probe", cat="optim",
+                            chunks=len(z_chunks)):
+                return sum(
+                    k_probe(z, labels[i], weights[i])
+                    for i, z in enumerate(z_chunks)
+                )
 
         def data_value_at_t(z_chunks, zd_chunks, t):
             """Line-search probe f_data(z + t·zd), fused per chunk."""
             t = jnp.asarray(t, jnp.float32)
-            return sum(
-                k_probe_at_t(z, zd, t, labels[i], weights[i])
-                for i, (z, zd) in enumerate(zip(z_chunks, zd_chunks))
-            )
+            with trace_span("optim.ooc_probe", cat="optim",
+                            chunks=len(z_chunks)):
+                return sum(
+                    k_probe_at_t(z, zd, t, labels[i], weights[i])
+                    for i, (z, zd) in enumerate(zip(z_chunks, zd_chunks))
+                )
 
         def stream_grad(z_chunks):
             f = jnp.zeros((), jnp.float32)
             g = jnp.zeros((data.dim,), jnp.float32)
-            for i, (z, c) in enumerate(zip(z_chunks, data.chunks)):
-                fc, gc = k_grad(z, labels[i], weights[i],
-                                put_ell(c.idx), put_ell(c.val))
-                f, g = f + fc, g + gc
+            for i, (ci, cv) in enumerate(ell_feed()):
+                with trace_span("optim.ooc_grad_chunk", cat="optim",
+                                chunk=i):
+                    fc, gc = k_grad(z_chunks[i], labels[i], weights[i],
+                                    ci, cv)
+                    f, g = f + fc, g + gc
             return f, g
 
         return (put_rep, stream_scores, data_value, data_value_at_t,
@@ -561,7 +695,33 @@ class OutOfCoreLBFGS:
         except OSError:
             pass  # best-effort: a failed save must never kill the solve
 
-    def optimize(self, data: ChunkedGLMData, x0: Array) -> OptimizerResult:
+    def _primed_init(self, primed, data: ChunkedGLMData, w) -> Optional[tuple]:
+        """(z, fd, gd) from a :class:`StreamPrimer` when it is usable for
+        THIS solve: the prime's pass ran over EXACTLY these chunk objects
+        (identity-checked — a prime from a different dataset, or from
+        chunks replaced by a mid-stream regrow, must never be trusted), at
+        exactly this start point, no mesh (the primer's margins are
+        unsharded). Unusable primes fall back to the fresh init passes —
+        correctness never depends on the pipeline.
+        """
+        if primed is None or self.mesh is not None:
+            return None
+        z = primed.get("z") or []
+        chunks = primed.get("chunks") or []
+        if len(z) != data.n_chunks or len(chunks) != data.n_chunks or any(
+                a is not b for a, b in zip(chunks, data.chunks)):
+            return None
+        w0 = primed.get("w0")
+        if w0 is None or w0.shape != w.shape or not bool(
+                jnp.all(w0 == w)):
+            return None
+        return z, primed["fd"], primed["gd"]
+
+    def optimize(self, data: ChunkedGLMData, x0: Array,
+                 primed: Optional[dict] = None) -> OptimizerResult:
+        """``primed`` (from :class:`StreamPrimer`) carries the init pass
+        computed while the data streamed in; a valid prime skips the two
+        init passes (scores + gradient) bit-identically."""
         cfg = self.config
         dim = data.dim
         (put_rep, stream_scores, data_value, data_value_at_t,
@@ -583,10 +743,21 @@ class OutOfCoreLBFGS:
             z = stream_scores(w)  # scores rebuild from w: one pass
             passes += 1
         else:
-            # init: one scores pass + one grad pass
-            z = stream_scores(w)
-            f, g = full_fg(w, z)
-            passes = 2
+            prime = self._primed_init(primed, data, w)
+            if prime is not None:
+                # The init already ran during ingest as ONE fused pass per
+                # chunk (scores + grad off the same feed) — data_passes is
+                # a measured count, so the prime records 1, not the
+                # unprimed path's 2.
+                z, fd, gd = prime
+                f = fd + 0.5 * jnp.sum(l2v * w * w)
+                g = gd + l2v * w
+                passes = 1
+            else:
+                # init: one scores pass + one grad pass
+                z = stream_scores(w)
+                f, g = full_fg(w, z)
+                passes = 2
             gnorm0 = jnp.linalg.norm(g)
             hist = empty_history(cfg.history_length, dim, jnp.float32)
             values = np.full(max_it + 1, np.inf, np.float32)
@@ -714,7 +885,8 @@ class OutOfCoreOWLQN(OutOfCoreLBFGS):
             return jnp.full_like(w, self.l1_weight)
         return self.l1_weight * self.reg_mask.astype(w.dtype)
 
-    def optimize(self, data: ChunkedGLMData, x0: Array) -> OptimizerResult:
+    def optimize(self, data: ChunkedGLMData, x0: Array,
+                 primed: Optional[dict] = None) -> OptimizerResult:
         cfg = self.config
         dim = data.dim
         (put_rep, stream_scores, data_value, data_value_at_t,
@@ -752,9 +924,17 @@ class OutOfCoreOWLQN(OutOfCoreLBFGS):
             z = stream_scores(w)  # scores rebuild from w: one pass
             passes += 1
         else:
-            z = stream_scores(w)
-            f, g = smooth_fg(w, z)
-            passes = 2
+            prime = self._primed_init(primed, data, w)
+            if prime is not None:
+                z, fd, gd = prime
+                f = (fd + 0.5 * jnp.sum(l2v * w * w)
+                     + jnp.sum(l1v * jnp.abs(w)))
+                g = gd + l2v * w
+                passes = 1  # one fused streamed pass during ingest
+            else:
+                z = stream_scores(w)
+                f, g = smooth_fg(w, z)
+                passes = 2
             gnorm0 = jnp.linalg.norm(pseudo_gradient(w, g, l1v))
             hist = empty_history(cfg.history_length, dim, jnp.float32)
             values = np.full(max_it + 1, np.inf, np.float32)
@@ -858,7 +1038,7 @@ def scores_out_of_core(data: ChunkedGLMData, w) -> np.ndarray:
 
 def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
                     progress=None, checkpoint_path=None, mesh=None,
-                    data_axis="data"):
+                    data_axis="data", device_cache=None, primed=None):
     """Problem-level entry mirroring ``GLMOptimizationProblem.run`` for the
     out-of-core path: same task→loss mapping, regularization/reg-mask
     semantics, and ``(GLMModel, OptimizerResult)`` return. LBFGS handles
@@ -882,6 +1062,7 @@ def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
         checkpoint_path=checkpoint_path,
         mesh=mesh,
         data_axis=data_axis,
+        device_cache=device_cache,
     )
     if problem.optimizer_type == OptimizerType.OWLQN:
         solver = OutOfCoreOWLQN(l1_weight=l1, **common)
@@ -900,7 +1081,7 @@ def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
         solver = OutOfCoreLBFGS(**common)
     if w0 is None:
         w0 = jnp.zeros((data.dim,), jnp.float32)
-    result = solver.optimize(data, w0)
+    result = solver.optimize(data, w0, primed=primed)
     model = GeneralizedLinearModel(
         Coefficients(means=result.x, variances=None), problem.task
     )

@@ -209,6 +209,28 @@ def main() -> None:
              f"(layers: {sorted(report.layers)})")
     if not 0.0 <= ov <= 1.0:
         fail(f"analyzer: overlap fraction {ov} outside [0, 1]")
+    # ISSUE 9 regression guard: the overlap REPORT must stay present and
+    # well-formed (the asserts above and the verdict below) — a refactor
+    # that drops the ingest spans would turn it one-sided/None and fail
+    # here instead of rotting quietly. The pre-pipeline overlap value was
+    # exactly 0.0 and the smoke fit is in-core (all compute after the
+    # read), so there is no meaningful numeric floor to gate at this
+    # scale; the pipelined data path's ≥0.5 verdict is measured where it
+    # runs, in bench.py game_scale (game_scale_overlap_fraction — SLO
+    # rule example in docs/observability.md).
+    if report.overlap.get("verdict") not in (
+            "serialized", "partially-overlapped", "overlapped"):
+        fail(f"analyzer: overlap verdict missing/unknown: "
+             f"{report.overlap.get('verdict')!r}")
+    # The driver's ingest must have gone through the prefetch pipeline
+    # (io/prefetch.py): the consumer's bounded-queue pull is span-traced,
+    # so its absence means the pipelined read path silently fell back.
+    with open(trace_path) as f:
+        _train_events = json.load(f)["traceEvents"]
+    if not any(e.get("name") == "ingest.prefetch_queue_wait"
+               for e in _train_events):
+        fail("training trace has no ingest.prefetch_queue_wait spans — "
+             "the driver's prefetched ingest pipeline did not run")
     print(f"obs_smoke: timeline analyzer ok (bottleneck "
           f"{report.bottleneck()['cat']}:{report.bottleneck()['name']}, "
           f"ingest/compute overlap {ov}, shares sum {share_sum:.4f})")

@@ -1,0 +1,794 @@
+"""Pipelined ingest→device data path (io/prefetch.py, data/device_cache.py,
+and their threading through optim/out_of_core.py and the RE coordinate).
+
+Contracts under test:
+
+* the prefetch stage is a pure throughput detail — chunk order, content,
+  and error behavior are bit-identical to a sequential read;
+* the double-buffered device feed and the sweep cache never change a solve
+  (bit-identical with/without, primed or not);
+* the bf16 feed is tolerance-gated like the PR 1 dtype work: bf16 transfer
+  with f32 accumulation tracks the f32 fit within documented bounds;
+* chaos (``pytest -m chaos``): injected block-read ``OSError`` mid-prefetch
+  recovers through ``io_retries`` (or propagates promptly without them),
+  worker crashes fast-fail with in-flight chunks, and a seeded fault plan
+  still yields a bit-identical bundle.
+"""
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from photon_tpu import native
+from photon_tpu.data.device_cache import DeviceSweepCache
+from photon_tpu.io.prefetch import (
+    device_put_chunk,
+    host_feed_array,
+    pipelined_puts,
+    prefetch,
+    read_bundle_pipelined,
+)
+
+requires_native = pytest.mark.skipif(
+    native.get_lib() is None, reason="native decoder unavailable"
+)
+
+
+# ---------------------------------------------------------------------------
+# prefetch stage (no IO needed)
+
+
+def test_prefetch_preserves_order_and_items():
+    items = list(range(57))
+    assert list(prefetch(iter(items), depth=3)) == items
+    assert list(prefetch(iter(items), depth=0)) == items  # disabled path
+
+
+def test_prefetch_bounded_queue_backpressure():
+    """The producer must never run more than ``depth`` + in-flight items
+    ahead of the consumer."""
+    produced = []
+
+    def gen():
+        for i in range(50):
+            produced.append(i)
+            yield i
+
+    it = prefetch(gen(), depth=2)
+    first = next(it)
+    time.sleep(0.2)  # give the producer every chance to overrun
+    assert first == 0
+    # consumed 1; queue holds <= 2; one more may be blocked in put.
+    assert len(produced) <= 1 + 2 + 2
+    assert list(it) == list(range(1, 50))
+
+
+def test_prefetch_propagates_producer_error_in_order():
+    def gen():
+        yield 1
+        yield 2
+        raise OSError("stream died")
+
+    it = prefetch(gen(), depth=4)
+    assert next(it) == 1
+    assert next(it) == 2
+    with pytest.raises(OSError, match="stream died"):
+        next(it)
+
+
+def test_prefetch_abandoned_consumer_stops_producer():
+    state = {"n": 0}
+
+    def gen():
+        while True:
+            state["n"] += 1
+            yield state["n"]
+
+    it = prefetch(gen(), depth=2)
+    assert next(it) == 1
+    it.close()  # GeneratorExit → stop flag → producer unblocks and exits
+    n_after_close = state["n"]
+    time.sleep(0.2)
+    assert state["n"] == n_after_close
+    assert threading.active_count() < 50  # no thread leak across tests
+
+
+def test_pipelined_puts_keeps_one_in_flight():
+    calls = []
+
+    def put(x):
+        calls.append(x)
+        return x * 10
+
+    out = []
+    for y in pipelined_puts(iter(range(5)), put, ahead=1):
+        # When item N is yielded, item N+1's put has already been issued.
+        out.append((y, len(calls)))
+    assert [y for y, _ in out] == [0, 10, 20, 30, 40]
+    assert [c for _, c in out] == [2, 3, 4, 5, 5]
+
+
+# ---------------------------------------------------------------------------
+# bf16 feed
+
+
+def test_host_feed_array_bf16_halves_bytes():
+    a = np.linspace(0, 1, 64, dtype=np.float32)
+    b = host_feed_array(a, "bfloat16")
+    assert b.nbytes == a.nbytes // 2
+    assert host_feed_array(a, None) is a
+    # one-hot / small-integer values are EXACT in bf16
+    ones = np.ones(16, np.float32)
+    np.testing.assert_array_equal(
+        host_feed_array(ones, "bfloat16").astype(np.float32), ones
+    )
+
+
+def test_bf16_feed_matvec_accumulates_f32():
+    from photon_tpu.data.batch import SparseFeatures
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, 40, size=(32, 6)).astype(np.int32)
+    val = rng.normal(size=(32, 6)).astype(np.float32)
+    w = jnp.asarray(rng.normal(size=40).astype(np.float32))
+    f32 = SparseFeatures(jnp.asarray(idx), jnp.asarray(val), 40)
+    b16 = SparseFeatures(
+        jnp.asarray(idx), jnp.asarray(host_feed_array(val, "bfloat16")), 40
+    )
+    out = b16.matvec(w)
+    assert out.dtype == jnp.float32  # promotion: bf16 storage, f32 math
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(f32.matvec(w)), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_bf16_feed_fit_tolerance_gate():
+    """The PR 1-style dtype gate for the feed: a fixed-effect fit on a
+    bf16-fed bundle must track the f32 fit to documented tolerance."""
+    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+    from tests.test_out_of_core import _data, _problem
+
+    idx, val, labels = _data(n=600, dim=120, seed=7)
+    problem = _problem(max_iter=60)
+
+    def fit(v):
+        batch = LabeledBatch(
+            features=SparseFeatures(jnp.asarray(idx), jnp.asarray(v), 150),
+            labels=jnp.asarray(labels),
+            offsets=jnp.zeros((len(labels),), jnp.float32),
+            weights=jnp.ones((len(labels),), jnp.float32),
+        )
+        m, r = problem.run(batch, jnp.zeros((150,), jnp.float32))
+        return np.asarray(m.coefficients.means), float(r.value)
+
+    w32, f32 = fit(val)
+    w16, f16 = fit(host_feed_array(val, "bfloat16"))
+    assert f16 == pytest.approx(f32, rel=5e-3)
+    np.testing.assert_allclose(w16, w32, rtol=0.0, atol=5e-2)
+
+
+def test_bf16_bundle_re_dataset_repacks_f32():
+    """A bf16-fed bundle must still produce f32 RE buckets: the feed narrows
+    TRANSFER only — per-entity Newton solves (batched Cholesky) have no bf16
+    lowering and accumulate in f32 over the already-quantized values."""
+    import dataclasses
+
+    from photon_tpu.estimators.config import RandomEffectDataConfig
+    from photon_tpu.estimators.game_estimator import (
+        build_re_dataset_from_bundle,
+    )
+    from tests.test_checkpoint import _bundle
+
+    b = _bundle()
+    sf = b.features["g"]
+    b16 = dataclasses.replace(b, features={
+        "g": dataclasses.replace(sf, val=sf.val.astype(jnp.bfloat16)),
+    })
+    ds = build_re_dataset_from_bundle(
+        b16, RandomEffectDataConfig(re_type="userId", feature_shard="g"),
+    )
+    assert ds.buckets[0].val.dtype == jnp.float32
+    np.testing.assert_allclose(
+        np.asarray(ds.buckets[0].val),
+        np.asarray(build_re_dataset_from_bundle(
+            b, RandomEffectDataConfig(re_type="userId", feature_shard="g"),
+        ).buckets[0].val),
+        rtol=1e-2, atol=1e-2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# device sweep cache
+
+
+def _cache_put(cache, key, a):
+    """Pin one host array through the production surface (get_or_put is
+    what the OOC feed calls)."""
+    return cache.get_or_put(key, a.nbytes, lambda: jnp.asarray(a), retain=a)
+
+
+def test_sweep_cache_hit_miss_and_budget_spill():
+    cache = DeviceSweepCache(budget_bytes=1000)
+    a = np.ones(100, np.float32)          # 400 B: fits
+    big = np.ones(1000, np.float32)       # 4 KB: spills
+
+    d1 = _cache_put(cache, ("a",), a)
+    d2 = _cache_put(cache, ("a",), a)
+    assert d1 is d2                        # hit returns the pinned array
+    assert cache.stats()["entries"] == 1
+    s1 = _cache_put(cache, ("big",), big)
+    s2 = _cache_put(cache, ("big",), big)
+    assert s1 is not s2                    # spill: rebuilt per use
+    assert cache.spilled_bytes >= big.nbytes
+    np.testing.assert_array_equal(np.asarray(s1), big)
+    cache.release()
+    assert cache.stats()["entries"] == 0 and cache.resident_bytes == 0
+
+
+def test_sweep_cache_spill_counted_once_per_key():
+    """Spilled bytes must read DATASET size, not dataset × passes: a
+    multi-pass solve re-missing the same busted-budget chunk every pass
+    may not re-add its bytes (the figure drives --sweep-cache-mb sizing)."""
+    cache = DeviceSweepCache(budget_bytes=100)
+    big = np.ones(1000, np.float32)
+    for _ in range(5):
+        _cache_put(cache, ("big",), big)
+    assert cache.spilled_bytes == big.nbytes
+    cache.release()
+    assert cache.spilled_bytes == 0
+
+
+def test_sweep_cache_discard_rolls_back_accounting():
+    """discard() frees a pin whose host referent was replaced (the primer's
+    regrow cleanup) and rolls the byte/entry accounting back."""
+    cache = DeviceSweepCache(budget_bytes=10_000)
+    a = np.ones(100, np.float32)
+    _cache_put(cache, ("a",), a)
+    assert cache.stats() == {"budget_bytes": 10_000, "resident_bytes": 400,
+                             "spilled_bytes": 0, "entries": 1}
+    cache.discard(("a",))
+    cache.discard(("missing",))            # unknown keys are a no-op
+    assert cache.stats()["entries"] == 0 and cache.resident_bytes == 0
+    cache.release()
+
+
+def test_sweep_cache_spilled_mirror_lookups_count_as_misses():
+    """A budget-busted RE dataset re-uploads every sweep — later lookups
+    must NOT report cache hits (a 'healthy hit rate' over a spilled
+    dataset would hide exactly the regression the cache exists to kill)."""
+    from photon_tpu.data.random_effect import build_random_effect_dataset
+    from photon_tpu.obs.metrics import REGISTRY
+
+    rng = np.random.default_rng(2)
+    n, k, dim = 40, 3, 20
+    ds = build_random_effect_dataset(
+        re_type="userId",
+        entity_keys_per_row=np.array([f"u{i % 4}" for i in range(n)], object),
+        idx=rng.integers(0, dim, size=(n, k)).astype(np.int32),
+        val=rng.normal(size=(n, k)).astype(np.float32),
+        labels=(rng.random(n) < 0.5).astype(np.float32),
+        global_dim=dim,
+        host_resident=True,
+    )
+    tiny = DeviceSweepCache(budget_bytes=8)
+    hits = REGISTRY.counter("sweep_cache_hits_total")
+    h0 = sum(v for _, v in hits.collect())
+    assert tiny.dataset_mirror(ds) is ds
+    assert tiny.dataset_mirror(ds) is ds
+    assert tiny.dataset_mirror(ds) is ds
+    assert sum(v for _, v in hits.collect()) == h0
+    tiny.release()
+
+
+def test_sweep_cache_disabled_budget_zero():
+    cache = DeviceSweepCache(budget_bytes=0)
+    assert not cache.enabled
+    a = np.ones(10, np.float32)
+    out = _cache_put(cache, ("k",), a)
+    assert cache.stats()["entries"] == 0
+    np.testing.assert_array_equal(np.asarray(out), a)
+
+
+def test_sweep_cache_dataset_mirror_identity_stable():
+    from photon_tpu.data.random_effect import build_random_effect_dataset
+
+    rng = np.random.default_rng(1)
+    n, k, dim = 60, 4, 30
+    ds = build_random_effect_dataset(
+        re_type="userId",
+        entity_keys_per_row=np.array([f"u{i % 6}" for i in range(n)], object),
+        idx=rng.integers(0, dim, size=(n, k)).astype(np.int32),
+        val=rng.normal(size=(n, k)).astype(np.float32),
+        labels=(rng.random(n) < 0.5).astype(np.float32),
+        global_dim=dim,
+        host_resident=True,
+    )
+    assert isinstance(ds.buckets[0].idx, np.ndarray)  # host build
+    cache = DeviceSweepCache()
+    m1 = cache.dataset_mirror(ds)
+    m2 = cache.dataset_mirror(ds)
+    assert m1 is m2                       # identity stable across sweeps
+    assert not isinstance(m1.buckets[0].idx, np.ndarray)
+    for b_host, b_dev in zip(ds.buckets, m1.buckets):
+        np.testing.assert_array_equal(b_host.proj, np.asarray(b_dev.proj))
+    # Budget-busted datasets keep the ORIGINAL object (streaming fallback).
+    tiny = DeviceSweepCache(budget_bytes=8)
+    assert tiny.dataset_mirror(ds) is ds
+    assert tiny.dataset_mirror(ds) is ds  # and stays stable
+    cache.release()
+
+
+def test_re_fit_with_sweep_cache_matches_without():
+    """A multi-sweep GAME fit over a host-resident RE dataset must be
+    bit-identical with the sweep cache on vs off (the cache is a transfer
+    detail, not a semantics change) — and the cached fit must actually HIT
+    the cache on sweep 1."""
+    from tests.test_checkpoint import _bundle, _final_arrays
+    from photon_tpu.estimators.config import (
+        FixedEffectDataConfig,
+        GLMOptimizationConfiguration,
+        RandomEffectDataConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.obs.metrics import REGISTRY
+    from photon_tpu.optim import RegularizationContext, RegularizationType
+    from photon_tpu.types import TaskType
+
+    bundle = _bundle()
+    base = dict(
+        regularization=RegularizationContext(RegularizationType.L2),
+        max_iterations=10,
+    )
+    configs = [{
+        "fixed": GLMOptimizationConfiguration(reg_weight=1.0, **base),
+        "perUser": GLMOptimizationConfiguration(reg_weight=1.0, **base),
+    }]
+
+    def fit(cache_mb):
+        est = GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_data_configs={
+                "fixed": FixedEffectDataConfig("g"),
+                "perUser": RandomEffectDataConfig(
+                    re_type="userId", feature_shard="g",
+                    host_resident=True),
+            },
+            n_sweeps=2,
+            sweep_cache_mb=cache_mb,
+        )
+        return est.fit(bundle, None, configs)
+
+    hits = REGISTRY.counter("sweep_cache_hits_total")
+    h0 = sum(v for _, v in hits.collect())
+    with_cache = fit(cache_mb=None)
+    assert sum(v for _, v in hits.collect()) > h0   # sweep 1 hit the mirror
+    without = fit(cache_mb=0)
+    for a, b in zip(_final_arrays(with_cache), _final_arrays(without)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# out-of-core: cache + primed init
+
+
+def _ooc_fixture(seed=0):
+    from tests.test_out_of_core import _data, _problem
+
+    idx, val, labels = _data(n=700, dim=150, seed=seed)
+    return idx, val, labels, _problem(max_iter=25)
+
+
+def test_ooc_device_cache_solve_bit_identical():
+    from photon_tpu.optim.out_of_core import ChunkedGLMData, run_out_of_core
+
+    idx, val, labels, problem = _ooc_fixture()
+    data = ChunkedGLMData.from_arrays(idx, val, labels, 150, chunk_rows=256)
+    m0, r0 = run_out_of_core(problem, data)
+    cache = DeviceSweepCache()
+    data2 = ChunkedGLMData.from_arrays(idx, val, labels, 150, chunk_rows=256)
+    m1, r1 = run_out_of_core(problem, data2, device_cache=cache)
+    assert cache.stats()["entries"] == data2.n_chunks
+    cache.release()
+    np.testing.assert_array_equal(np.asarray(m0.coefficients.means),
+                                  np.asarray(m1.coefficients.means))
+    assert float(r0.value) == float(r1.value)
+
+
+def test_ooc_primed_init_bit_identical():
+    """StreamPrimer's overlapped init pass must reproduce the unprimed
+    solve exactly (same kernels, same accumulation order)."""
+    from photon_tpu.ops.losses import loss_for_task
+    from photon_tpu.optim.out_of_core import (
+        ChunkedGLMData,
+        StreamPrimer,
+        run_out_of_core,
+    )
+    from photon_tpu.types import TaskType
+
+    idx, val, labels, problem = _ooc_fixture(seed=4)
+
+    def stream():
+        from photon_tpu.data.batch import SparseFeatures
+
+        class Chunk:
+            def __init__(self, lo, hi):
+                self.features = {"g": SparseFeatures(
+                    idx=idx[lo:hi], val=val[lo:hi], dim=150)}
+                self.labels = labels[lo:hi]
+                self.offsets = np.zeros(hi - lo, np.float32)
+                self.weights = np.ones(hi - lo, np.float32)
+                self.n_rows = hi - lo
+
+        for lo in range(0, 700, 210):
+            yield Chunk(lo, min(lo + 210, 700))
+
+    data_plain = ChunkedGLMData.from_stream(stream(), "g", 150,
+                                            chunk_rows=256)
+    m0, r0 = run_out_of_core(problem, data_plain)
+
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    primer = StreamPrimer(loss, 150)
+    data = ChunkedGLMData.from_stream(
+        prefetch(stream(), depth=2), "g", 150, chunk_rows=256,
+        on_chunk=primer,
+    )
+    m1, r1 = run_out_of_core(problem, data, primed=primer.primed())
+    np.testing.assert_array_equal(np.asarray(m0.coefficients.means),
+                                  np.asarray(m1.coefficients.means))
+    assert float(r0.value) == float(r1.value)
+    assert int(r0.iterations) == int(r1.iterations)
+
+
+def test_ooc_primer_discards_pins_orphaned_by_regrow():
+    """A mid-stream ELL width regrow replaces already-flushed chunk arrays;
+    the primer must discard its now-unreachable cache pins (budget holds
+    live data, not orphans) and the primed solve still matches unprimed."""
+    from photon_tpu.ops.losses import loss_for_task
+    from photon_tpu.optim.out_of_core import (
+        ChunkedGLMData,
+        StreamPrimer,
+        run_out_of_core,
+    )
+    from photon_tpu.types import TaskType
+
+    rng = np.random.default_rng(9)
+    dim, n = 80, 600
+
+    def stream():
+        from photon_tpu.data.batch import SparseFeatures
+
+        class Chunk:
+            def __init__(self, lo, hi, k):
+                idx = rng.integers(0, dim, size=(hi - lo, k)).astype(np.int32)
+                val = (rng.normal(size=(hi - lo, k)) / np.sqrt(k)).astype(
+                    np.float32)
+                self.features = {"g": SparseFeatures(idx=idx, val=val,
+                                                     dim=dim)}
+                self.labels = (rng.random(hi - lo) < 0.5).astype(np.float32)
+                self.offsets = np.zeros(hi - lo, np.float32)
+                self.weights = np.ones(hi - lo, np.float32)
+                self.n_rows = hi - lo
+
+        yield Chunk(0, 300, k=4)       # narrow first...
+        yield Chunk(300, 600, k=9)     # ...then wider: regrow fires
+
+    rng_state = rng.bit_generator.state
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    cache = DeviceSweepCache()
+    primer = StreamPrimer(loss, dim, device_cache=cache)
+    data = ChunkedGLMData.from_stream(stream(), "g", dim, chunk_rows=256,
+                                      on_chunk=primer)
+    # Every RESIDENT entry must key a live chunk array: the regrown chunk
+    # 0/1 pins were discarded, and the budget reflects only reachable data.
+    live_ids = {id(c.idx) for c in data.chunks}
+    resident_keys = {k[1] for k in cache._entries}
+    assert resident_keys <= live_ids
+    from tests.test_out_of_core import _problem
+
+    m1, r1 = run_out_of_core(_problem(max_iter=25), data,
+                             device_cache=cache, primed=primer.primed())
+    cache.release()
+    rng.bit_generator.state = rng_state
+    data2 = ChunkedGLMData.from_stream(stream(), "g", dim, chunk_rows=256)
+    m2, r2 = run_out_of_core(_problem(max_iter=25), data2)
+    np.testing.assert_array_equal(np.asarray(m1.coefficients.means),
+                                  np.asarray(m2.coefficients.means))
+    assert float(r1.value) == float(r2.value)
+
+
+def test_ooc_primed_rejected_on_mismatched_start():
+    """A prime computed at a different w0 must be IGNORED, not trusted —
+    the solve falls back to fresh init passes and still converges right."""
+    from photon_tpu.ops.losses import loss_for_task
+    from photon_tpu.optim.out_of_core import (
+        ChunkedGLMData,
+        OutOfCoreLBFGS,
+        StreamPrimer,
+    )
+    from photon_tpu.types import TaskType
+
+    idx, val, labels, problem = _ooc_fixture(seed=5)
+    data = ChunkedGLMData.from_arrays(idx, val, labels, 150, chunk_rows=256)
+    loss = loss_for_task(TaskType.LOGISTIC_REGRESSION)
+    primer = StreamPrimer(loss, 150, w0=jnp.ones((150,), jnp.float32))
+    for i, c in enumerate(data.chunks):
+        primer(i, c, data.labels[i], data.offsets[i], data.weights[i])
+    solver = OutOfCoreLBFGS(loss=loss, l2_weight=1.0,
+                            config=problem.optimizer_config)
+    r_primed = solver.optimize(data, jnp.zeros((150,), jnp.float32),
+                               primed=primer.primed())
+    r_fresh = solver.optimize(data, jnp.zeros((150,), jnp.float32))
+    assert float(r_primed.value) == float(r_fresh.value)
+
+
+def test_ooc_bf16_value_dtype_tolerance():
+    """bf16-fed out-of-core solve (value_dtype=bfloat16: bf16 transfer,
+    f32 accumulation) tracks the f32 solve within the documented gate."""
+    from photon_tpu.optim.out_of_core import ChunkedGLMData, run_out_of_core
+
+    idx, val, labels, problem = _ooc_fixture(seed=6)
+    d32 = ChunkedGLMData.from_arrays(idx, val, labels, 150, chunk_rows=256)
+    m32, r32 = run_out_of_core(problem, d32)
+    d16 = ChunkedGLMData.from_arrays(idx, val, labels, 150, chunk_rows=256,
+                                     value_dtype=jnp.bfloat16)
+    assert d16.chunks[0].val.dtype == jnp.bfloat16
+    assert d16.streamed_bytes_per_pass() < d32.streamed_bytes_per_pass()
+    m16, r16 = run_out_of_core(problem, d16)
+    assert float(r16.value) == pytest.approx(float(r32.value), rel=1e-2)
+    np.testing.assert_allclose(np.asarray(m16.coefficients.means),
+                               np.asarray(m32.coefficients.means),
+                               rtol=0.0, atol=6e-2)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end pipelined reads (native decoder)
+
+
+def _write_stream_file(tmp_path, n=400, name="d.avro", block_records=64):
+    from photon_tpu.io.avro import write_container
+    from tests.test_streaming import SCHEMA, _index, _make_records
+
+    rng = np.random.default_rng(0)
+    feat_names, records = _make_records(rng, n=n)
+    path = str(tmp_path / name)
+    write_container(path, SCHEMA, records, block_records=block_records)
+    return path, _index(feat_names)
+
+
+@requires_native
+def test_read_bundle_pipelined_bit_identical(tmp_path):
+    from photon_tpu.io.data_reader import InputColumnNames
+    from photon_tpu.io.streaming import StreamingAvroReader
+
+    path, imap = _write_stream_file(tmp_path)
+    cols = InputColumnNames(response="label")
+    seq = StreamingAvroReader({"g": imap}, columns=cols,
+                              id_tag_columns=("userId",)).read(path)
+    pipe = read_bundle_pipelined(
+        {"g": imap}, None, cols, ("userId",), path,
+        capture_uids=True, depth=3,
+    )
+    np.testing.assert_array_equal(seq.labels, pipe.labels)
+    np.testing.assert_array_equal(seq.uids, pipe.uids)
+    np.testing.assert_array_equal(seq.id_tags["userId"],
+                                  pipe.id_tags["userId"])
+    np.testing.assert_array_equal(np.asarray(seq.features["g"].idx),
+                                  np.asarray(pipe.features["g"].idx))
+    np.testing.assert_array_equal(np.asarray(seq.features["g"].val),
+                                  np.asarray(pipe.features["g"].val))
+
+
+@requires_native
+def test_read_bundle_pipelined_bf16_feed(tmp_path):
+    from photon_tpu.io.data_reader import InputColumnNames
+
+    path, imap = _write_stream_file(tmp_path, n=120)
+    cols = InputColumnNames(response="label")
+    b = read_bundle_pipelined(
+        {"g": imap}, None, cols, (), path, capture_uids=False,
+        feed_dtype="bfloat16",
+    )
+    assert b.features["g"].val.dtype == jnp.bfloat16
+    assert b.features["g"].idx.dtype == jnp.int32  # indices stay exact
+
+
+@requires_native
+def test_device_put_chunk_moves_numeric_payload(tmp_path):
+    from photon_tpu.io.data_reader import InputColumnNames
+    from photon_tpu.io.streaming import StreamingAvroReader
+
+    path, imap = _write_stream_file(tmp_path, n=100)
+    cols = InputColumnNames(response="label")
+    sr = StreamingAvroReader({"g": imap}, columns=cols)
+    (chunk,) = list(sr.iter_chunks(path))
+    dev = device_put_chunk(chunk, feed_dtype="bfloat16")
+    assert dev.n_rows == chunk.n_rows
+    assert not isinstance(dev.features["g"].idx, np.ndarray)
+    assert dev.features["g"].val.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(dev.features["g"].val, np.float32),
+        np.asarray(chunk.features["g"].val),
+        rtol=1e-2, atol=1e-2,
+    )
+
+
+@requires_native
+def test_uid_dictionary_growth_warns_once(tmp_path, caplog, monkeypatch):
+    import logging
+
+    from photon_tpu.io.data_reader import InputColumnNames
+    from photon_tpu.io.streaming import StreamingAvroReader
+
+    path, imap = _write_stream_file(tmp_path, n=300, block_records=32)
+    monkeypatch.setenv("PHOTON_UID_WARN_ROWS", "100")
+    cols = InputColumnNames(response="label")
+    sr = StreamingAvroReader({"g": imap}, columns=cols, capture_uids=True,
+                             chunk_rows=64)
+    with caplog.at_level(logging.WARNING, logger="photon_tpu.io"):
+        n = sum(c.n_rows for c in sr.iter_chunks(path))
+    assert n == 300
+    warns = [r for r in caplog.records if "uid dictionary" in r.message]
+    assert len(warns) == 1                 # one-time, not per chunk
+    assert "unique entries" in warns[0].getMessage()
+
+    # capture_uids=False flows never warn.
+    caplog.clear()
+    sr2 = StreamingAvroReader({"g": imap}, columns=cols, capture_uids=False,
+                              chunk_rows=64)
+    with caplog.at_level(logging.WARNING, logger="photon_tpu.io"):
+        list(sr2.iter_chunks(path))
+    assert not [r for r in caplog.records if "uid dictionary" in r.message]
+
+
+# ---------------------------------------------------------------------------
+# chaos (pytest -m chaos; slow keeps these out of the tier-1 budget)
+
+
+@requires_native
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_block_read_oserror_mid_prefetch_recovers(tmp_path):
+    """An injected transient block-read OSError fires INSIDE the prefetch
+    producer thread; io_retries reopens and the prefetched bundle is
+    bit-identical to a fault-free sequential read."""
+    from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+    from photon_tpu.io.data_reader import InputColumnNames
+    from photon_tpu.io.streaming import StreamingAvroReader
+
+    path, imap = _write_stream_file(tmp_path, n=400, block_records=32)
+    cols = InputColumnNames(response="label")
+    ref = StreamingAvroReader({"g": imap}, columns=cols).read(path)
+
+    plan = FaultPlan(seed=7, specs=[
+        FaultSpec(site="io.block_read", error="os", after=3, count=2),
+    ])
+    with active_plan(plan) as inj:
+        pipe = read_bundle_pipelined(
+            {"g": imap}, None, cols, (), path, capture_uids=True, depth=2,
+        )
+    assert inj.fired("io.block_read") == 2   # the faults really happened
+    np.testing.assert_array_equal(ref.labels, pipe.labels)
+    np.testing.assert_array_equal(np.asarray(ref.features["g"].idx),
+                                  np.asarray(pipe.features["g"].idx))
+    np.testing.assert_array_equal(np.asarray(ref.features["g"].val),
+                                  np.asarray(pipe.features["g"].val))
+
+
+@requires_native
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_block_read_oserror_without_retries_fails_fast(tmp_path):
+    """With io_retries=0 the same fault must PROPAGATE through the prefetch
+    thread to the consumer (promptly — no hang, no silent truncation)."""
+    from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+    from photon_tpu.io.data_reader import InputColumnNames
+    from photon_tpu.io.streaming import StreamingAvroReader
+
+    path, imap = _write_stream_file(tmp_path, n=400, block_records=32)
+    cols = InputColumnNames(response="label")
+    sr = StreamingAvroReader({"g": imap}, columns=cols, io_retries=0)
+    plan = FaultPlan(seed=7, specs=[
+        FaultSpec(site="io.block_read", error="os", after=3, count=1),
+    ])
+    t0 = time.monotonic()
+    with active_plan(plan):
+        with pytest.raises(OSError):
+            list(prefetch(sr.iter_chunks(path), depth=2))
+    assert time.monotonic() - t0 < 30.0      # fast-fail, not a hang
+
+
+@requires_native
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_prefetch_fault_point_fires(tmp_path):
+    """The producer loop's own fault point (io.prefetch) kills the stage
+    mid-stream and the error reaches the consumer."""
+    from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+    from photon_tpu.io.data_reader import InputColumnNames
+    from photon_tpu.io.streaming import StreamingAvroReader
+
+    path, imap = _write_stream_file(tmp_path, n=400, block_records=32)
+    cols = InputColumnNames(response="label")
+    sr = StreamingAvroReader({"g": imap}, columns=cols, chunk_rows=64)
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="io.prefetch", error="runtime", after=2, count=1),
+    ])
+    with active_plan(plan) as inj:
+        with pytest.raises(RuntimeError):
+            list(prefetch(sr.iter_chunks(path), depth=2))
+    assert inj.fired("io.prefetch") == 1
+
+
+@requires_native
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_worker_crash_fast_fails_with_inflight_chunks(tmp_path):
+    """A corrupt second file kills its decode worker mid-pool; the parallel
+    chunk stream must surface the failure promptly even though file 1's
+    chunks are already in flight through the prefetcher."""
+    from photon_tpu.io.avro import SchemaError
+    from photon_tpu.io.data_reader import FeatureShardConfig, InputColumnNames
+    from photon_tpu.io.parallel_ingest import iter_chunks_parallel
+
+    p1, imap = _write_stream_file(tmp_path, n=200, name="a.avro",
+                                  block_records=32)
+    bad = tmp_path / "b.avro"
+    data = bytearray((tmp_path / "a.avro").read_bytes())
+    data[len(data) // 2:] = b"\xff" * (len(data) - len(data) // 2)
+    bad.write_bytes(bytes(data))
+
+    cols = InputColumnNames(response="label")
+    t0 = time.monotonic()
+    with pytest.raises((SchemaError, ValueError, OSError)):
+        list(prefetch(iter_chunks_parallel(
+            [p1, str(bad)], {"g": imap}, {"g": FeatureShardConfig()},
+            cols, (), n_workers=2, chunk_rows=64,
+        ), depth=2))
+    assert time.monotonic() - t0 < 60.0
+
+
+@requires_native
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_seeded_plan_bundle_bit_identical_vs_sequential(tmp_path):
+    """Under one seeded fault plan (delays + one recovered OSError), the
+    prefetched multi-file read still equals the sequential read bit for
+    bit — fault recovery may cost time, never rows."""
+    from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+    from photon_tpu.io.avro import write_container
+    from photon_tpu.io.data_reader import InputColumnNames
+    from photon_tpu.io.streaming import StreamingAvroReader
+    from tests.test_streaming import SCHEMA, _index, _make_records
+
+    rng = np.random.default_rng(3)
+    feat_names, records = _make_records(rng, n=500)
+    p1, p2 = str(tmp_path / "s1.avro"), str(tmp_path / "s2.avro")
+    write_container(p1, SCHEMA, records[:250], block_records=32)
+    write_container(p2, SCHEMA, records[250:], block_records=32)
+    imap = _index(feat_names)
+    cols = InputColumnNames(response="label")
+
+    ref = StreamingAvroReader({"g": imap}, columns=cols,
+                              id_tag_columns=("userId",)).read([p1, p2])
+    plan = FaultPlan(seed=11, specs=[
+        FaultSpec(site="io.block_read", delay_s=0.002, every=5),
+        FaultSpec(site="io.block_read", error="os", after=9, count=1),
+        FaultSpec(site="io.prefetch", delay_s=0.001, every=2),
+    ])
+    with active_plan(plan) as inj:
+        pipe = read_bundle_pipelined(
+            {"g": imap}, None, cols, ("userId",), [p1, p2],
+            capture_uids=True, depth=2,
+        )
+    assert inj.fired("io.block_read") >= 2
+    assert inj.fired("io.prefetch") >= 1
+    np.testing.assert_array_equal(ref.labels, pipe.labels)
+    np.testing.assert_array_equal(ref.uids, pipe.uids)
+    np.testing.assert_array_equal(ref.id_tags["userId"],
+                                  pipe.id_tags["userId"])
+    np.testing.assert_array_equal(np.asarray(ref.features["g"].idx),
+                                  np.asarray(pipe.features["g"].idx))
+    np.testing.assert_array_equal(np.asarray(ref.features["g"].val),
+                                  np.asarray(pipe.features["g"].val))
