@@ -51,14 +51,23 @@ echo "order: ${SHUFFLED}" >> ci_random_order.txt
 # shellcheck disable=SC2086
 python -m pytest ${SHUFFLED} -q -p no:cacheprovider
 
+echo "== recovery smoke (fail-fast backend probe; docs/robustness.md) =="
+# Backend-failure resilience without a chip: an injected init HANG dies at
+# the PHOTON_BACKEND_INIT_TIMEOUT_S deadline (seconds, not the ~1500s the
+# operational record shows), injected UNAVAILABLE/OOM inits classify, the
+# strict/failover policy ladder enforces, and a RunSupervisor drill
+# journals a classified restart.
+python scripts/recovery_smoke.py
+
 echo "== chaos smoke (deterministic fault injection; docs/robustness.md) =="
 # The chaos suite re-runs standalone so a fault-injection regression is
 # attributable at a glance: training preempted mid-sweep must resume
-# bit-identically, and the scoring server under store-outage + overload
-# plans must answer every request (success, degraded, or 503) — no hangs.
+# bit-identically (now including the device_lost in-run recovery plans),
+# and the scoring server under store-outage + overload plans must answer
+# every request (success, degraded, or 503) — no hangs.
 # (Named files, not tests/: an unrelated collection error — e.g. a missing
 # optional dependency in another test module — must not mask chaos results.)
-python -m pytest tests/test_chaos.py tests/test_serving.py tests/test_prefetch.py -q -m chaos
+python -m pytest tests/test_chaos.py tests/test_serving.py tests/test_prefetch.py tests/test_backend_guard.py -q -m chaos
 
 echo "== obs smoke (tracing + Prometheus exposition; docs/observability.md) =="
 # A tiny traced training + scoring pass: validates the --trace-out artifact

@@ -30,11 +30,20 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="shard spec (repeatable); default 'global:features'")
     p.add_argument("--num-partitions", type=int, default=1,
                    help="hash partitions per store (reference PalDB partitions)")
+    from photon_tpu.cli.params import add_backend_policy_flag
+
+    add_backend_policy_flag(p)
     return p
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
+    from photon_tpu.cli.params import enable_backend_guard
+
+    # Indexing is host-side work, but the native block decoder's jax
+    # imports can still initialize a backend; the same fail-fast gate (and
+    # --backend-policy cpu-only for pure-host runs) applies.
+    enable_backend_guard(args)
     os.makedirs(args.output_dir, exist_ok=True)
     with PhotonLogger(args.output_dir) as logger:
         sizes = {}
@@ -57,7 +66,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
 
 
 def main() -> None:  # pragma: no cover - console entry
-    run()
+    from photon_tpu.cli.params import console_main
+
+    console_main(run)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -65,16 +65,25 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "of numeric scalars (scores/labels/weights + int32 "
                         "group codes; group-id strings are dictionary-"
                         "encoded per chunk, never accumulated)")
-    from photon_tpu.cli.params import add_compilation_cache_flag
+    from photon_tpu.cli.params import (
+        add_backend_policy_flag,
+        add_compilation_cache_flag,
+    )
 
+    add_backend_policy_flag(p)
     add_compilation_cache_flag(p)
     return p
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
-    from photon_tpu.cli.params import enable_compilation_cache
+    from photon_tpu.cli.params import (
+        enable_backend_guard,
+        enable_compilation_cache,
+    )
 
+    # Fail-fast backend gate (PHOTON_BACKEND_INIT_TIMEOUT_S hard deadline).
+    enable_backend_guard(args)
     enable_compilation_cache(args.compilation_cache_dir)
     if args.dtype == "float64":
         import jax
@@ -313,7 +322,9 @@ def _score_chunked(args, reader, transformer, suite, scores_path, logger, _dt):
 
 
 def main() -> None:  # pragma: no cover - console entry
-    run()
+    from photon_tpu.cli.params import console_main
+
+    console_main(run)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -167,12 +167,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "<shard>.avro (reference FeatureSummarizationResultAvro "
                         "output, SURVEY.md §3.1 feature-summarization stage)")
     from photon_tpu.cli.params import (
+        add_backend_policy_flag,
         add_compilation_cache_flag,
         add_fault_plan_flag,
         add_re_routing_flags,
         add_trace_flag,
     )
 
+    add_backend_policy_flag(p)
     add_compilation_cache_flag(p)
     add_fault_plan_flag(p)
     add_re_routing_flags(p)
@@ -232,12 +234,17 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     """Run training; returns a result summary dict (also written to disk)."""
     args = build_arg_parser().parse_args(argv)
     from photon_tpu.cli.params import (
+        enable_backend_guard,
         enable_compilation_cache,
         enable_fault_plan,
         enable_re_routing,
         enable_trace,
     )
 
+    # Backend policy FIRST — the fail-fast probe (hard
+    # PHOTON_BACKEND_INIT_TIMEOUT_S deadline) must gate the process before
+    # anything can initialize a backend in-process and wedge.
+    enable_backend_guard(args)
     enable_compilation_cache(args.compilation_cache_dir)
     enable_fault_plan(args.fault_plan)
     enable_re_routing(args, output_dir=args.output_dir)
@@ -265,7 +272,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         jax.profiler.start_trace(args.profile_dir)
         profiling = True
 
-    from photon_tpu.supervisor import Heartbeat, RestartPolicy, run_with_recovery
+    from photon_tpu.supervisor import Heartbeat, RestartPolicy, RunSupervisor
 
     heartbeat = None
     # SLO rules (docs/observability.md §SLO) ride the beat loop when a
@@ -367,14 +374,23 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         if args.max_restarts > 0:
             import logging
 
-            return run_with_recovery(
-                attempt,
+            # RunSupervisor (docs/robustness.md §recovery journal): same
+            # RestartPolicy/backoff contract as run_with_recovery, plus
+            # classified causes, run_restarts_total{cause=...}, recovery.*
+            # trace events, and an append-only JSONL journal next to the
+            # model — and under --backend-policy failover, a backend-level
+            # failure re-probes between attempts and re-enters on CPU
+            # instead of burning the whole budget on a wedged grant.
+            supervisor = RunSupervisor(
                 RestartPolicy(
                     max_restarts=args.max_restarts,
                     backoff_seconds=args.restart_backoff,
                 ),
+                journal=os.path.join(args.output_dir, "recovery.jsonl"),
                 logger=logging.getLogger("photon_tpu.supervisor"),
+                failover_policy=args.backend_policy,
             )
+            return supervisor.run(attempt)
         return attempt(0)
     finally:
         if heartbeat is not None:
@@ -677,7 +693,9 @@ def _run_inner(args, task) -> dict:
 
 
 def main() -> None:  # pragma: no cover - console entry
-    run()
+    from photon_tpu.cli.params import console_main
+
+    console_main(run)
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -120,10 +120,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "input file size exceeds "
                         "$PHOTON_DEVICE_DATA_BUDGET_GB, default 10)")
     from photon_tpu.cli.params import (
+        add_backend_policy_flag,
         add_compilation_cache_flag,
         add_trace_flag,
     )
 
+    add_backend_policy_flag(p)
     add_compilation_cache_flag(p)
     add_trace_flag(p)
     return p
@@ -392,11 +394,15 @@ def _run_out_of_core(args, task, imap, shard_cfg, chunk_rows, logger) -> dict:
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
     from photon_tpu.cli.params import (
+        enable_backend_guard,
         enable_compilation_cache,
         enable_trace,
         finish_trace,
     )
 
+    # Fail-fast backend gate before anything can wedge in backend init
+    # (PHOTON_BACKEND_INIT_TIMEOUT_S hard deadline; docs/robustness.md).
+    enable_backend_guard(args)
     enable_compilation_cache(args.compilation_cache_dir)
     enable_trace(args.trace_out)
     try:
@@ -653,7 +659,9 @@ def _run(args) -> dict:
 
 
 def main() -> None:  # pragma: no cover - console entry
-    run()
+    from photon_tpu.cli.params import console_main
+
+    console_main(run)
 
 
 if __name__ == "__main__":  # pragma: no cover

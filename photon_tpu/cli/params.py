@@ -383,6 +383,59 @@ def enable_re_routing(args, output_dir=None) -> None:
         os.environ["PHOTON_CLEAR_CACHES_PER_CONFIG"] = "1"
 
 
+def add_backend_policy_flag(parser) -> None:
+    """Shared --backend-policy flag (default: $PHOTON_BACKEND_POLICY or
+    'strict'): what to do when the accelerator backend fails its health
+    probe (docs/robustness.md §"Backend-failure resilience"). The probe
+    runs subprocess-isolated under the PHOTON_BACKEND_INIT_TIMEOUT_S hard
+    deadline (default 120 s), so no entrypoint can hang ~25 minutes inside
+    a wedged backend init."""
+    import os
+
+    parser.add_argument(
+        "--backend-policy", choices=["strict", "failover", "cpu-only"],
+        default=os.environ.get("PHOTON_BACKEND_POLICY") or "strict",
+        help="on a failed backend health probe: 'strict' = classified "
+             "error + nonzero exit (never silently train on the wrong "
+             "hardware); 'failover' = re-enter on CPU with the swap "
+             "stamped into provenance (artifacts resolve to backend=cpu); "
+             "'cpu-only' = pin the CPU backend, never touch the "
+             "accelerator (default: $PHOTON_BACKEND_POLICY or strict)")
+
+
+def enable_backend_guard(args, logger=None) -> dict:
+    """Enforce --backend-policy before any in-process backend init. A
+    probe that already passed in this process is not repeated (driver
+    re-entries and test suites stay fast); a failed probe under 'strict'
+    raises BackendUnusable, which the console entry surfaces as a
+    classified one-line error and a nonzero exit."""
+    import logging
+
+    from photon_tpu.runtime.backend_guard import ensure_backend
+
+    return ensure_backend(
+        policy=getattr(args, "backend_policy", "strict"),
+        logger=logger or logging.getLogger("photon_tpu.runtime"),
+    )
+
+
+def console_main(run_fn) -> None:
+    """Console-entry wrapper shared by the drivers: a failed backend
+    health probe under --backend-policy strict exits with ONE classified
+    line and status 2 — the operator (and the scheduler's log scraper)
+    gets `fatal [init_unavailable]: ...`, not a 40-frame traceback ending
+    in a jaxlib internal."""
+    import sys
+
+    from photon_tpu.runtime.backend_guard import BackendUnusable
+
+    try:
+        run_fn()
+    except BackendUnusable as e:
+        print(f"fatal [{e.cause}]: {e.reason}", file=sys.stderr)
+        raise SystemExit(2) from None
+
+
 def add_fault_plan_flag(parser) -> None:
     """Shared --fault-plan flag (default: $PHOTON_FAULT_PLAN): run the
     driver under a deterministic fault-injection plan for chaos drills

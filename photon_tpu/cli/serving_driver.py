@@ -74,11 +74,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "judged at every metrics flush; violations bump "
                         "slo_violations_total and emit trace instants")
     from photon_tpu.cli.params import (
+        add_backend_policy_flag,
         add_compilation_cache_flag,
         add_fault_plan_flag,
         add_trace_flag,
     )
 
+    add_backend_policy_flag(p)
     add_compilation_cache_flag(p)
     add_fault_plan_flag(p)
     add_trace_flag(p)
@@ -88,11 +90,17 @@ def build_arg_parser() -> argparse.ArgumentParser:
 def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
     """Registry (load + warm) → batcher → HTTP front-end, not yet serving."""
     from photon_tpu.cli.params import (
+        enable_backend_guard,
         enable_compilation_cache,
         enable_fault_plan,
         enable_trace,
     )
 
+    # Fail-fast backend gate: a serving box with a wedged accelerator must
+    # refuse to start (strict) or come up on CPU with the swap stamped
+    # (failover) within PHOTON_BACKEND_INIT_TIMEOUT_S — never hang the
+    # deploy for 25 minutes inside model warmup's first device touch.
+    enable_backend_guard(args)
     enable_compilation_cache(args.compilation_cache_dir)
     enable_fault_plan(args.fault_plan)
     enable_trace(args.trace_out)
@@ -199,7 +207,9 @@ def _run(args, serve_forever: bool) -> dict:
 
 
 def main() -> None:  # pragma: no cover - console entry
-    run()
+    from photon_tpu.cli.params import console_main
+
+    console_main(run)
 
 
 if __name__ == "__main__":  # pragma: no cover

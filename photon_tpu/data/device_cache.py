@@ -38,7 +38,24 @@ import numpy as np
 from photon_tpu.obs import trace_span
 from photon_tpu.obs.metrics import REGISTRY
 
-__all__ = ["DeviceSweepCache", "default_budget_bytes"]
+__all__ = ["DeviceSweepCache", "default_budget_bytes", "release_all_caches"]
+
+# Live-instance registry (weak: the cache's own lifetime is unchanged) so
+# device-loss recovery (runtime/backend_guard.recover_from_device_loss)
+# can drop EVERY process-wide pin at once — after a device loss the pinned
+# buffers are dead weight at best and poison at worst, and the recovery
+# path has no handle on the estimator that owns each cache.
+import weakref
+
+_LIVE_CACHES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def release_all_caches() -> int:
+    """Release every live :class:`DeviceSweepCache`; returns how many."""
+    caches = list(_LIVE_CACHES)
+    for c in caches:
+        c.release()
+    return len(caches)
 
 _CACHE_BYTES = REGISTRY.gauge(
     "sweep_cache_bytes",
@@ -107,6 +124,7 @@ class DeviceSweepCache:
         self._bytes = 0
         self._spilled = 0
         self._lock = threading.Lock()
+        _LIVE_CACHES.add(self)
 
     # -- core --------------------------------------------------------------
 

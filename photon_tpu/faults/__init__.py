@@ -10,6 +10,7 @@ degradation).
 """
 from photon_tpu.faults.chaos import bit_flip, torn_write
 from photon_tpu.faults.plan import (
+    DeviceLostError,
     FaultInjector,
     FaultPlan,
     FaultSpec,
@@ -22,6 +23,7 @@ from photon_tpu.faults.plan import (
 )
 
 __all__ = [
+    "DeviceLostError",
     "FaultInjector",
     "FaultPlan",
     "FaultSpec",

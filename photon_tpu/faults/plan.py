@@ -26,11 +26,22 @@ site                        where / what a fired fault simulates
 ``checkpoint.load``         checkpoint file open on resume
 ``descent.step``            top of each coordinate-descent step
                             (host preemption delivered as an exception)
+``descent.device``          inside each coordinate-descent step, before
+                            the solve (``error="device_lost"`` here drives
+                            the IN-RUN recovery path: checkpoint →
+                            executable-cache clear → resume, not an
+                            attempt restart)
+``optim.ooc_iteration``     top of each out-of-core optimizer iteration
+                            (same in-run device-loss recovery, resuming
+                            from the solver's own .npz checkpoint)
 ``heartbeat.beat``          heartbeat file write (stale-heartbeat peers)
 ``serving.store_lookup``    coefficient-store point lookup (latency
                             spikes via ``delay_s``, errors via ``error``)
 ``serving.batcher_batch``   micro-batcher worker, per assembled batch
                             (unexpected worker death)
+``serving.kernel``          scoring-kernel invocation on the batcher
+                            worker (``error="device_lost"`` exercises the
+                            scorer's breaker-gated re-init + retry)
 ==========================  ================================================
 
 A plan is a list of :class:`FaultSpec`; each spec independently counts the
@@ -53,6 +64,7 @@ from typing import Callable, Optional, Sequence
 
 __all__ = [
     "PreemptionError",
+    "DeviceLostError",
     "FaultSpec",
     "FaultPlan",
     "FaultInjector",
@@ -73,6 +85,18 @@ class PreemptionError(RuntimeError):
     resume)."""
 
 
+class DeviceLostError(RuntimeError):
+    """A lost accelerator device surfaced as an exception mid-computation.
+
+    Subclasses ``RuntimeError`` (like jaxlib's XlaRuntimeError) so the
+    supervisor's retryable set treats it as transient. Distinct from
+    :class:`PreemptionError` because it takes a DIFFERENT recovery path:
+    the in-run handler (descent / out-of-core / scorer) checkpoints,
+    clears the executable caches, and resumes WITHOUT killing the attempt
+    (``runtime/backend_guard.recover_from_device_loss``); only repeated
+    losses escalate to the supervisor restart."""
+
+
 # JSON-able error names -> exception types raised by a firing spec.
 _ERROR_TYPES = {
     "os": OSError,
@@ -80,6 +104,7 @@ _ERROR_TYPES = {
     "runtime": RuntimeError,
     "connection": ConnectionError,
     "preemption": PreemptionError,
+    "device_lost": DeviceLostError,
     "memory": MemoryError,
 }
 

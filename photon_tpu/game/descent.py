@@ -179,6 +179,11 @@ class CoordinateDescent:
             _retrace.clear_warm(k)
 
         step = step_base
+        # Device-loss recovery clears the RE kernels' warm marks along with
+        # the executable caches; the sentinel re-arms only after the NEXT
+        # fully-executed sweep (the recovery sweep's remainder legitimately
+        # recompiles shapes whose executables were purged).
+        rearm_sweep = None
         for sweep in range(self.n_sweeps):
             # Manual span, not ``with`` (the inner loop body is long): on a
             # mid-sweep exception the sweep span is simply not emitted — the
@@ -196,21 +201,92 @@ class CoordinateDescent:
                     "descent.step", sweep=sweep, coordinate=cid, step=step
                 )
                 coord = coordinates[cid]
-                with trace_span("descent.step", cat="descent", sweep=sweep,
-                                coordinate=cid, step=step) as step_span:
-                    residual_offset = total - scores[cid]
-                    model, _ = coord.train(residual_offset, models.get(cid))
-                    new_score = coord.score(model)
-                    total = residual_offset + new_score
-                    scores[cid] = new_score
-                    models[cid] = model
-                    # Tiny D2H fetch: the step record (and span) must report
-                    # COMPLETED compute, not async dispatch (without this the
-                    # tracker claimed ~4s of a 70s fit; block_until_ready
-                    # alone does not synchronize on the axon tunnel backend,
-                    # a D2H does). The data dependency
-                    # new_score <- model <- solve forces the whole step.
-                    np.asarray(new_score[:1])
+                # In-run device-loss recovery (docs/robustness.md): the step
+                # body COMMITS (total/scores/models mutate) only after the
+                # D2H sync proves the device work completed, so a device
+                # loss anywhere inside leaves the pre-step state intact and
+                # the step simply re-runs after recovery — bit-identically,
+                # because the step is a pure function of that state.
+                recoveries = 0
+                while True:
+                    try:
+                        with trace_span(
+                            "descent.step", cat="descent", sweep=sweep,
+                            coordinate=cid, step=step,
+                        ) as step_span:
+                            # Chaos hook: error="device_lost" here drives
+                            # the in-run path (vs descent.step, whose
+                            # preemption kills the whole attempt).
+                            fault_point("descent.device", sweep=sweep,
+                                        coordinate=cid, step=step)
+                            residual_offset = total - scores[cid]
+                            model, _ = coord.train(
+                                residual_offset, models.get(cid))
+                            new_score = coord.score(model)
+                            new_total = residual_offset + new_score
+                            # Tiny D2H fetch: the step record (and span) must
+                            # report COMPLETED compute, not async dispatch
+                            # (without this the tracker claimed ~4s of a 70s
+                            # fit; block_until_ready alone does not
+                            # synchronize on the axon tunnel backend, a D2H
+                            # does). The data dependency
+                            # new_score <- model <- solve forces the whole
+                            # step — and is the commit gate above.
+                            np.asarray(new_score[:1])
+                        total = new_total
+                        scores[cid] = new_score
+                        models[cid] = model
+                        break
+                    except Exception as e:  # noqa: BLE001 - classified below
+                        from photon_tpu.runtime import backend_guard as _bg
+
+                        if (not _bg.is_device_lost(e)
+                                or recoveries >= _bg.max_inrun_recoveries()):
+                            raise
+                        recoveries += 1
+                        # Checkpoint FIRST (pre-step state is still exact),
+                        # then clear-and-reenter; a failing snapshot means
+                        # the device state is unfetchable and the loss must
+                        # escalate to the supervisor restart instead.
+                        if checkpointer is not None:
+                            try:
+                                checkpointer.save(
+                                    step,
+                                    state={
+                                        "models": models,
+                                        "scores": scores,
+                                        "total": total,
+                                        "v_cache": v_cache,
+                                        "best_metric": best_metric,
+                                        "best_models": best_models,
+                                        "tracker": tracker,
+                                        **(extra_state or {}),
+                                    },
+                                    meta={
+                                        "phase": "recovery",
+                                        "sweep": sweep,
+                                        # pre-step state == "resume after
+                                        # the previous coordinate"
+                                        "coord_index": ci - 1,
+                                        **(checkpoint_meta or {}),
+                                    },
+                                )
+                                checkpointer.wait()
+                            except KeyboardInterrupt:
+                                raise  # a user abort is never "recovery"
+                            except Exception:
+                                raise e
+                        logger.warning(
+                            "device lost in sweep %d coord %s (%s: %s); "
+                            "in-run recovery %d/%d, re-running the step",
+                            sweep, cid, type(e).__name__, e, recoveries,
+                            _bg.max_inrun_recoveries(),
+                        )
+                        _bg.recover_from_device_loss(
+                            f"descent sweep {sweep} coord {cid}",
+                            logger=logger,
+                        )
+                        rearm_sweep = sweep + 1
                 dt = step_span.seconds
 
                 record = CoordinateStepRecord(sweep, cid, dt)
@@ -279,9 +355,14 @@ class CoordinateDescent:
             # Arm after the first sweep that executed EVERY coordinate step
             # (a resumed run's first sweep may be partial, leaving later
             # coordinates' shapes uncompiled — warming then would turn their
-            # legitimate first compiles into false retrace alarms).
+            # legitimate first compiles into false retrace alarms). An
+            # in-run device-loss recovery pushes the arming point out the
+            # same way: its cache purge makes every shape recompile once
+            # more across the remainder of that sweep.
             first_full = (0 if resumed_pos is None else resumed_pos[0] + 1)
-            if sweep == first_full:
+            arm_at = (first_full if rearm_sweep is None
+                      else max(first_full, rearm_sweep))
+            if sweep == arm_at:
                 for k in _retrace.RE_SOLVER_KERNELS:
                     _retrace.mark_warm(k)
 

@@ -159,6 +159,19 @@ def compare_pair(
     notes: list[str] = []
 
     po, pn = old.provenance, new.provenance
+    # Backend-guard failover stamp (bench provenance.backend_guard): a run
+    # that re-entered on CPU after a failed accelerator probe is a
+    # DIFFERENT-hardware run by construction — the per-metric backend
+    # resolution already refuses the deltas, but the note says WHY the
+    # round is CPU, so the refusal reads as an incident, not a mystery.
+    for prov, name, tag in ((po, old.name, "old"), (pn, new.name, "new")):
+        fo = (prov.get("backend_guard") or {}).get("failover")
+        if fo:
+            notes.append(
+                f"backend failover occurred in the {tag} artifact "
+                f"({name}): [{fo.get('cause', 'unknown')}] → "
+                f"{fo.get('to', 'cpu')} — this round ran on the failover "
+                "backend; accelerator comparisons are withheld")
     for key, label in (("jax_version", "jax version"),
                        ("hostname", "host")):
         vo, vn = po.get(key), pn.get(key)

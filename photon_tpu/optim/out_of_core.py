@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.data.batch import SparseFeatures
+from photon_tpu.faults import fault_point
 from photon_tpu.obs import trace_span
 from photon_tpu.optim.base import (
     FUNCTION_VALUES_CONVERGED,
@@ -721,7 +722,45 @@ class OutOfCoreLBFGS:
                  primed: Optional[dict] = None) -> OptimizerResult:
         """``primed`` (from :class:`StreamPrimer`) carries the init pass
         computed while the data streamed in; a valid prime skips the two
-        init passes (scores + gradient) bit-identically."""
+        init passes (scores + gradient) bit-identically.
+
+        In-run device-loss recovery (docs/robustness.md): a classified
+        device loss mid-solve does NOT kill the attempt — the executable
+        caches clear, sweep-cache pins release, and the solve re-enters
+        through ``_optimize_impl``, whose checkpoint load fast-forwards to
+        the last saved iteration (or restarts the deterministic loop from
+        scratch without a checkpoint path) — bit-identical either way.
+        Bounded by ``PHOTON_DEVICE_LOST_MAX_RECOVERIES``; past it the
+        error escalates to the supervisor restart."""
+        recoveries = 0
+        while True:
+            try:
+                return self._optimize_impl(data, x0, primed=primed)
+            except Exception as e:  # noqa: BLE001 - classified below
+                from photon_tpu.runtime import backend_guard as _bg
+
+                if (not _bg.is_device_lost(e)
+                        or recoveries >= _bg.max_inrun_recoveries()):
+                    raise
+                recoveries += 1
+                import logging
+
+                logging.getLogger("photon_tpu.ooc").warning(
+                    "device lost mid-solve (%s: %s); in-run recovery %d/%d"
+                    "%s", type(e).__name__, e, recoveries,
+                    _bg.max_inrun_recoveries(),
+                    ", resuming from checkpoint" if self.checkpoint_path
+                    else ", re-running the deterministic loop")
+                _bg.recover_from_device_loss(
+                    "out-of-core solve", device_cache=self.device_cache,
+                )
+                # The prime's resident margins died with the device; the
+                # re-entry rebuilds them (checkpoint scores-rebuild pass or
+                # fresh init passes).
+                primed = None
+
+    def _optimize_impl(self, data: ChunkedGLMData, x0: Array,
+                       primed: Optional[dict] = None) -> OptimizerResult:
         cfg = self.config
         dim = data.dim
         (put_rep, stream_scores, data_value, data_value_at_t,
@@ -770,6 +809,10 @@ class OutOfCoreLBFGS:
         reason = NOT_CONVERGED
         last_save = float("-inf")
         while True:
+            # Chaos hook: error="device_lost" here exercises the in-run
+            # recovery wrapper in optimize() (checkpoint fast-forward →
+            # bit-identical result).
+            fault_point("optim.ooc_iteration", it=it)
             # Convergence test BEFORE the max-iteration cut (and so also
             # after the final update) — same ordering as the in-core loop,
             # so converged_reason agrees on runs that converge exactly at
@@ -885,8 +928,8 @@ class OutOfCoreOWLQN(OutOfCoreLBFGS):
             return jnp.full_like(w, self.l1_weight)
         return self.l1_weight * self.reg_mask.astype(w.dtype)
 
-    def optimize(self, data: ChunkedGLMData, x0: Array,
-                 primed: Optional[dict] = None) -> OptimizerResult:
+    def _optimize_impl(self, data: ChunkedGLMData, x0: Array,
+                       primed: Optional[dict] = None) -> OptimizerResult:
         cfg = self.config
         dim = data.dim
         (put_rep, stream_scores, data_value, data_value_at_t,
@@ -947,6 +990,8 @@ class OutOfCoreOWLQN(OutOfCoreLBFGS):
         reason = NOT_CONVERGED
         last_save = float("-inf")
         while True:
+            # Same in-run device-loss recovery hook as the smooth solver.
+            fault_point("optim.ooc_iteration", it=it)
             pg = pseudo_gradient(w, g, l1v)
             reason = int(check_convergence(
                 jnp.asarray(it), f_prev, f, jnp.linalg.norm(pg), gnorm0, cfg

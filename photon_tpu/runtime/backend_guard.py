@@ -1,0 +1,585 @@
+"""Fail-fast backend health probe, error classification, failure policy.
+
+Why this module exists (the operational record, not a hypothetical):
+``TPU_RECOVERY.jsonl`` logs seven consecutive runs each burning ~1500 s
+inside ``Unable to initialize backend: UNAVAILABLE`` before dying, and the
+ROADMAP bench-trajectory caveat notes rounds r3/r5 silently fell back to
+CPU, poisoning cross-round comparisons until the PR 6 gate started
+refusing them. Upstream photon-ml never had this problem class — Spark
+re-schedules a lost executor and lineage replays its partition — so the
+rebuild needs an explicit contract where the reference had a runtime.
+
+Three pieces:
+
+* :func:`probe_backend` — a SUBPROCESS-isolated backend init with a hard
+  deadline (``PHOTON_BACKEND_INIT_TIMEOUT_S``, default 120 s). A wedged
+  device grant blocks ``jax.devices()`` forever *in C++*; no in-process
+  timeout can interrupt it, so the probe must be a child process the
+  parent can kill. SIGTERM first (a hard-killed client that later receives
+  the grant can wedge it for every subsequent process), SIGKILL as the
+  backstop.
+* :func:`classify_backend_error` — maps backend failures onto the four
+  causes the recovery layers act on: ``init_unavailable`` (the 1500 s
+  class: grant wedged / UNAVAILABLE / init hang), ``compile_error``,
+  ``device_lost`` (mid-run loss: the only in-run-recoverable cause), and
+  ``oom``. Everything else is ``unknown`` — never guessed.
+* :func:`ensure_backend` — the ``--backend-policy`` contract shared by
+  bench.py and every CLI driver:
+
+  ========== ==============================================================
+  policy     on probe failure
+  ========== ==============================================================
+  strict     raise :class:`BackendUnusable` (classified cause; driver
+             exits nonzero) — the default: never silently train on the
+             wrong hardware
+  failover   re-enter on the next available backend (CPU), stamping the
+             swap into :func:`guard_snapshot` so bench provenance (and
+             the PR 6 gate) can never mistake a failover round for an
+             accelerator number
+  cpu-only   pin the CPU backend up front; no probe, no accelerator
+  ========== ==============================================================
+
+In-run recovery (device loss mid-sweep) lives here too —
+:func:`recover_from_device_loss` is the shared checkpoint-then-clear-then-
+resume step ``game/descent.py`` and ``optim/out_of_core.py`` call; see
+docs/robustness.md for the full ladder.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+import time
+from typing import Optional
+
+__all__ = [
+    "BACKEND_POLICIES",
+    "CAUSE_INIT_UNAVAILABLE",
+    "CAUSE_COMPILE_ERROR",
+    "CAUSE_DEVICE_LOST",
+    "CAUSE_OOM",
+    "CAUSE_UNKNOWN",
+    "BackendProbeResult",
+    "BackendUnusable",
+    "backend_init_timeout_s",
+    "classify_backend_error",
+    "ensure_backend",
+    "guard_snapshot",
+    "is_device_lost",
+    "max_inrun_recoveries",
+    "probe_backend",
+    "record_failover",
+    "recover_from_device_loss",
+    "reset_guard",
+    "try_claim_lock",
+    "wait_claim_lock",
+]
+
+BACKEND_POLICIES = ("strict", "failover", "cpu-only")
+
+CAUSE_INIT_UNAVAILABLE = "init_unavailable"
+CAUSE_COMPILE_ERROR = "compile_error"
+CAUSE_DEVICE_LOST = "device_lost"
+CAUSE_OOM = "oom"
+CAUSE_UNKNOWN = "unknown"
+
+
+def backend_init_timeout_s(default: float = 120.0) -> float:
+    """Hard deadline for backend init (``PHOTON_BACKEND_INIT_TIMEOUT_S``).
+
+    The default kills the observed ~25-minute init hangs at 2 minutes — a
+    healthy accelerator grant completes in seconds, so anything past this
+    is the wedge, not a slow success. Malformed/negative values fall back
+    to ``default`` (a typo'd override must degrade the deadline, never
+    disable fail-fast)."""
+    try:
+        v = float(os.environ.get("PHOTON_BACKEND_INIT_TIMEOUT_S", default))
+    except (TypeError, ValueError):
+        return float(default)
+    return v if v > 0 else float(default)
+
+
+def max_inrun_recoveries(default: int = 2) -> int:
+    """Bound on in-run device-loss recoveries per scope
+    (``PHOTON_DEVICE_LOST_MAX_RECOVERIES``): past it the error escalates to
+    the :class:`~photon_tpu.supervisor.RunSupervisor` restart path."""
+    try:
+        return max(0, int(os.environ.get(
+            "PHOTON_DEVICE_LOST_MAX_RECOVERIES", default)))
+    except (TypeError, ValueError):
+        return int(default)
+
+
+# Ordered classification: FIRST match wins, so the ordering is part of the
+# contract. ``init_unavailable`` outranks ``compile_error`` because the
+# recovery-log failure signature is literally "UNAVAILABLE: TPU backend
+# setup/compile error" — an init-phase failure that merely mentions
+# compilation, and restart-with-backoff (not a code change) is its remedy.
+_CAUSE_PATTERNS: tuple = (
+    (CAUSE_OOM, re.compile(
+        r"RESOURCE_EXHAUSTED|out of memory|\bOOM\b|hbm.{0,20}exhausted",
+        re.IGNORECASE)),
+    (CAUSE_DEVICE_LOST, re.compile(
+        r"device\W{0,3}(was\s+)?lost|DEVICE_LOST|device is in an invalid"
+        r"|socket closed|connection reset|broken pipe.{0,40}device"
+        r"|tunnel.{0,30}(closed|dropped|reset)",
+        re.IGNORECASE)),
+    (CAUSE_INIT_UNAVAILABLE, re.compile(
+        r"UNAVAILABLE|[Uu]nable to initialize backend"
+        r"|[Ff]ailed to initialize|[Nn]o visible device"
+        r"|backend init.{0,30}(timed? ?out|deadline)"
+        r"|probe hung|wedged device grant",
+    )),
+    (CAUSE_COMPILE_ERROR, re.compile(
+        r"XlaCompile|compilation (error|failure|failed)"
+        r"|compile (error|failed)|lowering (error|failed)|Mosaic failed",
+        re.IGNORECASE)),
+)
+
+
+def classify_backend_error(err) -> str:
+    """One of the cause constants for an exception (or message text).
+
+    Exception *types* outrank message text: an injected
+    :class:`~photon_tpu.faults.DeviceLostError` or a real ``MemoryError``
+    classifies by what it is, not what it says."""
+    text = err if isinstance(err, str) else f"{type(err).__name__}: {err}"
+    if not isinstance(err, str):
+        from photon_tpu.faults import DeviceLostError
+
+        if isinstance(err, DeviceLostError):
+            return CAUSE_DEVICE_LOST
+        if isinstance(err, MemoryError):
+            return CAUSE_OOM
+        if isinstance(err, (OSError, ConnectionError)):
+            # A plain I/O error whose MESSAGE happens to say "connection
+            # reset" / "socket closed" (an NFS hiccup, a dropped HTTP
+            # peer) is NOT a device loss: it must take the io-retry /
+            # supervisor path, never the in-run recovery's
+            # executable-cache purge. Real tunnel losses surface as
+            # XlaRuntimeError (a RuntimeError), which still classifies by
+            # text below.
+            return CAUSE_UNKNOWN
+    for cause, pattern in _CAUSE_PATTERNS:
+        if pattern.search(text):
+            return cause
+    return CAUSE_UNKNOWN
+
+
+def is_device_lost(err) -> bool:
+    """Is this the one cause the in-run recovery path may absorb?"""
+    return classify_backend_error(err) == CAUSE_DEVICE_LOST
+
+
+class BackendUnusable(RuntimeError):
+    """The backend failed its health probe under ``--backend-policy
+    strict``: carries the classified ``cause`` and the probe's ``reason``
+    so the driver's nonzero exit is diagnosable from the one-line error."""
+
+    def __init__(self, cause: str, reason: str):
+        self.cause = cause
+        self.reason = reason
+        super().__init__(f"backend unusable [{cause}]: {reason}")
+
+
+@dataclasses.dataclass(frozen=True)
+class BackendProbeResult:
+    """Outcome of one (possibly multi-attempt) subprocess probe."""
+
+    ok: bool
+    backend: str             # jax.default_backend() seen by the probe child
+    seconds: float           # wall time of the LAST attempt
+    attempts: int
+    cause: Optional[str] = None
+    reason: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        return {k: v for k, v in out.items() if v is not None}
+
+
+_PROBE_MARK = "PHOTON_BACKEND="
+_DEFAULT_PROBE_CODE = (
+    "import jax, jax.numpy as jnp; "
+    "jnp.ones((8,)).sum().block_until_ready(); "
+    f"print('{_PROBE_MARK}' + jax.default_backend())"
+)
+
+# Machine-wide single-TPU-claimant lock, shared with bench.py and
+# scripts/tpu_claimant.py: the axon tunnel grants ONE client at a time and
+# overlapping clients can wedge it — the operational record's ~25-minute
+# failure mode. EVERY tunnel client (claimants, bench, and now the
+# drivers' probes) must hold this flock before touching the tunnel. The
+# per-uid fallback keeps self-exclusion working on a shared sticky /tmp
+# where another user owns the shared path.
+TPU_CLAIM_LOCK = "/tmp/tpu_claimant.lock"
+_CLAIM_LOCK_HANDLE = None  # held for the process lifetime once acquired
+
+
+def try_claim_lock() -> bool:
+    """Acquire the claim lock; False if another tunnel client holds it
+    (do NOT touch the tunnel), True once held (kept until process exit —
+    the caller IS the tunnel client from here on)."""
+    global _CLAIM_LOCK_HANDLE
+    if _CLAIM_LOCK_HANDLE is not None:
+        return True
+    import fcntl
+
+    for path in (TPU_CLAIM_LOCK, f"{TPU_CLAIM_LOCK}.{os.getuid()}"):
+        try:
+            f = open(path, "a")
+        except OSError:
+            continue  # foreign-owned path on sticky /tmp: per-uid fallback
+        try:
+            fcntl.flock(f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            f.close()
+            return False  # a claimant is active
+        _CLAIM_LOCK_HANDLE = f
+        return True
+    return True  # no lockable path: don't block the run over it
+
+
+def wait_claim_lock(timeout_s: float, poll_s: float = 5.0) -> bool:
+    """Poll for the claim lock up to ``timeout_s`` (0 = one try)."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        if try_claim_lock():
+            return True
+        if time.monotonic() >= deadline:
+            return False
+        time.sleep(poll_s)
+
+
+def _probe_once(code: str, timeout_s: float) -> BackendProbeResult:
+    import subprocess
+    import sys
+
+    t0 = time.monotonic()
+    # Popen + SIGTERM grace, not subprocess.run's SIGKILL: a hard-killed
+    # client that later receives the device grant can wedge it for every
+    # subsequent process (the exact failure this probe exists to catch).
+    p = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    try:
+        out, err = p.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        p.terminate()
+        try:
+            p.communicate(timeout=10)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+        took = time.monotonic() - t0
+        return BackendProbeResult(
+            ok=False, backend="", seconds=took, attempts=1,
+            cause=CAUSE_INIT_UNAVAILABLE,
+            reason=(f"backend init timed out after {timeout_s:.0f}s "
+                    "deadline (wedged device grant?) — probe child killed"),
+        )
+    took = time.monotonic() - t0
+    backend = ""
+    for line in (out or "").splitlines():
+        if line.startswith(_PROBE_MARK):
+            backend = line[len(_PROBE_MARK):].strip()
+    if p.returncode == 0 and backend:
+        return BackendProbeResult(
+            ok=True, backend=backend, seconds=took, attempts=1)
+    tail = (err or out or "").strip()[-400:]
+    reason = f"probe exited {p.returncode}: {tail}" if tail else (
+        f"probe exited {p.returncode} with no output")
+    return BackendProbeResult(
+        ok=False, backend=backend, seconds=took, attempts=1,
+        cause=classify_backend_error(tail or reason), reason=reason,
+    )
+
+
+def probe_backend(
+    timeout_s: Optional[float] = None,
+    attempts: Optional[int] = None,
+    probe_code: Optional[str] = None,
+    claim_lock: bool = True,
+) -> BackendProbeResult:
+    """Subprocess-isolated backend health check under a hard deadline.
+
+    ``probe_code`` is the test/chaos seam: recovery drills substitute a
+    child that hangs or prints a canned UNAVAILABLE traceback, and the
+    deadline-kill + classification path runs for real without a chip.
+    ``attempts`` (``PHOTON_BACKEND_PROBE_ATTEMPTS``, default 1) retries
+    the probe; attempt counts are stamped into provenance either way.
+
+    A REAL probe (no ``probe_code``) is a tunnel client, so it first takes
+    the machine-wide claim lock (``PHOTON_BACKEND_LOCK_WAIT``, default
+    60 s): probing while a recovery claimant is mid-claim would be a
+    second concurrent client — the wedge trigger this layer exists to
+    prevent. A held lock reports as a classified failure (transient;
+    strict policy fails fast, failover re-enters on CPU) instead of
+    risking the wedge. ``claim_lock=False`` is for callers that already
+    manage the lock themselves (bench.py — flock by the same process on a
+    second fd would self-conflict)."""
+    deadline = backend_init_timeout_s() if timeout_s is None else timeout_s
+    if attempts is None:
+        try:
+            attempts = max(1, int(os.environ.get(
+                "PHOTON_BACKEND_PROBE_ATTEMPTS", "1")))
+        except (TypeError, ValueError):
+            attempts = 1
+    if probe_code is None and claim_lock:
+        try:
+            lock_wait = float(os.environ.get(
+                "PHOTON_BACKEND_LOCK_WAIT", "60"))
+        except (TypeError, ValueError):
+            lock_wait = 60.0
+        if not wait_claim_lock(lock_wait):
+            return BackendProbeResult(
+                ok=False, backend="", seconds=0.0, attempts=0,
+                cause=CAUSE_INIT_UNAVAILABLE,
+                reason=("TPU claim lock held by another client (recovery "
+                        f"claimant?) through the {lock_wait:.0f}s wait "
+                        "window; not probing — a second concurrent tunnel "
+                        "client is the wedge trigger"),
+            )
+    code = probe_code or _DEFAULT_PROBE_CODE
+    last = None
+    for i in range(attempts):
+        last = _probe_once(code, deadline)
+        if last.ok:
+            return dataclasses.replace(last, attempts=i + 1)
+    return dataclasses.replace(last, attempts=attempts)
+
+
+# ------------------------------------------------------------- guard state
+#
+# One guard decision per process (the probe is an up-front gate, not a
+# recurring cost); bench provenance and /healthz read the snapshot.
+
+_STATE: Optional[dict] = None
+_PROBED_OK = False  # per-process probe memo: one subprocess, not one per run()
+
+
+def guard_snapshot() -> Optional[dict]:
+    """The guard's decision record for provenance stamping, or None when
+    no guard ran in this process: ``{policy, backend, backend_init_seconds,
+    probe_attempts, failover}``."""
+    return None if _STATE is None else dict(_STATE)
+
+
+def reset_guard() -> None:
+    """Test hook: forget the per-process guard decision + probe memo."""
+    global _STATE, _PROBED_OK
+    _STATE = None
+    _PROBED_OK = False
+
+
+def _jax_initialized() -> bool:
+    """True when THIS process already has a live jax backend — probing a
+    subprocess then proves nothing the parent doesn't already know, and
+    costs seconds per driver run (tests call drivers dozens of times)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge as xb
+
+        return bool(getattr(xb, "_backends", None))
+    except Exception:  # noqa: BLE001 - private API; absence = not provable
+        return False
+
+
+def _pin_cpu() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_backend(
+    policy: str = "strict",
+    timeout_s: Optional[float] = None,
+    logger=None,
+    probe_code: Optional[str] = None,
+) -> dict:
+    """Enforce the backend policy before any in-process jax backend init.
+
+    Returns the guard snapshot (also kept module-global for provenance).
+    Under ``strict`` a failed probe raises :class:`BackendUnusable`; under
+    ``failover`` the process re-enters on CPU with the swap recorded (a
+    ``backend_failovers_total{cause=...}`` counter bump + a
+    ``recovery.backend_failover`` trace instant + the snapshot stamp);
+    ``cpu-only`` pins CPU and never touches the accelerator tunnel."""
+    global _STATE, _PROBED_OK
+    if policy not in BACKEND_POLICIES:
+        raise ValueError(
+            f"unknown backend policy {policy!r}; known: {BACKEND_POLICIES}")
+    if policy == "cpu-only":
+        _pin_cpu()
+        _STATE = {"policy": policy, "backend": "cpu",
+                  "backend_init_seconds": 0.0, "probe_attempts": 0,
+                  "failover": None}
+        return dict(_STATE)
+
+    if probe_code is None and (
+            _PROBED_OK or _jax_initialized()
+            or os.environ.get("PHOTON_BACKEND_PROBE") == "0"):
+        # Backend already proven live in-process (or probing disabled):
+        # keep/refresh the snapshot without burning a subprocess.
+        backend = None
+        try:
+            import sys
+
+            jax = sys.modules.get("jax")
+            if jax is not None and _jax_initialized():
+                backend = jax.default_backend()
+        except Exception:  # noqa: BLE001 - snapshot detail, never fatal
+            pass
+        if _STATE is None or _STATE.get("backend") is None:
+            _STATE = {"policy": policy, "backend": backend,
+                      "backend_init_seconds": 0.0, "probe_attempts": 0,
+                      "failover": None}
+        else:
+            _STATE["policy"] = policy
+            if backend is not None:
+                _STATE["backend"] = backend
+        return dict(_STATE)
+
+    probe = probe_backend(timeout_s=timeout_s, probe_code=probe_code)
+    if probe.ok:
+        _PROBED_OK = True
+        _STATE = {"policy": policy, "backend": probe.backend,
+                  "backend_init_seconds": round(probe.seconds, 3),
+                  "probe_attempts": probe.attempts, "failover": None}
+        return dict(_STATE)
+
+    from photon_tpu.obs import instant
+    from photon_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "backend_probe_failures_total",
+        "health-probe failures by classified cause (runtime/backend_guard)",
+    ).inc(cause=probe.cause or CAUSE_UNKNOWN)
+    instant("recovery.backend_probe_failed", cat="recovery",
+            cause=probe.cause, reason=probe.reason,
+            seconds=round(probe.seconds, 3), policy=policy)
+    if logger is not None:
+        logger.warning(
+            "backend probe failed [%s] after %.1fs (attempt %d): %s",
+            probe.cause, probe.seconds, probe.attempts, probe.reason)
+    if policy == "strict":
+        raise BackendUnusable(probe.cause or CAUSE_UNKNOWN,
+                              probe.reason or "probe failed")
+    return record_failover(probe, logger=logger, policy=policy)
+
+
+def record_failover(
+    probe: BackendProbeResult, logger=None, policy: str = "failover",
+) -> dict:
+    """Re-enter on the next available backend and stamp the swap.
+
+    CPU is always initializable in-process, so it is the universal next
+    rung; the swap lands in the guard snapshot (→ bench provenance), the
+    ``backend_failovers_total{cause=...}`` counter, and a
+    ``recovery.backend_failover`` trace instant — so a failover round can
+    NEVER masquerade as an accelerator number (PR 6 per-metric backend
+    provenance refuses the cross-backend comparison). Shared by
+    :func:`ensure_backend` and the :class:`~photon_tpu.supervisor.
+    RunSupervisor` between-attempts path."""
+    global _STATE
+    from photon_tpu.obs import instant
+    from photon_tpu.obs.metrics import REGISTRY
+
+    _pin_cpu()
+    failover = {
+        "to": "cpu",
+        "cause": probe.cause or CAUSE_UNKNOWN,
+        "reason": probe.reason,
+        "probe_seconds": round(probe.seconds, 3),
+    }
+    REGISTRY.counter(
+        "backend_failovers_total",
+        "policy-driven backend failovers by classified cause",
+    ).inc(cause=failover["cause"])
+    instant("recovery.backend_failover", cat="recovery", **failover)
+    if logger is not None:
+        logger.warning(
+            "backend policy 'failover': re-entering on CPU [%s] — artifacts "
+            "will stamp backend=cpu (not comparable to accelerator rounds)",
+            failover["cause"])
+    _STATE = {"policy": policy, "backend": "cpu",
+              "backend_init_seconds": round(probe.seconds, 3),
+              "probe_attempts": probe.attempts, "failover": failover}
+    return dict(_STATE)
+
+
+# --------------------------------------------------------- in-run recovery
+
+
+def recover_from_device_loss(
+    reason: str,
+    device_cache=None,
+    logger=None,
+    reinit_client: bool = False,
+) -> dict:
+    """The shared mid-run recovery step (descent / out-of-core / scorer):
+
+    1. drop jax's compiled-executable caches AND the retrace sentinel's
+       warm marks (``supervisor.clear_executable_caches`` — the recompiles
+       that follow are expected, not alarms);
+    2. release device-resident sweep-cache pins (``device_cache`` when the
+       caller owns one, else every live ``DeviceSweepCache`` in the
+       process) — their device buffers died with the device;
+    3. optionally re-initialize the backend client (``reinit_client``) —
+       ONLY for callers holding no live device arrays (the supervisor's
+       between-attempt path); in-run callers keep their host-checkpointed
+       state and re-enter through fresh uploads.
+
+    The caller checkpoints BEFORE calling this (checkpoint → clear →
+    re-init → resume is the drill order the chaos suite asserts). Emits
+    ``recovery.device_lost`` + ``recovery.backend_reinit`` trace instants
+    and bumps ``run_restarts_total{cause="device_lost"}`` so the recovery
+    is visible in metrics and the trace timeline."""
+    from photon_tpu.obs import instant
+    from photon_tpu.obs.metrics import REGISTRY
+
+    instant("recovery.device_lost", cat="recovery", reason=reason)
+    REGISTRY.counter(
+        "run_restarts_total",
+        "training restarts/recoveries by classified cause "
+        "(docs/robustness.md §recovery journal)",
+    ).inc(cause=CAUSE_DEVICE_LOST)
+
+    from photon_tpu.supervisor import clear_executable_caches
+
+    clear_executable_caches(f"device-loss recovery: {reason}")
+
+    released = 0
+    if device_cache is not None:
+        device_cache.release()
+        released = 1
+    else:
+        from photon_tpu.data.device_cache import release_all_caches
+
+        released = release_all_caches()
+
+    reinit = False
+    if reinit_client:
+        try:
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+            reinit = True
+        except Exception as e:  # noqa: BLE001 - version-dependent API
+            if logger is not None:
+                logger.warning("backend client re-init unavailable (%s: %s); "
+                               "executable caches cleared only",
+                               type(e).__name__, e)
+    instant("recovery.backend_reinit", cat="recovery", reason=reason,
+            caches_released=released, client_reinit=reinit)
+    if logger is not None:
+        logger.warning(
+            "device-loss recovery (%s): executable caches cleared, %d sweep "
+            "cache(s) released%s — resuming from checkpointed state",
+            reason, released, ", backend client re-initialized"
+            if reinit else "")
+    return {"caches_released": released, "client_reinit": reinit}

@@ -29,6 +29,7 @@ from photon_tpu.estimators.game_transformer import (
     SCORE_KERNEL_NAME,
     additive_score_rows,
 )
+from photon_tpu.faults import fault_point
 from photon_tpu.game.coordinates import FixedEffectModel
 from photon_tpu.obs import retrace, trace_span
 from photon_tpu.game.random_effect import RandomEffectModel
@@ -114,6 +115,25 @@ class RowScorer:
         self._shards_used = sorted(
             {shard for _, shard in fixed_parts + re_parts}
         )
+        # Kernel-path circuit breaker (docs/robustness.md §"Backend-failure
+        # resilience"): the store breakers above degrade a sick coefficient
+        # STORE; this one bounds re-initialization attempts when the KERNEL
+        # itself fails on a classified device loss. Closed: a device-lost
+        # kernel error triggers one clear-caches + re-run recovery. Open
+        # (repeated failures): recovery is skipped and the error fast-fails
+        # to the batcher — scoring latency must not absorb doomed re-inits.
+        # breaker_failures=0 disables it (same contract as the store
+        # breakers): kernel errors then surface unrecovered, the pre-guard
+        # behavior.
+        kernel_failures = getattr(config, "breaker_failures", 5)
+        self.kernel_breaker = (
+            CircuitBreaker(
+                failure_threshold=max(1, int(kernel_failures)),
+                cooldown_s=getattr(config, "breaker_cooldown_s", 2.0) or 2.0,
+            )
+            if kernel_failures > 0 else None
+        )
+        self._warming = False
 
     # -------------------------------------------------------------- parsing
 
@@ -251,7 +271,12 @@ class RowScorer:
                     degraded_rows[int(r)].append(cid)
             re_proj[cid], re_coef[cid] = cache.gather(slots)
 
-        with trace_span("serve.kernel", cat="serving", rows=b, bucket=bp):
+        def run_kernel() -> np.ndarray:
+            # Chaos hook: error="device_lost" exercises the breaker-gated
+            # re-init + retry below without a real device loss. Quiet
+            # during warmup so a plan's `after` counts only served batches.
+            if not self._warming:
+                fault_point("serving.kernel", rows=b, bucket=bp)
             scores = additive_score_rows(
                 jnp.asarray(offsets),
                 shard_idx,
@@ -264,8 +289,68 @@ class RowScorer:
             )
             # The D2H fetch below is the sync point; inside the span so the
             # kernel span reports completed compute, not async dispatch.
-            host_scores = np.asarray(scores)
+            return np.asarray(scores)
+
+        with trace_span("serve.kernel", cat="serving", rows=b, bucket=bp):
+            try:
+                host_scores = run_kernel()
+                if self.kernel_breaker is not None:
+                    self.kernel_breaker.record_success()
+            except Exception as e:  # noqa: BLE001 - classified below
+                host_scores = self._recover_kernel(e, run_kernel)
         return host_scores[:b], [tuple(d) for d in degraded_rows]
+
+    def _recover_kernel(self, err: Exception, run_kernel) -> np.ndarray:
+        """Kernel device-loss recovery, bounded by the kernel breaker:
+        clear the executable caches (+ warm marks, so the retry's recompile
+        is expected) and re-run the batch ONCE. ONLY a classified
+        device_lost is recoverable this way — a deterministic kernel error
+        (bad lowering, shape bug) would fail the retry identically, and
+        purging every compiled serving shape for it would break the
+        stable-shape latency contract for nothing. The breaker counts every
+        failure; once open, recovery is short-circuited and the error
+        fast-fails every waiter in the batch until the cooldown's half-open
+        probe — a dead device must degrade to fast 500s, not a re-init
+        storm."""
+        from photon_tpu.obs.metrics import REGISTRY
+        from photon_tpu.runtime.backend_guard import (
+            classify_backend_error,
+            is_device_lost,
+        )
+
+        cause = classify_backend_error(err)
+        REGISTRY.counter(
+            "serve_kernel_errors_total",
+            "scoring-kernel failures by classified cause",
+        ).inc(cause=cause)
+        if self.kernel_breaker is None or not is_device_lost(err):
+            raise err
+        self.kernel_breaker.record_failure()
+        if not self.kernel_breaker.allow():
+            raise err
+        from photon_tpu.obs import instant
+        from photon_tpu.supervisor import clear_executable_caches
+
+        instant("recovery.kernel_reinit", cat="recovery", cause=cause,
+                error=f"{type(err).__name__}: {str(err)[:200]}")
+        clear_executable_caches(f"serving kernel recovery [{cause}]")
+        try:
+            with retrace.expected_compiles():
+                host_scores = run_kernel()
+        except Exception:
+            self.kernel_breaker.record_failure()
+            raise
+        self.kernel_breaker.record_success()
+        REGISTRY.counter(
+            "serve_kernel_recoveries_total",
+            "scoring batches recovered by kernel re-initialization",
+        ).inc(cause=cause)
+        # The cache clear dropped EVERY bucket shape's executable and the
+        # warm mark with them; re-warm the full ladder now (a closed set,
+        # one-time cost on a rare recovery) so the stable-shape
+        # no-recompile contract — and its retrace sentinel — re-arms.
+        self.warmup()
+        return host_scores
 
     def warmup(self) -> int:
         """Compile every row-bucket shape once (empty rows, fallback
@@ -296,9 +381,13 @@ class RowScorer:
         # to different max_batch/nnz). Suppress the sentinel for THIS
         # thread only: the old version keeps serving during a swap, and a
         # genuine retrace on a serving thread must still warn.
-        with retrace.expected_compiles():
-            for size in sizes:
-                self._score_chunk([dummy] * size)
+        self._warming = True
+        try:
+            with retrace.expected_compiles():
+                for size in sizes:
+                    self._score_chunk([dummy] * size)
+        finally:
+            self._warming = False
         # Shape ladder fully compiled: from here on, any further trace of
         # the scoring kernel is a hot-path retrace — the sentinel counts it
         # and warns (log + trace event + Prometheus counter).
@@ -309,9 +398,15 @@ class RowScorer:
         return {cid: c.snapshot() for cid, c in self._caches.items()}
 
     def breaker_snapshot(self) -> dict:
-        """Per-RE-coordinate circuit-breaker state (for /metrics)."""
-        return {
+        """Per-RE-coordinate store breakers + the kernel breaker (for
+        /metrics and /healthz degradation reporting). The kernel breaker
+        rides under the reserved ``__kernel__`` key — coordinate ids come
+        from user config and can never start with a dunder."""
+        out = {
             cid: c.breaker.snapshot()
             for cid, c in self._caches.items()
             if c.breaker is not None
         }
+        if self.kernel_breaker is not None:
+            out["__kernel__"] = self.kernel_breaker.snapshot()
+        return out

@@ -193,20 +193,33 @@ class ScoringServer:
                     return
                 if self.path == "/healthz":
                     v = server.registry.current
+                    # Backend identity + restart/recovery counts ride every
+                    # health reply (docs/robustness.md): an orchestrator —
+                    # or the PR 6 gate's operator — can see at a glance
+                    # WHICH backend is serving and whether the process has
+                    # been limping through recoveries, not just alive/dead.
+                    base = {
+                        "model_version": v.version,
+                        "backend": server.backend_name(),
+                        "restarts": server.restart_counts(),
+                    }
                     if not server.batcher.healthy:
                         self._reply(503, {
                             "status": "unhealthy",
                             "error": "batcher worker died: "
                                      f"{server.batcher.failed!r}",
-                            "model_version": v.version,
+                            "degraded": ["batcher_worker_dead"],
+                            **base,
                         })
                         return
+                    degraded = server.degraded_reasons(v)
                     self._reply(200, {
-                        "status": "ok",
-                        "model_version": v.version,
+                        "status": "degraded" if degraded else "ok",
+                        "degraded": degraded,
                         "model_dir": v.model_dir,
                         "uptime_s": round(
                             time.time() - server._started_at, 1),
+                        **base,
                     })
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
@@ -350,6 +363,51 @@ class ScoringServer:
     def counters(self) -> dict:
         """Back-compat view of the old counter dict (registry-backed)."""
         return {k: int(c.value()) for k, c in self._counters.items()}
+
+    def backend_name(self) -> str:
+        """The backend serving this process's kernels, cached after first
+        read (``jax.default_backend()`` is non-trivially costly under the
+        tunnel backend and cannot change without a process restart)."""
+        cached = getattr(self, "_backend_name", None)
+        if cached is not None:
+            return cached
+        try:
+            import jax
+
+            self._backend_name = jax.default_backend()
+        except Exception:  # noqa: BLE001 - health must answer regardless
+            self._backend_name = "unknown"
+        return self._backend_name
+
+    def restart_counts(self) -> dict:
+        """Process-wide restart/recovery counts by classified cause
+        (``run_restarts_total`` + the scorer's kernel recoveries) for the
+        health payload: ``{"total": N, "<cause>": n, ...}``."""
+        out: dict = {"total": 0}
+        for name in ("run_restarts_total", "serve_kernel_recoveries_total"):
+            for labels, value in GLOBAL_REGISTRY.counter(name).collect():
+                if not value:
+                    continue
+                out["total"] += int(value)
+                key = labels.get("cause", "unclassified")
+                out[key] = out.get(key, 0) + int(value)
+        return out
+
+    def degraded_reasons(self, version=None) -> list:
+        """Why this (otherwise alive) server is serving worse answers:
+        open/half-open circuit breakers, both the per-coordinate store
+        breakers and the scorer's kernel breaker. Empty = fully healthy."""
+        v = version if version is not None else self.registry.current
+        reasons = []
+        try:
+            snap = v.scorer.breaker_snapshot()
+        except Exception:  # noqa: BLE001 - harness fakes lack a scorer
+            return reasons
+        for cid, s in sorted(snap.items()):
+            if s.get("state") in ("open", "half_open"):
+                kind = "kernel" if cid == "__kernel__" else f"store:{cid}"
+                reasons.append(f"breaker_{s['state']}:{kind}")
+        return reasons
 
     @property
     def latency(self):

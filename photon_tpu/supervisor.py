@@ -56,6 +56,8 @@ __all__ = [
     "AttemptFailure",
     "RestartsExhausted",
     "run_with_recovery",
+    "RecoveryJournal",
+    "RunSupervisor",
     "Heartbeat",
     "PeerReport",
     "PeerWatchdog",
@@ -244,16 +246,21 @@ class RestartPolicy:
 
 @dataclasses.dataclass
 class AttemptFailure:
-    """One failed attempt, for the supervision log."""
+    """One failed attempt, for the supervision log. ``cause`` is the
+    classified backend cause (``runtime/backend_guard``) when the failure
+    went through :class:`RunSupervisor`; None for the plain retry loop."""
 
     attempt: int
     error_type: str
     message: str
     seconds: float
+    cause: Optional[str] = None
 
 
 class RestartsExhausted(RuntimeError):
-    """Raised when every attempt in the budget failed; carries the history."""
+    """Raised when every attempt in the budget failed; carries the history
+    (and, via :attr:`cause`, the last classified backend cause when the
+    attempts ran under a :class:`RunSupervisor`)."""
 
     def __init__(self, failures: Sequence[AttemptFailure], last: BaseException):
         self.failures = list(failures)
@@ -262,6 +269,10 @@ class RestartsExhausted(RuntimeError):
             f"{len(self.failures)} attempt(s) failed; last: "
             f"{type(last).__name__}: {last}"
         )
+
+    @property
+    def cause(self) -> Optional[str]:
+        return self.failures[-1].cause if self.failures else None
 
 
 def run_with_recovery(
@@ -303,6 +314,207 @@ def run_with_recovery(
             if delay > 0:
                 sleep(delay)
     raise AssertionError("unreachable")
+
+
+# ---------------------------------------------------------------- supervision
+#
+# RunSupervisor formalizes what the ad-hoc TPU recovery tooling grew by
+# hand (TPU_RECOVERY.jsonl: per-attempt {attempt, seconds, ok, tail, time}
+# rows appended by scripts/tpu_recovery_daemon.py): classified restarts
+# from checkpoints, an append-only machine-readable journal under the
+# write_metrics_jsonl atomic O_APPEND contract, restart counters, and
+# recovery.* trace events — docs/robustness.md §"Recovery journal".
+
+
+class RecoveryJournal:
+    """Append-only JSONL record of supervision events.
+
+    Each row: ``{"time": <ISO-8601 UTC>, "event": <name>, "pid": ...,
+    **fields}``. Writes go through ``utils.write_metrics_jsonl`` — one
+    unbuffered whole-line O_APPEND write per row — so a supervisor restart
+    racing the dying attempt's final record interleaves whole lines, never
+    torn ones, and readers can tail the journal live. Every row is also
+    mirrored as a ``recovery.<event>`` trace instant so a chaos drill's
+    journal and timeline tell one story."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def record(self, event: str, _mirror: bool = True, **fields) -> None:
+        """Append one row; ``_mirror=False`` skips the trace instant for
+        events whose canonical instant is emitted elsewhere (e.g.
+        ``backend_failover``, where ``backend_guard.record_failover`` owns
+        the timeline event — one failover must be ONE event)."""
+        from photon_tpu.obs import instant
+        from photon_tpu.utils import write_metrics_jsonl
+
+        row = {
+            "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "event": event,
+            "pid": os.getpid(),
+            **fields,
+        }
+        try:
+            write_metrics_jsonl(self.path, [row])
+        except OSError:
+            pass  # the journal is evidence, never a new failure mode
+        if _mirror:
+            instant(f"recovery.{event}", cat="recovery", **fields)
+
+
+class RunSupervisor:
+    """Checkpoint-resume restart supervision with classified causes.
+
+    Wraps a training attempt factory exactly like :func:`run_with_recovery`
+    (same :class:`RestartPolicy` decorrelated-jitter backoff, same
+    retryable/fatal split, same ``--checkpoint-dir`` fast-forward contract)
+    and adds the observability the ad-hoc recovery log proved necessary:
+
+    * every failure is classified (``runtime/backend_guard``:
+      init_unavailable / compile_error / device_lost / oom; plus
+      ``preemption``/``io`` from the exception type) and counted in
+      ``run_restarts_total{cause=...}``;
+    * every attempt start/failure/success/exhaustion lands in the
+      :class:`RecoveryJournal` and as a ``recovery.*`` trace instant;
+    * under ``failover_policy="failover"`` a classified backend-level
+      failure re-probes the backend between attempts and re-enters on CPU
+      when the accelerator stays dead (the swap stamped via
+      ``backend_guard.guard_snapshot`` — bench provenance and the PR 6
+      gate then refuse accelerator comparisons), instead of burning every
+      attempt on the same wedged grant.
+    """
+
+    def __init__(
+        self,
+        policy: RestartPolicy = RestartPolicy(),
+        journal: Optional[object] = None,
+        logger=None,
+        failover_policy: str = "strict",
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if isinstance(journal, str):
+            journal = RecoveryJournal(journal)
+        self.policy = policy
+        self.journal = journal
+        self.logger = logger
+        self.failover_policy = failover_policy
+        self.sleep = sleep
+
+    @staticmethod
+    def classify(err: BaseException) -> str:
+        """Cause label for the restart counter/journal: the backend
+        classification when it matches, else the exception family."""
+        from photon_tpu.faults import PreemptionError
+        from photon_tpu.runtime.backend_guard import (
+            CAUSE_UNKNOWN,
+            classify_backend_error,
+        )
+
+        if isinstance(err, PreemptionError):
+            return "preemption"
+        cause = classify_backend_error(err)
+        if cause != CAUSE_UNKNOWN:
+            return cause
+        if isinstance(err, (OSError, ConnectionError)):
+            return "io"
+        return CAUSE_UNKNOWN
+
+    def _journal(self, event: str, **fields) -> None:
+        if self.journal is not None:
+            self.journal.record(event, **fields)
+        else:
+            from photon_tpu.obs import instant
+
+            instant(f"recovery.{event}", cat="recovery", **fields)
+
+    def _maybe_failover(self, cause: str) -> None:
+        """Between attempts, under the failover policy only: a backend-
+        level failure re-probes in a subprocess (fresh deadline) and pins
+        CPU when the accelerator is still dead. No live device arrays
+        exist between attempts — each attempt rebuilds from checkpoint —
+        so the full client re-init is safe HERE and only here."""
+        if self.failover_policy != "failover":
+            return
+        from photon_tpu.runtime import backend_guard as bg
+
+        if cause not in (bg.CAUSE_INIT_UNAVAILABLE, bg.CAUSE_DEVICE_LOST,
+                         bg.CAUSE_COMPILE_ERROR):
+            return
+        probe = bg.probe_backend()
+        if probe.ok:
+            return
+        # record_failover owns the canonical recovery.backend_failover
+        # trace instant; the journal row is written un-mirrored so one
+        # failover is ONE timeline event.
+        if self.journal is not None:
+            self.journal.record("backend_failover", _mirror=False,
+                                to="cpu", cause=probe.cause,
+                                reason=probe.reason)
+        bg.record_failover(probe, logger=self.logger)
+        try:
+            from jax.extend.backend import clear_backends
+
+            clear_backends()
+        except Exception:  # noqa: BLE001 - version-dependent API
+            pass
+
+    def run(self, make_attempt: Callable[[int], object]):
+        """Run ``make_attempt(attempt_index)`` under the policy; returns
+        the first successful attempt's result. Non-retryable errors
+        propagate immediately (journaled as ``fatal``); an exhausted
+        budget raises :class:`RestartsExhausted` whose ``cause`` is the
+        last classified failure."""
+        from photon_tpu.obs.metrics import REGISTRY
+
+        restarts = REGISTRY.counter(
+            "run_restarts_total",
+            "training restarts/recoveries by classified cause "
+            "(docs/robustness.md §recovery journal)",
+        )
+        failures: list[AttemptFailure] = []
+        delays = self.policy.delays()
+        for attempt in range(self.policy.max_restarts + 1):
+            t0 = time.monotonic()
+            self._journal("attempt_start", attempt=attempt)
+            try:
+                result = make_attempt(attempt)
+            except BaseException as e:  # noqa: BLE001 - classified below
+                took = round(time.monotonic() - t0, 3)
+                cause = self.classify(e)
+                retryable = self.policy.is_retryable(e)
+                will_restart = retryable and attempt < self.policy.max_restarts
+                failures.append(AttemptFailure(
+                    attempt, type(e).__name__, str(e), took, cause=cause))
+                self._journal(
+                    "attempt_failed", attempt=attempt, cause=cause,
+                    error=f"{type(e).__name__}: {str(e)[:300]}",
+                    seconds=took, ok=False, will_restart=will_restart)
+                if self.logger is not None:
+                    self.logger.warning(
+                        "attempt %d failed after %.1fs [%s] (%s: %s); %s",
+                        attempt, took, cause, type(e).__name__, e,
+                        "restarting" if will_restart
+                        else "fatal" if not retryable else "budget exhausted")
+                if not retryable:
+                    self._journal("fatal", attempt=attempt, cause=cause)
+                    raise
+                if not will_restart:
+                    self._journal("exhausted", attempts=len(failures),
+                                  cause=cause)
+                    raise RestartsExhausted(failures, e) from e
+                restarts.inc(cause=cause)
+                self._maybe_failover(cause)
+                delay = next(delays)
+                self._journal("restart", attempt=attempt + 1, cause=cause,
+                              backoff_s=round(delay, 3))
+                if delay > 0:
+                    self.sleep(delay)
+                continue
+            took = round(time.monotonic() - t0, 3)
+            self._journal("run_ok", attempt=attempt, seconds=took, ok=True,
+                          prior_failures=len(failures))
+            return result
+        raise AssertionError("unreachable")
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,148 @@
+"""Recovery smoke (ci.sh stage; docs/robustness.md §backend resilience).
+
+Exercises the fail-fast backend contract end to end WITHOUT a chip, using
+the probe's injection seam (``probe_code`` runs arbitrary child code):
+
+1. an injected init HANG is killed at the configured deadline — seconds,
+   not the ~1500 s the operational record shows (TPU_RECOVERY.jsonl) —
+   and classified ``init_unavailable``;
+2. an injected ``Unable to initialize backend: UNAVAILABLE`` init failure
+   (the recovery log's literal signature) classifies ``init_unavailable``;
+3. an injected RESOURCE_EXHAUSTED death classifies ``oom``;
+4. ``ensure_backend`` enforces the policy ladder on a failing probe:
+   ``strict`` raises a classified ``BackendUnusable``; ``failover``
+   re-enters on CPU and stamps the swap into the guard snapshot;
+5. a ``RunSupervisor`` drill: a flaky attempt restarts with the cause
+   classified and journaled (valid JSONL rows, ``run_restarts_total``
+   counter bumped), then an always-failing attempt exhausts the budget
+   and surfaces the last classified cause.
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from photon_tpu.runtime import backend_guard as bg  # noqa: E402
+from photon_tpu.supervisor import (  # noqa: E402
+    RecoveryJournal,
+    RestartPolicy,
+    RestartsExhausted,
+    RunSupervisor,
+)
+
+HANG = "import time; time.sleep(600)"
+UNAVAILABLE = (
+    "import sys; sys.stderr.write('RuntimeError: Unable to initialize "
+    "backend: UNAVAILABLE: TPU backend setup/compile error\\n'); sys.exit(1)"
+)
+OOM = (
+    "import sys; sys.stderr.write('RESOURCE_EXHAUSTED: out of memory "
+    "allocating 16G\\n'); sys.exit(1)"
+)
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"RECOVERY SMOKE FAILED: {msg}", file=sys.stderr)
+        sys.exit(1)
+    print(f"  ok: {msg}")
+
+
+def main() -> None:
+    print("== injected init-hang dies at the deadline ==")
+    t0 = time.monotonic()
+    r = bg.probe_backend(timeout_s=2.0, probe_code=HANG)
+    took = time.monotonic() - t0
+    check(not r.ok, "hanging probe reported failure")
+    check(took < 30.0, f"killed at the deadline ({took:.1f}s, not ~1500s)")
+    check(r.cause == bg.CAUSE_INIT_UNAVAILABLE,
+          f"hang classified init_unavailable (got {r.cause})")
+
+    print("== injected UNAVAILABLE init classifies ==")
+    r = bg.probe_backend(timeout_s=30.0, probe_code=UNAVAILABLE)
+    check(not r.ok and r.cause == bg.CAUSE_INIT_UNAVAILABLE,
+          f"UNAVAILABLE classified init_unavailable (got {r.cause})")
+
+    print("== injected OOM init classifies ==")
+    r = bg.probe_backend(timeout_s=30.0, probe_code=OOM)
+    check(not r.ok and r.cause == bg.CAUSE_OOM,
+          f"RESOURCE_EXHAUSTED classified oom (got {r.cause})")
+
+    print("== policy ladder on a failing probe ==")
+    bg.reset_guard()
+    try:
+        bg.ensure_backend(policy="strict", timeout_s=30.0,
+                          probe_code=UNAVAILABLE)
+        check(False, "strict raised BackendUnusable")
+    except bg.BackendUnusable as e:
+        check(e.cause == bg.CAUSE_INIT_UNAVAILABLE,
+              f"strict raised classified BackendUnusable ({e.cause})")
+    bg.reset_guard()
+    snap = bg.ensure_backend(policy="failover", timeout_s=30.0,
+                             probe_code=UNAVAILABLE)
+    check(snap["backend"] == "cpu" and snap["failover"] is not None,
+          "failover re-entered on CPU with the swap stamped")
+    check(snap["failover"]["cause"] == bg.CAUSE_INIT_UNAVAILABLE,
+          "failover event carries the classified cause")
+    bg.reset_guard()
+
+    print("== RunSupervisor drill: classified restart + journal ==")
+    from photon_tpu.faults import DeviceLostError
+    from photon_tpu.obs.metrics import REGISTRY
+
+    with tempfile.TemporaryDirectory() as td:
+        journal_path = os.path.join(td, "recovery.jsonl")
+        calls = []
+
+        def flaky(i):
+            calls.append(i)
+            if i == 0:
+                raise DeviceLostError("chip fell off the bus")
+            return "recovered"
+
+        before = REGISTRY.counter("run_restarts_total").value(
+            cause="device_lost")
+        sup = RunSupervisor(
+            RestartPolicy(max_restarts=2, backoff_seconds=0, jitter=False),
+            journal=RecoveryJournal(journal_path),
+            sleep=lambda s: None,
+        )
+        check(sup.run(flaky) == "recovered" and calls == [0, 1],
+              "one classified restart, then success")
+        after = REGISTRY.counter("run_restarts_total").value(
+            cause="device_lost")
+        check(after == before + 1,
+              'run_restarts_total{cause="device_lost"} bumped')
+        rows = [json.loads(line)
+                for line in open(journal_path).read().splitlines()]
+        events = [r["event"] for r in rows]
+        check(events == ["attempt_start", "attempt_failed", "restart",
+                         "attempt_start", "run_ok"],
+              f"journal tells the whole story ({events})")
+        check(rows[1]["cause"] == "device_lost",
+              "journaled failure carries the classified cause")
+
+        def doomed(i):
+            raise RuntimeError("Unable to initialize backend: UNAVAILABLE")
+
+        sup2 = RunSupervisor(
+            RestartPolicy(max_restarts=1, backoff_seconds=0, jitter=False),
+            journal=RecoveryJournal(os.path.join(td, "r2.jsonl")),
+            sleep=lambda s: None,
+        )
+        try:
+            sup2.run(doomed)
+            check(False, "exhausted budget raised RestartsExhausted")
+        except RestartsExhausted as e:
+            check(e.cause == bg.CAUSE_INIT_UNAVAILABLE,
+                  f"exhaustion surfaces the last classified cause "
+                  f"({e.cause})")
+
+    print("recovery smoke ok")
+
+
+if __name__ == "__main__":
+    main()

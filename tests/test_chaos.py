@@ -129,6 +129,74 @@ def test_corrupt_checkpoint_falls_back_then_resumes_identical(
         np.testing.assert_array_equal(a, b)
 
 
+def test_device_lost_mid_sweep_recovers_in_run_bit_identical(
+    tmp_path, reference
+):
+    """ISSUE 10 acceptance (chaos drill): a seeded device_lost injected
+    mid-sweep triggers the IN-RUN recovery — checkpoint → executable-cache
+    clear → re-init → resume — inside ONE attempt (no supervisor restart),
+    and the final coefficients equal the uninterrupted run's bit for bit.
+    The recovery is visible in run_restarts_total{cause="device_lost"}."""
+    from photon_tpu.obs.metrics import REGISTRY
+
+    bundle, vbundle, ref = reference
+    ckdir = str(tmp_path / "ck")
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="descent.device", error="device_lost", after=2,
+                  count=1),
+    ])
+    before = REGISTRY.counter("run_restarts_total").value(
+        cause="device_lost")
+    mgr = CheckpointManager(ckdir)
+    with active_plan(plan) as inj:
+        # ONE attempt: the device loss must be absorbed in-run, not by a
+        # supervisor restart.
+        recovered = _estimator().fit(
+            bundle, vbundle, _config(), checkpoint_manager=mgr
+        )
+    mgr.close()
+    assert inj.fired("descent.device") == 1      # the loss really happened
+    assert REGISTRY.counter("run_restarts_total").value(
+        cause="device_lost") == before + 1       # ...and was counted
+    # The recovery checkpointed BEFORE clearing (step snapshots exist).
+    import os
+
+    assert any(n.startswith("step-") for n in os.listdir(ckdir))
+    for a, b in zip(_final_arrays(recovered), _final_arrays(ref)):
+        np.testing.assert_array_equal(a, b)
+    assert recovered[0].evaluation.values == ref[0].evaluation.values
+
+
+def test_device_lost_escalates_to_supervisor_past_budget(
+    tmp_path, reference, monkeypatch
+):
+    """Repeated device losses exhaust the bounded in-run recoveries and
+    escalate to the RunSupervisor restart path, which classifies and
+    journals the cause before giving up."""
+    import json
+
+    from photon_tpu.supervisor import RestartsExhausted, RunSupervisor
+
+    monkeypatch.setenv("PHOTON_DEVICE_LOST_MAX_RECOVERIES", "1")
+    bundle, vbundle, _ = reference
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="descent.device", error="device_lost"),  # every step
+    ])
+    journal = str(tmp_path / "recovery.jsonl")
+    sup = RunSupervisor(
+        RestartPolicy(max_restarts=1, backoff_seconds=0, jitter=False),
+        journal=journal,
+        sleep=lambda s: None,
+    )
+    with active_plan(plan):
+        with pytest.raises(RestartsExhausted) as ei:
+            sup.run(_attempt_factory(str(tmp_path / "ck"), bundle, vbundle))
+    assert ei.value.cause == "device_lost"
+    rows = [json.loads(x) for x in open(journal).read().splitlines()]
+    assert rows[-1] == {**rows[-1], "event": "exhausted",
+                        "cause": "device_lost"}
+
+
 def test_checkpoint_write_fault_surfaces_as_retryable(tmp_path, reference):
     """An injected IO error in the background checkpoint writer surfaces on
     the next save as a RuntimeError — retryable by the supervisor, never a

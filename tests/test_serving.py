@@ -543,9 +543,15 @@ def test_chaos_server_outage_and_overload(trained, tmp_path):
         assert m["breakers"]["perUser"]["opens"] >= 1
         assert m["shed"] + m["expired"] == len(shed)
         assert m["degraded"] == len(degraded)
-        # Server is still healthy — shedding is not dying.
+        # Server is still healthy — shedding is not dying — and the open
+        # store breaker is VISIBLE as a degradation reason, not hidden
+        # behind a bare "ok" (docs/robustness.md §/healthz).
         status, health = _get(host, port, "/healthz")
-        assert status == 200 and health["status"] == "ok"
+        assert status == 200 and health["status"] in ("ok", "degraded")
+        if m["breakers"]["perUser"]["state"] != "closed":
+            assert health["status"] == "degraded"
+            assert any(r.endswith("store:perUser")
+                       for r in health["degraded"])
     finally:
         server.shutdown()
 
@@ -594,6 +600,119 @@ def test_chaos_store_stall_expires_requests_not_hangs(trained):
         # Stall over: the server recovered without a restart.
         status, body = _post(host, port, "/score", _payload(rec))
         assert status == 200
+    finally:
+        server.shutdown()
+
+
+def test_healthz_reports_backend_degraded_and_restarts(trained):
+    """ISSUE 10 satellite: /healthz carries backend identity, an explicit
+    degraded-reason list, and restart/recovery counts — not just
+    alive/dead (docs/robustness.md §/healthz)."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=4, max_wait_ms=1.0, cache_entities=16,
+                          max_row_nnz=32, breaker_failures=2,
+                          breaker_cooldown_s=60.0))
+    batcher = MicroBatcher(max_batch=4, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    try:
+        status, health = _get(host, port, "/healthz")
+        assert status == 200
+        assert health["status"] == "ok"
+        assert health["backend"] == "cpu"      # the live backend, honestly
+        assert health["degraded"] == []
+        assert isinstance(health["restarts"], dict)
+        assert "total" in health["restarts"]
+        # An OPEN kernel breaker surfaces as a degraded reason (still 200:
+        # the server answers, just worse — the ladder's middle rung).
+        kb = registry.current.scorer.kernel_breaker
+        for _ in range(2):
+            kb.record_failure()
+        status, health = _get(host, port, "/healthz")
+        assert status == 200
+        assert health["status"] == "degraded"
+        assert health["degraded"] == ["breaker_open:kernel"]
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_kernel_device_lost_recovers_through_breaker(trained):
+    """ISSUE 10 tentpole (serving leg): a device_lost out of the scoring
+    kernel re-initializes (executable-cache clear + re-warm) through the
+    kernel circuit breaker and the request still answers 200 with the
+    right score — one recovery, breaker closed again afterwards."""
+    from photon_tpu.obs.metrics import REGISTRY
+
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=4, max_wait_ms=1.0, cache_entities=16,
+                          max_row_nnz=32, breaker_failures=3))
+    batcher = MicroBatcher(max_batch=4, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    rec = read_records(str(d / "val.avro"))[0]
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="serving.kernel", error="device_lost", count=1),
+    ])
+    before = REGISTRY.counter("serve_kernel_recoveries_total").value(
+        cause="device_lost")
+    try:
+        with active_plan(plan) as inj:
+            status, body = _post(host, port, "/score", _payload(rec))
+        assert inj.fired("serving.kernel") == 1  # the loss really happened
+        assert status == 200 and "score" in body  # ...and was absorbed
+        assert REGISTRY.counter("serve_kernel_recoveries_total").value(
+            cause="device_lost") == before + 1
+        kb = registry.current.scorer.breaker_snapshot()["__kernel__"]
+        assert kb["state"] == "closed" and kb["failures"] == 1
+        # Healthy again end to end: scoring and health agree.
+        status, body2 = _post(host, port, "/score", _payload(rec))
+        assert status == 200
+        assert body2["score"] == pytest.approx(body["score"], abs=1e-6)
+        status, health = _get(host, port, "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        assert health["restarts"]["total"] >= 1
+    finally:
+        server.shutdown()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_kernel_repeated_errors_open_breaker_fast_fail(trained):
+    """When the device stays dead, the kernel breaker opens and requests
+    fast-fail 500 instead of burning a re-init per batch; /healthz says
+    degraded."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=4, max_wait_ms=1.0, cache_entities=16,
+                          max_row_nnz=32, breaker_failures=2,
+                          breaker_cooldown_s=60.0))
+    batcher = MicroBatcher(max_batch=4, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    rec = read_records(str(d / "val.avro"))[0]
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="serving.kernel", error="device_lost"),  # every call
+    ])
+    try:
+        with active_plan(plan):
+            statuses = [
+                _post(host, port, "/score", _payload(rec))[0]
+                for _ in range(4)
+            ]
+        assert all(s == 500 for s in statuses)  # failed, never hung
+        kb = registry.current.scorer.breaker_snapshot()["__kernel__"]
+        assert kb["state"] == "open"
+        assert kb["short_circuited"] >= 1       # recovery was NOT retried
+        status, health = _get(host, port, "/healthz")
+        assert status == 200 and health["status"] == "degraded"
+        assert "breaker_open:kernel" in health["degraded"]
     finally:
         server.shutdown()
 
