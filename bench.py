@@ -1041,6 +1041,198 @@ def bench_serve():
     }
 
 
+def bench_online():
+    """Online incremental learning round-trip (docs/online.md): train a
+    small GAME model, serve it, then stream labeled events through the
+    :class:`OnlineTrainer` publishing per-entity deltas into the LIVE
+    registry. Reports event→published-delta freshness (p50/p95), refresh
+    throughput (entities/sec), and proves the served path actually moved:
+    a probe entity's /score must change after its delta lands, with ZERO
+    scoring-kernel retraces across patch publication."""
+    import http.client
+
+    from photon_tpu.estimators.config import (
+        FixedEffectDataConfig,
+        GLMOptimizationConfiguration,
+        RandomEffectDataConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.estimators.game_transformer import SCORE_KERNEL_NAME
+    from photon_tpu.index.index_map import (
+        DefaultIndexMap,
+        build_mmap_index,
+        feature_key,
+    )
+    from photon_tpu.io.data_reader import FeatureShardConfig
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.obs import retrace
+    from photon_tpu.online import (
+        OnlineEvent,
+        OnlineTrainer,
+        OnlineTrainerConfig,
+        RegistryPublisher,
+    )
+    from photon_tpu.optim import RegularizationContext, RegularizationType
+    from photon_tpu.serving import (
+        MicroBatcher,
+        ModelRegistry,
+        ScoringServer,
+        ServingConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    import tempfile
+
+    n_users, rows_per_user, d_global, d_user = (
+        (32, 8, 64, 4) if SMOKE else (256, 16, 1024, 8))
+    n_events = 256 if SMOKE else 4096
+    bundle = _game_bundle(n_users, rows_per_user, d_global, d_user)
+    data_configs = {
+        "fixed": FixedEffectDataConfig("global"),
+        "perUser": RandomEffectDataConfig(re_type="userId",
+                                          feature_shard="global"),
+    }
+    estimator = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs=data_configs,
+        n_sweeps=1,
+    )
+    gcfg = {
+        "fixed": GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=15),
+        "perUser": GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=15),
+    }
+    model = estimator.fit(bundle, None, [gcfg])[0].model
+
+    feats = bundle.features["global"]
+    dim = feats.dim
+    fidx, fval = np.asarray(feats.idx), np.asarray(feats.val)
+    users = bundle.id_tags["userId"]
+    labels = np.asarray(bundle.labels)
+
+    def event_features(r):
+        return [
+            {"name": "c", "term": str(int(c)), "value": float(v)}
+            for c, v in zip(fidx[r], fval[r]) if c < dim
+        ]
+
+    with tempfile.TemporaryDirectory() as td:
+        mdir = os.path.join(td, "best")
+        imap = DefaultIndexMap(
+            [feature_key("c", str(j)) for j in range(dim)])
+        shard_cfgs = {"global": FeatureShardConfig(
+            ("features",), add_intercept=False)}
+        save_game_model(
+            mdir, model, {"global": imap},
+            shard_by_coordinate={"perUser": "global"},
+            shard_configs=shard_cfgs,
+        )
+        build_mmap_index(imap, os.path.join(td, "index", "global"))
+        cfg = ServingConfig(max_batch=32, max_wait_ms=1.0,
+                            cache_entities=max(64, n_users),
+                            max_row_nnz=32)
+        registry = ModelRegistry(mdir, cfg)
+        batcher = MicroBatcher(max_batch=cfg.max_batch,
+                               max_wait_ms=cfg.max_wait_ms)
+        server = ScoringServer(registry, batcher, port=0)
+        server.start()
+        host, port = server.address
+        retraces0 = retrace.retraces_after_warmup(SCORE_KERNEL_NAME)
+
+        def score(payload) -> float:
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            conn.request("POST", "/score", body=json.dumps(payload).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = json.loads(resp.read())
+            conn.close()
+            if resp.status != 200:
+                raise RuntimeError(f"serve returned {resp.status}: {body}")
+            return float(body["score"])
+
+        probe_row = 0
+        probe_payload = {
+            "features": event_features(probe_row),
+            "entities": {"userId": str(users[probe_row])},
+        }
+        score_before = score(probe_payload)
+
+        trainer = OnlineTrainer.from_game_model(
+            model, data_configs, {"global": imap}, shard_cfgs,
+            OnlineTrainerConfig(
+                window=32, max_event_nnz=32,
+                refresh_batch=max(8, n_users // 4), chunk=256,
+                incremental_weight=1.0, reg_weight=1.0, max_iterations=15,
+            ),
+            publisher=RegistryPublisher(registry),
+        )
+
+        # The event stream: re-labeled observations over the trained
+        # bundle's rows, stamped at ingest time so the freshness histogram
+        # measures the real consume→publish wall.
+        rng = np.random.default_rng(11)
+        order = rng.permutation(bundle.n_rows)[:n_events]
+
+        def stream():
+            for i, r in enumerate(order):
+                yield OnlineEvent(
+                    entities={"userId": str(users[r])},
+                    features=event_features(r),
+                    label=float(labels[r]),
+                    ts=time.time(),
+                    seq=i,
+                )
+
+        t0 = time.perf_counter()
+        summary = trainer.run(stream())
+        online_wall = time.perf_counter() - t0
+
+        score_after = score(probe_payload)
+        e2e_t0 = next(
+            (f for s in summary["refreshes"] for f in s["freshness_s"]),
+            None,
+        )
+        retraces_after = retrace.retraces_after_warmup(SCORE_KERNEL_NAME)
+        fresh_snapshot = server.freshness()
+        server.shutdown()
+
+    fresh = sorted(
+        f for s in summary["refreshes"] for f in s["freshness_s"])
+    refresh_seconds = sum(s["seconds"] for s in summary["refreshes"])
+    refreshed = summary["entities_refreshed"]
+
+    def q(p: float):
+        return fresh[min(len(fresh) - 1, int(p * len(fresh)))] if fresh \
+            else None
+
+    return {
+        "online_freshness_p50_ms": (
+            round(q(0.50) * 1e3, 2) if fresh else None),
+        "online_freshness_p95_ms": (
+            round(q(0.95) * 1e3, 2) if fresh else None),
+        "online_freshness_samples": len(fresh),
+        "online_entities_refreshed_per_sec": (
+            round(refreshed / refresh_seconds, 1)
+            if refresh_seconds > 0 else None),
+        "online_entities_refreshed": refreshed,
+        "online_events": summary["events"],
+        "online_deltas_published": summary["deltas"],
+        "online_refresh_cycles": summary["cycles"],
+        "online_wall_seconds": round(online_wall, 3),
+        "online_patch_seq": fresh_snapshot.get("patch_seq"),
+        # The served-path acceptance: scores MOVED after the delta, and the
+        # stable-shape contract held across every patch publication.
+        "online_served_score_changed": bool(
+            abs(score_after - score_before) > 1e-9),
+        "online_score_probe_delta": round(score_after - score_before, 6),
+        "online_retraces_after_warmup": int(retraces_after - retraces0),
+        "_online_e2e_first_freshness_s": e2e_t0,
+    }
+
+
 def _game_scale_data_path():
     """ISSUE 9 acceptance instrument: same-box A/B of the ingest→device→
     solve data path, judged by the PR 6 timeline analyzer.
@@ -2138,6 +2330,7 @@ def main():
         ("owlqn_tron", bench_owlqn_tron),
         ("game", bench_game),
         ("serve", bench_serve),
+        ("online", bench_online),
         ("ingest", bench_ingest),
         ("game_scale", bench_game_scale),
         ("tuner", bench_tuner),
@@ -2148,6 +2341,7 @@ def main():
             "owlqn_tron": "owlqn_linear_l1_samples_per_sec",
             "game": "game_samples_per_sec",
             "serve": "serve_rows_per_sec",
+            "online": "online_freshness_p50_ms",
             "ingest": "ingest_rows_per_sec",
             "game_scale": "game_scale_total_seconds",
             "tuner": "tuner_trials",

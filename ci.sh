@@ -77,6 +77,16 @@ echo "== obs smoke (tracing + Prometheus exposition; docs/observability.md) =="
 # text-format grammar (latency, throughput, queue depth, kernel retraces).
 python scripts/obs_smoke.py
 
+echo "== online smoke (streaming delta trainer -> live server; docs/online.md) =="
+# The online incremental-learning loop end to end: a small event stream
+# replays through the REAL online driver publishing deltas over HTTP
+# against a live scoring server — served scores must change post-delta
+# (model version unmoved), the freshness metric must land in the trace and
+# /healthz watermarks, the patch journal + replay cursor must advance, and
+# the scoring kernel must log ZERO retraces-after-warmup across patch
+# publication.
+python scripts/online_smoke.py
+
 echo "== bench analysis (advisory compare of newest artifacts + doc sync) =="
 # Backend-aware regression gate over the two newest checked-in bench
 # artifacts (docs/observability.md §gate). ADVISORY: verdicts print on

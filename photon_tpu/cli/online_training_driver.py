@@ -1,0 +1,243 @@
+"""Online incremental training driver (docs/online.md).
+
+The sixth driver: where the training driver batch-fits a model directory
+and the serving driver scores it, this one sits BETWEEN them — it tails an
+event log, re-solves dirty entities on a cadence, and publishes model
+deltas into a live scoring server:
+
+    python -m photon_tpu.cli.online_training_driver \\
+        --model-dir out/best --events events.jsonl \\
+        --serve-url http://127.0.0.1:8080 --output-dir online_out --follow
+
+Without ``--serve-url`` the trainer runs open-loop (state + patch journal
+advance, nothing served) — the shadow-evaluation mode. The replay cursor
+(``<output-dir>/online-cursor.json``) advances only past PUBLISHED events,
+so a restarted driver resumes exactly where its last delta left off.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Optional, Sequence
+
+from photon_tpu.utils import PhotonLogger
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="online-training-driver",
+        description="Stream events into per-entity model deltas published "
+                    "to a live GAME scoring server.",
+    )
+    p.add_argument("--model-dir", required=True,
+                   help="a 'best' or 'models/<i>' directory from the "
+                        "training driver: the base model whose fixed "
+                        "effects freeze and whose random-effect posteriors "
+                        "seed the refresh anchors")
+    p.add_argument("--index-dir", default=None,
+                   help="per-shard index stores (default: "
+                        "<model-dir>/../index)")
+    p.add_argument("--events", required=True,
+                   help="JSONL event log (docs/online.md §schema)")
+    p.add_argument("--serve-url", default=None,
+                   help="live scoring server base URL; deltas publish via "
+                        "POST /admin/patch (omit to run open-loop)")
+    p.add_argument("--output-dir", default=None,
+                   help="photon.log + patch-journal.jsonl + "
+                        "online-cursor.json land here")
+    p.add_argument("--window", type=int, default=64,
+                   help="sliding-window rows per entity (the refresh's "
+                        "training data)")
+    p.add_argument("--max-event-nnz", type=int, default=64,
+                   help="per-shard feature cap per event (stable-shape "
+                        "contract; over-cap events are rejected)")
+    p.add_argument("--refresh-batch", type=int, default=4096,
+                   help="dirty entities per refresh cycle")
+    p.add_argument("--chunk", type=int, default=256,
+                   help="blessed entity-chunk size for the batched Newton "
+                        "solves (must be on PHOTON_RE_CHUNK_LADDER)")
+    p.add_argument("--cadence-s", type=float, default=1.0,
+                   help="refresh cadence in seconds (0 = only when "
+                        "refresh-batch entities are dirty, or at drain)")
+    p.add_argument("--incremental-weight", type=float, default=1.0,
+                   help="Gaussian-prior anchor strength to the previous "
+                        "posterior (0 = plain warm start, no anchoring)")
+    p.add_argument("--reg-weight", type=float, default=1.0,
+                   help="L2 weight per refresh solve")
+    p.add_argument("--max-iter", type=int, default=30)
+    p.add_argument("--tol", type=float, default=1e-7)
+    p.add_argument("--max-cycles", type=int, default=0,
+                   help="stop after N refresh cycles (0 = run to stream "
+                        "end / until interrupted under --follow)")
+    p.add_argument("--follow", action="store_true",
+                   help="tail the event log instead of stopping at EOF")
+    p.add_argument("--no-resume", action="store_true",
+                   help="ignore the saved replay cursor and start from "
+                        "event seq 0")
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   help="read events through the bounded background "
+                        "prefetch stage (io/prefetch.py; default "
+                        "$PHOTON_PREFETCH_DEPTH, 0 disables)")
+    from photon_tpu.cli.params import (
+        add_backend_policy_flag,
+        add_compilation_cache_flag,
+        add_fault_plan_flag,
+        add_trace_flag,
+    )
+
+    add_backend_policy_flag(p)
+    add_compilation_cache_flag(p)
+    add_fault_plan_flag(p)
+    add_trace_flag(p)
+    return p
+
+
+def _load_base(args, logger):
+    """Model dir → (GameModel, data configs, index maps, shard configs) —
+    the same metadata reconstruction the serving registry does, so the
+    trainer and the server can never disagree about feature assembly."""
+    from photon_tpu.estimators import (
+        FixedEffectDataConfig,
+        RandomEffectDataConfig,
+    )
+    from photon_tpu.index.index_map import MmapIndexMap
+    from photon_tpu.io.data_reader import FeatureShardConfig
+    from photon_tpu.io.model_io import default_index_root, load_game_model
+
+    with open(os.path.join(args.model_dir, "game-metadata.json")) as f:
+        meta = json.load(f)
+    shards = {info["feature_shard"] for info in meta["coordinates"].values()}
+    index_root = args.index_dir or default_index_root(args.model_dir)
+    index_maps = {
+        s: MmapIndexMap(os.path.join(index_root, s)) for s in sorted(shards)
+    }
+    for im in index_maps.values():
+        im.preload()
+    model, meta = load_game_model(args.model_dir, index_maps)
+    data_configs = {}
+    for cid, info in meta["coordinates"].items():
+        if info["type"] == "fixed":
+            data_configs[cid] = FixedEffectDataConfig(info["feature_shard"])
+        else:
+            data_configs[cid] = RandomEffectDataConfig(
+                re_type=info["re_type"], feature_shard=info["feature_shard"]
+            )
+    saved_shards = meta.get("feature_shards", {})
+    shard_configs = {
+        s: (
+            FeatureShardConfig(
+                feature_bags=tuple(saved_shards[s]["feature_bags"]),
+                add_intercept=saved_shards[s]["add_intercept"],
+            )
+            if s in saved_shards
+            else FeatureShardConfig(feature_bags=("features",))
+        )
+        for s in index_maps
+    }
+    logger.info(
+        "online base model: %s (%d coordinates, shards: %s)",
+        args.model_dir, len(data_configs), ",".join(sorted(index_maps)),
+    )
+    return model, data_configs, index_maps, shard_configs
+
+
+def run(argv: Optional[Sequence[str]] = None) -> dict:
+    args = build_arg_parser().parse_args(argv)
+    from photon_tpu.cli.params import finish_trace
+
+    try:
+        return _run(args)
+    finally:
+        finish_trace(args.trace_out)
+
+
+def _run(args) -> dict:
+    from photon_tpu.cli.params import (
+        enable_backend_guard,
+        enable_compilation_cache,
+        enable_fault_plan,
+        enable_trace,
+    )
+    from photon_tpu.io.prefetch import prefetch
+    from photon_tpu.online import (
+        EventCursor,
+        HttpPublisher,
+        OnlineTrainer,
+        OnlineTrainerConfig,
+        PatchJournal,
+        iter_events,
+    )
+
+    enable_backend_guard(args)
+    enable_compilation_cache(args.compilation_cache_dir)
+    enable_fault_plan(args.fault_plan)
+    enable_trace(args.trace_out)
+    plogger = PhotonLogger(args.output_dir)
+    logger = plogger.logger
+
+    model, data_configs, index_maps, shard_configs = _load_base(args, logger)
+    config = OnlineTrainerConfig(
+        window=args.window,
+        max_event_nnz=args.max_event_nnz,
+        refresh_batch=args.refresh_batch,
+        chunk=args.chunk,
+        cadence_s=args.cadence_s,
+        incremental_weight=args.incremental_weight,
+        reg_weight=args.reg_weight,
+        max_iterations=args.max_iter,
+        tolerance=args.tol,
+    )
+    publisher = HttpPublisher(args.serve_url) if args.serve_url else None
+    journal = PatchJournal(args.output_dir) if args.output_dir else None
+    cursor = EventCursor(args.output_dir) if args.output_dir else None
+    trainer = OnlineTrainer.from_game_model(
+        model, data_configs, index_maps, shard_configs, config,
+        publisher=publisher, journal=journal, cursor=cursor,
+    )
+    start_seq = 0
+    if cursor is not None and not args.no_resume:
+        start_seq = cursor.load()
+        if start_seq:
+            logger.info("resuming event replay at seq %d (cursor)",
+                        start_seq)
+    events = iter_events(
+        args.events, start_seq=start_seq, follow=args.follow,
+        # Idle ticks on a quiet followed stream: the cadence must still
+        # fire with dirty entities pending, not block until the next event.
+        idle_yield_s=args.cadence_s if args.follow else 0.0,
+    )
+    # Background tailing through the bounded prefetch stage: event decode
+    # and the refresh solves overlap, same pipeline shape as training
+    # ingest (io/prefetch.py).
+    events = prefetch(events, depth=args.prefetch_depth)
+    try:
+        summary = trainer.run(
+            events, max_cycles=args.max_cycles or None,
+        )
+    except KeyboardInterrupt:
+        summary = {**trainer.totals, "interrupted": True}
+    summary = {
+        "model_dir": args.model_dir,
+        "events_path": args.events,
+        "serve_url": args.serve_url,
+        "start_seq": start_seq,
+        **{k: v for k, v in summary.items() if k != "refreshes"},
+    }
+    logger.info("online trainer done: %s", json.dumps(summary))
+    if args.output_dir:
+        with open(os.path.join(args.output_dir,
+                               "online-summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+    plogger.close()
+    return summary
+
+
+def main() -> None:  # pragma: no cover - console entry
+    from photon_tpu.cli.params import console_main
+
+    console_main(run)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -42,6 +42,15 @@ site                        where / what a fired fault simulates
 ``serving.kernel``          scoring-kernel invocation on the batcher
                             worker (``error="device_lost"`` exercises the
                             scorer's breaker-gated re-init + retry)
+``online.refresh``          top of each online refresh cycle's solve
+                            (``online/trainer.py``; ``error="device_lost"``
+                            drives the in-run recovery: cache clear +
+                            bit-identical re-solve, bounded by
+                            PHOTON_DEVICE_LOST_MAX_RECOVERIES)
+``online.publish``          delta publication, before anything applies
+                            (a fired error must leave the serving store,
+                            trainer state, dirty set, journal, and cursor
+                            untouched — the next cycle retries)
 ==========================  ================================================
 
 A plan is a list of :class:`FaultSpec`; each spec independently counts the

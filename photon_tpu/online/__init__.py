@@ -1,0 +1,76 @@
+"""Online incremental learning: streaming per-entity refresh → serving delta.
+
+Upstream photon-ml can only batch-retrain GAME models — ``GameTrainingDriver``
+re-runs full coordinate descent over Spark RDDs, so score freshness is
+bounded by the retrain cadence (PAPER.md §0). This subsystem closes the loop
+the rest of the stack is already positioned for (ROADMAP item 3):
+
+* **events** — a durable JSONL event log (one labeled observation per line,
+  monotone ``seq``), a replay cursor for restart-exact resume, and the
+  feature resolver that turns an event's (bag, name, term, value) lists into
+  fixed-width ELL rows through the SAME index maps training used.
+* **state** — per-entity sliding windows (the data each refresh re-solves
+  on), the dirty set (entities with events since their last refresh, oldest
+  first), and the trainer's posterior state (means + variances per entity —
+  the anchor for the next refresh's :class:`PriorDistribution`).
+* **trainer** — :class:`OnlineTrainer`: consumes the stream (optionally via
+  ``io/prefetch.prefetch``), marks entities dirty as events arrive, and on a
+  cadence re-solves dirty entities in micro-batches through the blessed
+  chunk-ladder Newton kernels (``game/newton_re.py``), each refresh anchored
+  to the entity's previous posterior. Mid-refresh device loss recovers
+  in-run (PR 8 contract): clear executable caches, re-run bit-identically,
+  bounded by ``PHOTON_DEVICE_LOST_MAX_RECOVERIES``.
+* **delta** — publication is by MODEL DELTA: changed-entity coefficient
+  patches (never full snapshots), applied atomically to the serving
+  coefficient store + registry (``ModelRegistry.apply_delta``) with the
+  device LRU hot-set invalidated only for patched entities; a versioned
+  patch journal records every published delta.
+
+Publishers: :class:`RegistryPublisher` (in-process, the bench/test path)
+and :class:`HttpPublisher` (``POST /admin/patch`` against a live scoring
+server — the cross-process deployment shape). docs/online.md is the
+operator-facing walkthrough (event schema, dirty-set semantics, the
+delta-publish protocol, freshness SLOs).
+"""
+from photon_tpu.online.delta import (
+    EntityPatch,
+    ModelDelta,
+    PatchJournal,
+)
+from photon_tpu.online.events import (
+    EventCursor,
+    EventError,
+    EventWriter,
+    OnlineEvent,
+    append_events,
+    iter_events,
+    resolve_event_features,
+)
+from photon_tpu.online.state import EntityWindows, OnlineModelState
+from photon_tpu.online.trainer import (
+    HttpPublisher,
+    OnlineCoordinate,
+    OnlineTrainer,
+    OnlineTrainerConfig,
+    RegistryPublisher,
+)
+
+__all__ = [
+    "EntityPatch",
+    "ModelDelta",
+    "PatchJournal",
+    "EventCursor",
+    "EventError",
+    "EventWriter",
+    "OnlineEvent",
+    "append_events",
+    "iter_events",
+    "resolve_event_features",
+    "EntityWindows",
+    "OnlineModelState",
+    "HttpPublisher",
+    "OnlineCoordinate",
+    "OnlineTrainer",
+    "OnlineTrainerConfig",
+    "RegistryPublisher",
+]

@@ -57,10 +57,72 @@ class CoefficientStore:
         self.cols = cols
         self.vals = vals
         self.global_dim = int(global_dim)
+        # Online-delta overlay (docs/online.md): patched entities resolve
+        # here BEFORE the base CSR arrays (which may be read-only mmaps).
+        # ``apply_patches`` swaps the whole dict reference in one
+        # assignment, so a concurrent ``lookup`` sees the entire delta or
+        # none of it — never a torn mix.
+        self._patches: dict = {}
 
     @property
     def n_entities(self) -> int:
-        return len(self.keys)
+        """Distinct resolvable entities: base table + patched-in NEW keys."""
+        extra = sum(1 for k in self._patches if k not in self._key_to_row)
+        return len(self.keys) + extra
+
+    @property
+    def n_patched(self) -> int:
+        return len(self._patches)
+
+    def validate_patches(self, patches) -> dict:
+        """Validate (and normalize) a patch batch WITHOUT applying it:
+        ``{key: (cols, vals)}`` → staged dict of int32/float32 arrays.
+        Raises on mismatched shapes, non-ascending columns (the kernel's
+        binary-search layout), or out-of-range columns. Callers that need
+        cross-store atomicity (``ModelRegistry.apply_delta``) validate
+        EVERY store first, then apply."""
+        staged = {}
+        for key, (cols, vals) in patches.items():
+            cols = np.asarray(cols, np.int32)
+            vals = np.asarray(vals, np.float32)
+            if cols.shape != vals.shape or cols.ndim != 1:
+                raise ValueError(
+                    f"patch for {key!r}: cols/vals must be matching 1-D "
+                    f"arrays, got {cols.shape} vs {vals.shape}"
+                )
+            if len(cols) > 1 and np.any(np.diff(cols) < 0):
+                raise ValueError(
+                    f"patch for {key!r}: cols must be ascending "
+                    "(additive_score_rows binary-searches them)"
+                )
+            if len(cols) and (cols[0] < 0 or cols[-1] >= self.global_dim):
+                raise ValueError(
+                    f"patch for {key!r}: cols out of range "
+                    f"[0, {self.global_dim})"
+                )
+            staged[key] = (cols, vals)
+        return staged
+
+    def apply_patches(self, patches) -> int:
+        """Atomically overlay full replacement coefficient vectors.
+
+        ``patches`` maps entity key → ``(cols, vals)`` (global columns,
+        ascending — validated via :meth:`validate_patches` so a bad
+        producer can never corrupt scoring). Entities absent from the
+        base table are ADDED (cold-start entities streaming in). The base
+        arrays are never touched: they may be ``mmap_mode="r"`` views
+        shared across processes. Returns the number of entities patched.
+
+        The overlay is PROCESS state — the durable record of published
+        deltas is the trainer's patch journal (docs/online.md); ``save``
+        persists the base table only.
+        """
+        staged = self.validate_patches(patches)
+        # Build-then-swap: one reference assignment publishes everything.
+        merged = dict(self._patches)
+        merged.update(staged)
+        self._patches = merged
+        return len(staged)
 
     @property
     def max_width(self) -> int:
@@ -107,6 +169,9 @@ class CoefficientStore:
         # Chaos hook: latency spikes (delay_s) and IO errors on the store
         # path — what an mmap'd table on a sick filesystem really does.
         fault_point("serving.store_lookup", key=key)
+        patched = self._patches.get(key)  # one dict read; ref-swap atomic
+        if patched is not None:
+            return patched
         row = self._key_to_row.get(key)
         if row is None:
             return None
@@ -188,8 +253,29 @@ class DeviceCoefficientCache:
         self._lock = threading.Lock()
         self.stats = {
             "hits": 0, "misses": 0, "evictions": 0, "fallbacks": 0,
-            "degraded": 0,
+            "degraded": 0, "invalidations": 0,
         }
+
+    def invalidate(self, keys) -> int:
+        """Drop patched entities from the hot-set so their next resolve
+        restages fresh coefficients from the (just-patched) store.
+
+        Bookkeeping only: the device tables are NOT rewritten here — a
+        freed slot's stale row is overwritten by the next ``resolve`` that
+        reuses it, and every resolve+gather runs on the micro-batcher's
+        single worker thread (class doc), so an in-flight batch that
+        already resolved the old slot gathers consistent PRE-delta rows,
+        never a torn mix. Returns the number of entities dropped.
+        """
+        n = 0
+        with self._lock:
+            for key in keys:
+                slot = self._slots.pop(key, None)
+                if slot is not None:
+                    self._free.append(slot)
+                    n += 1
+            self.stats["invalidations"] += n
+        return n
 
     @property
     def fallback_slot(self) -> int:
@@ -318,6 +404,7 @@ class DeviceCoefficientCache:
                 "capacity": self.capacity,
                 "width": self.width,
                 "resident": len(self._slots),
+                "store_patched": self.store.n_patched,
                 **self.stats,
             }
         if self.breaker is not None:

@@ -133,6 +133,16 @@ class ModelRegistry:
         self._swap_lock = threading.Lock()  # serializes concurrent swaps
         self._next_version = 1
         self._current: Optional[ModelVersion] = None
+        # Online-delta freshness bookkeeping (docs/online.md): patch_seq /
+        # timestamps survive hot-swaps so /healthz freshness is measurable
+        # with or without a trainer attached.
+        self._patch_state = {
+            "patch_seq": 0,
+            "last_patch_ts": None,
+            "last_patch_entities": 0,
+            "patched_entities_total": 0,
+            "last_event_horizon": None,
+        }
         self.swap(model_dir)
 
     @property
@@ -156,3 +166,72 @@ class ModelRegistry:
                 self._current = version
                 self._next_version += 1
             return version
+
+    def apply_delta(self, patches_by_coordinate, seq: Optional[int] = None,
+                    event_horizon: Optional[int] = None) -> dict:
+        """Apply an online model delta to the CURRENT version, atomically
+        per coordinate (docs/online.md §"Delta protocol").
+
+        ``patches_by_coordinate`` maps coordinate id → {entity key →
+        ``(cols, vals)``}. Runs under the swap lock so a delta and a
+        hot-swap serialize: a delta never lands half on an outgoing
+        version; in-flight requests that captured the version pre-apply
+        score consistent pre-delta coefficients (the store overlay swap is
+        itself atomic). Validation failures (unknown coordinate, over-wide
+        patch, unsorted cols) apply NOTHING.
+        """
+        with self._swap_lock:
+            version = self.current
+            # Validate EVERYTHING across EVERY coordinate before the first
+            # apply — unknown coordinate, over-wide patch, bad column
+            # layout anywhere refuses the whole delta with no coordinate
+            # half-published (tested: a multi-coordinate delta with one
+            # poisoned coordinate applies nothing).
+            for cid, patches in patches_by_coordinate.items():
+                version.scorer.validate_delta(cid, patches)
+            applied = {}
+            total = 0
+            for cid, patches in patches_by_coordinate.items():
+                applied[cid] = version.scorer.apply_delta(cid, patches)
+                total += applied[cid]["patched"]
+            with self._lock:
+                st = self._patch_state
+                st["patch_seq"] += 1
+                st["last_patch_ts"] = time.time()
+                st["last_patch_entities"] = total
+                st["patched_entities_total"] += total
+                if event_horizon is not None:
+                    st["last_event_horizon"] = int(event_horizon)
+                patch_seq = st["patch_seq"]
+        from photon_tpu.obs import instant
+
+        instant("serving.delta_applied", cat="serving", patch_seq=patch_seq,
+                entities=total, trainer_seq=seq)
+        return {
+            "model_version": version.version,
+            "patch_seq": patch_seq,
+            "patched": total,
+            "coordinates": applied,
+        }
+
+    def freshness_snapshot(self) -> dict:
+        """Serving freshness for /healthz and /metrics (measurable without
+        the trainer attached): active version, when it was swapped in, and
+        the delta-patch watermark."""
+        v = self.current
+        with self._lock:
+            st = dict(self._patch_state)
+        return {
+            "model_version": v.version,
+            "last_swap_ts": v.loaded_at,
+            "seconds_since_swap": round(time.time() - v.loaded_at, 1),
+            "patch_seq": st["patch_seq"],
+            "last_patch_ts": st["last_patch_ts"],
+            "seconds_since_patch": (
+                round(time.time() - st["last_patch_ts"], 1)
+                if st["last_patch_ts"] else None
+            ),
+            "last_patch_entities": st["last_patch_entities"],
+            "patched_entities_total": st["patched_entities_total"],
+            "last_event_horizon": st["last_event_horizon"],
+        }

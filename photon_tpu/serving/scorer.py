@@ -394,6 +394,41 @@ class RowScorer:
         retrace.mark_warm(SCORE_KERNEL_NAME)
         return len(sizes)
 
+    def validate_delta(self, coordinate: str, patches) -> None:
+        """Validate one coordinate's entity patches WITHOUT applying:
+        coordinate exists, every patch fits the device-cache row width,
+        and the store accepts the column layout. The registry calls this
+        for EVERY coordinate before the first apply, so a bad coordinate
+        in a multi-coordinate delta can never leave another's patches
+        half-published."""
+        cache = self._caches.get(coordinate)
+        if cache is None:
+            raise ValueError(
+                f"unknown random-effect coordinate {coordinate!r}; "
+                f"patchable: {sorted(self._caches)}"
+            )
+        for key, (cols, _vals) in patches.items():
+            if len(cols) > cache.width:
+                raise ValueError(
+                    f"patch for {coordinate!r}/{key!r} has {len(cols)} "
+                    f"coefficients but the device cache width is "
+                    f"{cache.width}; widen the serving config or shrink "
+                    "the online subspace (max_event_nnz x window bounds it)"
+                )
+        cache.store.validate_patches(patches)
+
+    def apply_delta(self, coordinate: str, patches) -> dict:
+        """Apply one coordinate's entity patches (docs/online.md §"Delta
+        protocol"): validate (atomicity — a delta either applies whole or
+        not at all), overlay the store in one reference swap, then
+        invalidate exactly the patched entities in the device hot-set.
+        ``patches`` maps entity key → ``(cols, vals)``."""
+        self.validate_delta(coordinate, patches)
+        cache = self._caches[coordinate]
+        patched = cache.store.apply_patches(patches)
+        invalidated = cache.invalidate(list(patches))
+        return {"patched": patched, "invalidated": invalidated}
+
     def cache_snapshot(self) -> dict:
         return {cid: c.snapshot() for cid, c in self._caches.items()}
 

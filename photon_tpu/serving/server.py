@@ -101,7 +101,8 @@ class ScoringServer:
             name: self.metrics.counter(
                 f"serve_{name}_total", f"scoring requests: {name}")
             for name in (
-                "requests", "errors", "swaps", "shed", "expired", "degraded",
+                "requests", "errors", "swaps", "patches", "shed", "expired",
+                "degraded",
             )
         }
         self._latency = self.metrics.histogram(
@@ -202,6 +203,10 @@ class ScoringServer:
                         "model_version": v.version,
                         "backend": server.backend_name(),
                         "restarts": server.restart_counts(),
+                        # Serving freshness (docs/online.md): swap + delta
+                        # watermarks, so freshness SLOs are measurable
+                        # whether or not an online trainer is attached.
+                        "freshness": server.freshness(),
                     }
                     if not server.batcher.healthy:
                         self._reply(503, {
@@ -229,6 +234,8 @@ class ScoringServer:
                     self._score()
                 elif self.path == "/admin/swap":
                     self._swap()
+                elif self.path == "/admin/patch":
+                    self._patch()
                 else:
                     # Drain the unread body first: on a kept-alive
                     # connection it would otherwise be parsed as the next
@@ -331,6 +338,47 @@ class ScoringServer:
                     )
                 self._reply(200, {"model_version": v.version})
 
+            def _patch(self):
+                """Online model delta (docs/online.md §"Delta protocol"):
+                changed-entity coefficient patches applied atomically to
+                the current version's coefficient stores, device hot-set
+                invalidated only for the patched entities."""
+                try:
+                    payload = self._read_json()
+                    from photon_tpu.online.delta import ModelDelta
+
+                    try:
+                        delta = ModelDelta.from_wire(payload)
+                    except ValueError as e:
+                        raise RequestError(str(e)) from None
+                    if not delta.patches:
+                        raise RequestError("delta has no patches")
+                    result = server.registry.apply_delta(
+                        delta.raw_patches(), seq=delta.seq,
+                        event_horizon=delta.event_horizon,
+                    )
+                except RequestError as e:
+                    server._count(errors=1)
+                    self._reply(400, {"error": str(e)})
+                    return
+                except ValueError as e:
+                    # Validation refused the delta (unknown coordinate,
+                    # over-wide patch): the producer's bug, nothing applied.
+                    server._count(errors=1)
+                    self._reply(400, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 - bad push, keep old
+                    server._count(errors=1)
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                server._count(patches=1)
+                if server.logger is not None:
+                    server.logger.info(
+                        "applied delta patch_seq=%d (%d entities)",
+                        result["patch_seq"], result["patched"],
+                    )
+                self._reply(200, result)
+
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.httpd.daemon_threads = True
         self._loop_started = False
@@ -393,6 +441,14 @@ class ScoringServer:
                 out[key] = out.get(key, 0) + int(value)
         return out
 
+    def freshness(self) -> dict:
+        """Registry freshness watermarks (active version, last swap, last
+        delta patch) for /healthz and the metrics snapshot."""
+        try:
+            return self.registry.freshness_snapshot()
+        except Exception:  # noqa: BLE001 - harness fakes lack a registry
+            return {}
+
     def degraded_reasons(self, version=None) -> list:
         """Why this (otherwise alive) server is serving worse answers:
         open/half-open circuit breakers, both the per-coordinate store
@@ -448,6 +504,7 @@ class ScoringServer:
             "throughput_interval_rows_per_sec": interval_rate,
             "interval_s": round(dt, 3),
             **counters,
+            "freshness": self.freshness(),
             "batcher": self.batcher.snapshot(),
             "coefficient_caches": v.scorer.cache_snapshot(),
             "breakers": v.scorer.breaker_snapshot(),

@@ -10,7 +10,12 @@ ingest, model I/O, score files) stands on.
 import math
 
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# Environments without hypothesis must still COLLECT cleanly: the module
+# skips (one 's'), never errors — an unrelated optional dependency must not
+# cost the suite a collection error.
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from photon_tpu.io.avro import read_container, write_container
 

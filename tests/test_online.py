@@ -1,0 +1,535 @@
+"""Online incremental learning (photon_tpu/online/ — docs/online.md).
+
+Coverage per ISSUE 11: event-log round-trip + replay cursor, the
+coefficient-store delta overlay (atomic apply, cache invalidation,
+restage), convergence EQUIVALENCE of the incremental trainer against a
+full batch retrain on the same cumulative data (two losses), prior
+anchoring, the stable-shape no-retrace contract across refresh cycles,
+and the chaos drills: a ``device_lost`` injected mid-refresh never
+publishes a torn delta and resumes bit-identically; a failed publish
+applies NOTHING and the next cycle retries the same entities.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+from photon_tpu.index.index_map import DefaultIndexMap, feature_key
+from photon_tpu.io.data_reader import FeatureShardConfig
+from photon_tpu.online import (
+    EntityPatch,
+    EventCursor,
+    EventError,
+    EventWriter,
+    ModelDelta,
+    OnlineCoordinate,
+    OnlineEvent,
+    OnlineTrainer,
+    OnlineTrainerConfig,
+    PatchJournal,
+    append_events,
+    iter_events,
+    resolve_event_features,
+)
+from photon_tpu.serving import CoefficientStore, DeviceCoefficientCache
+from photon_tpu.types import TaskType
+
+D = 8  # global feature dim for the synthetic coordinate
+
+
+def _imap():
+    return DefaultIndexMap([feature_key("c", str(j)) for j in range(D)])
+
+
+def _shard_cfgs(add_intercept=False):
+    return {"global": FeatureShardConfig(("features",),
+                                         add_intercept=add_intercept)}
+
+
+def _trainer(task=TaskType.LOGISTIC_REGRESSION, publisher=None,
+             journal=None, cursor=None, **cfg_kwargs):
+    cfg = OnlineTrainerConfig(**{
+        "window": 64, "max_event_nnz": D, "refresh_batch": 256,
+        "chunk": 256, "incremental_weight": 0.0, "reg_weight": 1.0,
+        "max_iterations": 50, "dtype": "float64", **cfg_kwargs,
+    })
+    return OnlineTrainer(
+        task=task,
+        coordinates=[OnlineCoordinate("perUser", "userId", "global")],
+        index_maps={"global": _imap()},
+        shard_configs=_shard_cfgs(),
+        config=cfg,
+        publisher=publisher,
+        journal=journal,
+        cursor=cursor,
+    )
+
+
+def _gen_events(task, n_entities=6, rows=20, seed=1, nnz=3):
+    """Synthetic labeled events + the raw rows for the batch comparator."""
+    rng = np.random.default_rng(seed)
+    wu = rng.normal(size=(n_entities, D))
+    events, rows_out = [], []
+    for i in range(n_entities * rows):
+        u = i % n_entities
+        cols = np.sort(rng.choice(D, size=nnz, replace=False))
+        vals = rng.normal(size=nnz)
+        z = float((wu[u][cols] * vals).sum())
+        if task == TaskType.LOGISTIC_REGRESSION:
+            y = float(rng.random() < 1 / (1 + np.exp(-z)))
+        else:
+            y = z + float(rng.normal()) * 0.1
+        events.append(OnlineEvent(
+            entities={"userId": f"u{u}"},
+            features=[{"name": "c", "term": str(int(c)), "value": float(v)}
+                      for c, v in zip(cols, vals)],
+            label=y, ts=float(i), seq=i,
+        ))
+        rows_out.append((f"u{u}", cols, vals, y))
+    return events, rows_out
+
+
+def _batch_model(task, rows_out, problem):
+    """Full batch retrain on the cumulative rows — the equivalence oracle."""
+    from photon_tpu.data.random_effect import build_random_effect_dataset
+    from photon_tpu.game.random_effect import train_random_effects
+
+    n = len(rows_out)
+    idx = np.full((n, D), D, np.int32)
+    val = np.zeros((n, D), np.float64)
+    keys = np.empty(n, object)
+    labels = np.zeros(n)
+    for r, (k, c, v, y) in enumerate(rows_out):
+        idx[r, : len(c)] = c
+        val[r, : len(c)] = v
+        keys[r] = k
+        labels[r] = y
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, D, dtype=np.float64)
+    model, _ = train_random_effects(problem, ds, jnp.zeros(n))
+    return model
+
+
+class RecordingPublisher:
+    """Captures every published delta (the trainer otherwise runs
+    open-loop)."""
+
+    def __init__(self):
+        self.deltas = []
+
+    def publish(self, delta):
+        self.deltas.append(delta)
+        return {"recorded": len(self.deltas)}
+
+
+class StorePublisher:
+    """Publishes straight into a CoefficientStore + device cache — the
+    serving-side apply without an HTTP server in the loop."""
+
+    def __init__(self, store, cache):
+        self.store = store
+        self.cache = cache
+
+    def publish(self, delta):
+        raw = delta.raw_patches().get("perUser", {})
+        patched = self.store.apply_patches(raw)
+        self.cache.invalidate(list(raw))
+        return {"patched": patched}
+
+
+def _empty_store():
+    return CoefficientStore(
+        [], np.zeros(1, np.int64), np.zeros(0, np.int32),
+        np.zeros(0, np.float32), D,
+    )
+
+
+# ----------------------------------------------------------- event layer
+
+
+def test_event_log_roundtrip_and_cursor(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    events = [
+        OnlineEvent(entities={"userId": f"u{i}"},
+                    features=[{"name": "c", "term": "0", "value": 1.0}],
+                    label=float(i), ts=100.0 + i)
+        for i in range(5)
+    ]
+    first = append_events(path, events)
+    assert first == 0
+    back = list(iter_events(path))
+    assert [e.seq for e in back] == [0, 1, 2, 3, 4]
+    assert back[3].label == 3.0 and back[3].entities["userId"] == "u3"
+    # replay from a cursor position skips published events
+    assert [e.seq for e in iter_events(path, start_seq=3)] == [3, 4]
+    # appending to an existing log continues the sequence
+    with EventWriter(path) as w:
+        assert w.next_seq == 5
+        assert w.append(events[0]) == 5
+    # a torn (unterminated) tail line is skipped, not parsed
+    with open(path, "a") as f:
+        f.write('{"seq": 99, "label":')
+    assert [e.seq for e in iter_events(path)][-1] == 5
+    # cursor round-trip is atomic (tmp+rename) and defaults to 0
+    cur = EventCursor(str(tmp_path))
+    assert cur.load() == 0
+    cur.save(6)
+    assert EventCursor(str(tmp_path)).load() == 6
+
+
+def test_event_validation_and_resolution():
+    with pytest.raises(EventError, match="label"):
+        OnlineEvent.from_dict({"entities": {}, "features": []})
+    ev = OnlineEvent.from_dict({
+        "entities": {"userId": "u1"}, "label": 1.0,
+        "features": [{"name": "c", "term": "2", "value": 2.0},
+                     {"name": "nope", "term": None, "value": 9.0}],
+    })
+    rows = resolve_event_features(
+        ev, {"global": _imap()}, _shard_cfgs(), ["global"], max_nnz=4)
+    idx, val = rows["global"]
+    # unindexed features drop (like the reader); ghost padding == dim
+    assert list(idx) == [2, D, D, D]
+    assert val[0] == 2.0 and val[1:].sum() == 0.0
+    # over-cap rows refuse loudly (stable-shape contract)
+    big = OnlineEvent(
+        entities={"userId": "u1"},
+        features=[{"name": "c", "term": str(j), "value": 1.0}
+                  for j in range(5)],
+        label=0.0)
+    with pytest.raises(EventError, match="max_event_nnz"):
+        resolve_event_features(big, {"global": _imap()}, _shard_cfgs(),
+                               ["global"], max_nnz=4)
+
+
+def test_delta_wire_roundtrip():
+    delta = ModelDelta(
+        seq=7,
+        patches={"perUser": {"u1": EntityPatch(
+            "u1", np.asarray([4, 1], np.int32),
+            np.asarray([0.5, -2.0], np.float32))}},
+        event_horizon=99,
+    )
+    back = ModelDelta.from_wire(delta.to_wire())
+    assert back.seq == 7 and back.event_horizon == 99
+    p = back.patches["perUser"]["u1"]
+    # EntityPatch sorts defensively: kernel-facing cols must ascend
+    assert list(p.cols) == [1, 4]
+    np.testing.assert_array_equal(p.vals, np.asarray([-2.0, 0.5],
+                                                     np.float32))
+    with pytest.raises(ValueError):
+        ModelDelta.from_wire({"patches": {"perUser": {"u1": {"cols": [1]}}}})
+
+
+# ------------------------------------------------- store overlay + cache
+
+
+def test_store_overlay_atomic_apply_and_new_entities():
+    store = CoefficientStore(
+        ["a", "b"], np.asarray([0, 2, 3], np.int64),
+        np.asarray([0, 5, 1], np.int32),
+        np.asarray([1.0, 2.0, 3.0], np.float32), D,
+    )
+    base_a = store.lookup("a")
+    np.testing.assert_array_equal(base_a[0], [0, 5])
+    # overlay wins over base; new entities resolve; base arrays untouched
+    assert store.apply_patches({
+        "a": (np.asarray([2, 4], np.int32),
+              np.asarray([9.0, 8.0], np.float32)),
+        "new": (np.asarray([1], np.int32), np.asarray([7.0], np.float32)),
+    }) == 2
+    np.testing.assert_array_equal(store.lookup("a")[0], [2, 4])
+    np.testing.assert_array_equal(store.lookup("new")[1], [7.0])
+    np.testing.assert_array_equal(store.lookup("b")[0], [1])
+    assert store.n_patched == 2 and store.n_entities == 3
+    # validation refuses the WHOLE batch: nothing applied on error
+    with pytest.raises(ValueError, match="ascending"):
+        store.apply_patches({
+            "b": (np.asarray([5, 1], np.int32),
+                  np.asarray([1.0, 1.0], np.float32)),
+        })
+    np.testing.assert_array_equal(store.lookup("b")[0], [1])
+    with pytest.raises(ValueError, match="out of range"):
+        store.apply_patches({
+            "b": (np.asarray([D + 3], np.int32),
+                  np.asarray([1.0], np.float32)),
+        })
+
+
+def test_device_cache_invalidate_restages_patched_entities():
+    store = CoefficientStore(
+        ["a"], np.asarray([0, 2], np.int64),
+        np.asarray([0, 5], np.int32),
+        np.asarray([1.0, 2.0], np.float32), D,
+    )
+    cache = DeviceCoefficientCache(store, capacity=4)
+    slot = cache.slot_for("a")
+    proj, coef = cache.gather([slot])
+    np.testing.assert_array_equal(np.asarray(coef[0])[:2], [1.0, 2.0])
+    store.apply_patches({
+        "a": (np.asarray([0, 5], np.int32),
+              np.asarray([4.0, 5.0], np.float32)),
+    })
+    # without invalidation the hot-set still serves the old (consistent)
+    # pre-delta row
+    _, coef = cache.gather([cache.slot_for("a")])
+    np.testing.assert_array_equal(np.asarray(coef[0])[:2], [1.0, 2.0])
+    assert cache.invalidate(["a", "ghost"]) == 1
+    assert cache.stats["invalidations"] == 1
+    _, coef = cache.gather([cache.slot_for("a")])
+    np.testing.assert_array_equal(np.asarray(coef[0])[:2], [4.0, 5.0])
+    assert cache.snapshot()["store_patched"] == 1
+
+
+# -------------------------------------------------- trainer: equivalence
+
+
+@pytest.mark.parametrize("task", [TaskType.LOGISTIC_REGRESSION,
+                                  TaskType.LINEAR_REGRESSION])
+def test_incremental_refresh_matches_batch_retrain(task):
+    """ISSUE 11 acceptance: replayed incremental refreshes (3 cycles,
+    window covering the cumulative data, no prior anchoring) land on the
+    same per-entity optimum as ONE batch retrain over the same rows."""
+    tr = _trainer(task=task)
+    events, rows_out = _gen_events(task)
+    n3 = len(events) // 3
+    tr.run(events[:n3])
+    tr.run(events[n3:2 * n3])
+    tr.run(events[2 * n3:])
+    assert tr.totals["cycles"] == 3
+    model = _batch_model(task, rows_out, tr._problem)
+    for u in range(6):
+        gi, gv = model.coefficients_for(f"u{u}")
+        post = tr.state["perUser"].posterior_for(f"u{u}")
+        batch_full = np.zeros(D)
+        batch_full[gi] = gv
+        online_full = np.zeros(D)
+        online_full[post[0]] = post[1]
+        np.testing.assert_allclose(online_full, batch_full, atol=1e-3,
+                                   err_msg=f"entity u{u} diverged")
+
+
+def test_prior_anchoring_shrinks_toward_previous_posterior():
+    events, _ = _gen_events(TaskType.LOGISTIC_REGRESSION, rows=10)
+    free = _trainer(incremental_weight=0.0)
+    free.run(events)
+    anchored = _trainer(incremental_weight=50.0)
+    anchored.run(events)
+    # fresh entities anchor to the N(0, 1) default posterior at mean 0: a
+    # strong prior must shrink the solution toward it
+    for u in range(6):
+        wf = free.state["perUser"].posterior_for(f"u{u}")[1]
+        wa = anchored.state["perUser"].posterior_for(f"u{u}")[1]
+        assert np.linalg.norm(wa) < np.linalg.norm(wf)
+
+
+def test_windows_slide_and_dirty_horizon():
+    tr = _trainer(window=3)
+    w = tr.windows["perUser"]
+    for i in range(5):
+        tr.ingest(OnlineEvent(
+            entities={"userId": "u0"},
+            features=[{"name": "c", "term": "0", "value": float(i)}],
+            label=1.0, ts=float(i), seq=i))
+    rows = w.rows_for("u0")
+    assert len(rows) == 3                      # window slid
+    assert [r[6] for r in rows] == [2, 3, 4]   # newest kept
+    assert w.n_dirty == 1
+    # clearing below the newest event's seq keeps the entity dirty,
+    # re-stamped at the first UNPUBLISHED event
+    w.clear_dirty(["u0"], horizon=3)
+    assert w.n_dirty == 1
+    assert w.peek_dirty(10)[0][2] == 4
+    w.clear_dirty(["u0"], horizon=4)
+    assert w.n_dirty == 0
+
+
+def test_no_retrace_across_refresh_cycles():
+    """Stable-shape contract: once a (solver, S, P) class compiled at the
+    fixed ladder chunk, later cycles with the same shapes add ZERO kernel
+    traces."""
+    from photon_tpu.obs import retrace
+
+    tr = _trainer(window=4, dtype="float32", max_iterations=10)
+
+    def batch(base):
+        evs = []
+        for i in range(4 * 4):
+            u = i % 4
+            evs.append(OnlineEvent(
+                entities={"userId": f"u{u}"},
+                features=[{"name": "c", "term": str(j), "value": 1.0 + i}
+                          for j in range(4)],
+                label=float(i % 2), ts=float(base + i), seq=base + i))
+        return evs
+
+    tr.run(batch(0))       # windows full (4 rows each) -> shapes fixed
+    traces0 = retrace.traces("fit_bucket_newton")
+    tr.run(batch(100))     # same shapes: no new compile allowed
+    assert tr.totals["cycles"] == 2
+    assert retrace.traces("fit_bucket_newton") == traces0
+
+
+def test_journal_and_cursor_advance_on_publish(tmp_path):
+    journal = PatchJournal(str(tmp_path))
+    cursor = EventCursor(str(tmp_path))
+    pub = RecordingPublisher()
+    tr = _trainer(publisher=pub, journal=journal, cursor=cursor,
+                  refresh_batch=4, max_iterations=10)
+    events, _ = _gen_events(TaskType.LOGISTIC_REGRESSION, n_entities=4,
+                            rows=4)
+    summary = tr.run(events)
+    assert summary["deltas"] >= 2
+    rows = journal.read_all()
+    assert len(rows) == summary["deltas"]
+    assert rows[-1]["event_horizon"] == events[-1].seq
+    assert cursor.load() == events[-1].seq + 1
+    assert [d.seq for d in pub.deltas] == list(range(summary["deltas"]))
+    assert summary["freshness_samples"] == summary["entities_refreshed"]
+
+
+# ----------------------------------------------------------- chaos drills
+
+
+@pytest.mark.chaos
+def test_chaos_device_lost_mid_refresh_publishes_bitidentical_delta():
+    """PR 8 recovery contract for the online path: a device_lost injected
+    mid-refresh recovers in-run (cache clear + re-run) and the published
+    delta is BIT-IDENTICAL to an uninterrupted run's — never torn, never
+    skipped."""
+    events, _ = _gen_events(TaskType.LOGISTIC_REGRESSION, n_entities=4,
+                            rows=6, seed=3)
+
+    def run_one(plan):
+        pub = RecordingPublisher()
+        tr = _trainer(publisher=pub, max_iterations=15, dtype="float32")
+        if plan is not None:
+            with active_plan(plan) as inj:
+                tr.run(events)
+                assert inj.fired("online.refresh") == 1
+        else:
+            tr.run(events)
+        return tr, pub
+
+    clean_tr, clean_pub = run_one(None)
+    plan = FaultPlan(seed=5, specs=[
+        FaultSpec(site="online.refresh", error="device_lost", count=1),
+    ])
+    faulted_tr, faulted_pub = run_one(plan)
+    assert faulted_tr.totals["device_loss_recoveries"] == 1
+    assert len(faulted_pub.deltas) == len(clean_pub.deltas) == 1
+    a, b = clean_pub.deltas[0], faulted_pub.deltas[0]
+    assert set(a.patches["perUser"]) == set(b.patches["perUser"])
+    for key in a.patches["perUser"]:
+        pa, pb = a.patches["perUser"][key], b.patches["perUser"][key]
+        np.testing.assert_array_equal(pa.cols, pb.cols)
+        np.testing.assert_array_equal(pa.vals, pb.vals)  # bit-identical
+
+
+@pytest.mark.chaos
+def test_chaos_device_lost_escalates_past_recovery_budget(monkeypatch):
+    monkeypatch.setenv("PHOTON_DEVICE_LOST_MAX_RECOVERIES", "1")
+    events, _ = _gen_events(TaskType.LOGISTIC_REGRESSION, n_entities=2,
+                            rows=2)
+    tr = _trainer(max_iterations=5, dtype="float32")
+    plan = FaultPlan(seed=5, specs=[
+        FaultSpec(site="online.refresh", error="device_lost", count=3),
+    ])
+    from photon_tpu.faults import DeviceLostError
+
+    with active_plan(plan):
+        with pytest.raises(DeviceLostError):
+            tr.run(events)
+    assert tr.totals["device_loss_recoveries"] == 1  # bounded, then raised
+    assert tr.totals["deltas"] == 0                  # nothing published
+
+
+@pytest.mark.chaos
+def test_chaos_failed_publish_applies_nothing_and_retries():
+    """The no-torn-delta contract's trainer half: a publish that dies
+    leaves the store, the trainer state, the dirty set, and the journal
+    untouched; the NEXT cycle re-solves and publishes the same entities."""
+    store = _empty_store()
+    cache = DeviceCoefficientCache(store, capacity=4)
+    pub = StorePublisher(store, cache)
+    tr = _trainer(publisher=pub, max_iterations=10, dtype="float32")
+    events, _ = _gen_events(TaskType.LOGISTIC_REGRESSION, n_entities=3,
+                            rows=4, seed=9)
+    for ev in events:
+        tr.ingest(ev)
+    plan = FaultPlan(seed=1, specs=[
+        FaultSpec(site="online.publish", error="os", count=1),
+    ])
+    with active_plan(plan) as inj:
+        with pytest.raises(OSError):
+            tr.refresh()
+        assert inj.fired("online.publish") == 1
+    # nothing applied, nothing committed
+    assert store.n_patched == 0
+    assert tr.state["perUser"].n_entities == 0
+    assert tr.totals["deltas"] == 0
+    assert tr.windows["perUser"].n_dirty == 3
+    # the retry (fault exhausted) publishes the full delta atomically
+    summary = tr.refresh()
+    assert summary is not None and summary["entities"] == 3
+    assert store.n_patched == 3
+    assert tr.windows["perUser"].n_dirty == 0
+    for u in range(3):
+        hit = store.lookup(f"u{u}")
+        assert hit is not None and len(hit[0]) > 0
+
+
+def test_registry_multi_coordinate_delta_applies_all_or_nothing():
+    """A multi-coordinate delta with ONE poisoned coordinate (over-wide
+    patch) must apply NOTHING anywhere: the registry validates every
+    coordinate before the first apply, so coordinate A's overlay cannot
+    land while coordinate B's validation fails."""
+    import threading
+    import types
+
+    from photon_tpu.serving import ModelRegistry
+    from photon_tpu.serving.scorer import RowScorer
+
+    store_a, store_b = _empty_store(), _empty_store()
+    cache_a = DeviceCoefficientCache(store_a, capacity=4, width=4)
+    cache_b = DeviceCoefficientCache(store_b, capacity=4, width=4)
+    scorer = RowScorer.__new__(RowScorer)
+    scorer._caches = {"a": cache_a, "b": cache_b}
+    registry = ModelRegistry.__new__(ModelRegistry)
+    registry._lock = threading.Lock()
+    registry._swap_lock = threading.Lock()
+    registry._patch_state = {
+        "patch_seq": 0, "last_patch_ts": None, "last_patch_entities": 0,
+        "patched_entities_total": 0, "last_event_horizon": None,
+    }
+    registry._current = types.SimpleNamespace(version=1, scorer=scorer)
+    ok = (np.asarray([1, 2], np.int32), np.asarray([1.0, 2.0], np.float32))
+    wide = (np.arange(cache_b.width + 1, dtype=np.int32),
+            np.ones(cache_b.width + 1, np.float32))
+    with pytest.raises(ValueError, match="cache width"):
+        registry.apply_delta({"a": {"e1": ok}, "b": {"e2": wide}})
+    assert store_a.n_patched == 0          # coordinate A did NOT half-apply
+    assert store_b.n_patched == 0
+    assert registry._patch_state["patch_seq"] == 0
+    # and the valid-everywhere retry applies both atomically
+    out = registry.apply_delta({"a": {"e1": ok}, "b": {"e2": ok}})
+    assert out["patched"] == 2 and store_a.n_patched == 1
+    assert registry._patch_state["patch_seq"] == 1
+
+
+@pytest.mark.chaos
+def test_chaos_store_apply_validation_never_tears():
+    """Serving half of the contract: a delta containing one invalid patch
+    applies NOTHING — the overlay swap happens only after every patch
+    validated."""
+    store = _empty_store()
+    ok = (np.asarray([1, 2], np.int32), np.asarray([1.0, 2.0], np.float32))
+    bad = (np.asarray([3, 1], np.int32), np.asarray([1.0, 1.0], np.float32))
+    with pytest.raises(ValueError):
+        store.apply_patches({"good": ok, "bad": bad})
+    assert store.n_patched == 0
+    assert store.lookup("good") is None

@@ -753,3 +753,101 @@ def test_chaos_batcher_crash_fails_fast_and_flags_healthz(trained):
         assert status == 500
     finally:
         server.shutdown()
+
+
+# -------------------------------------- online deltas (PR-11 freshness)
+
+
+def test_admin_patch_applies_delta_and_reports_freshness(trained):
+    """ISSUE 11 satellite: ``POST /admin/patch`` applies changed-entity
+    coefficient patches atomically (model version unmoved), the patched
+    entity's served score changes, and /healthz + /metrics expose the
+    freshness watermarks (patch_seq, last-patch ts, patched counts) — all
+    without a trainer attached."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    rec = read_records(str(d / "val.avro"))[0]
+    key = rec["metadataMap"]["userId"]
+    store = registry.current.scorer._caches["perUser"].store
+    cols, vals = store.lookup(key)
+    try:
+        # Baseline freshness: no patches yet, swap watermark present.
+        status, health = _get(host, port, "/healthz")
+        assert status == 200
+        fr = health["freshness"]
+        assert fr["patch_seq"] == 0 and fr["last_patch_ts"] is None
+        assert fr["model_version"] == 1 and fr["last_swap_ts"] > 0
+
+        status, before = _post(host, port, "/score", _payload(rec))
+        assert status == 200
+
+        status, body = _post(host, port, "/admin/patch", {
+            "seq": 0, "event_horizon": 41,
+            "patches": {"perUser": {str(key): {
+                "cols": [int(c) for c in cols],
+                "vals": [float(v) * 3.0 for v in vals],
+            }}},
+        })
+        assert status == 200, body
+        assert body["patch_seq"] == 1 and body["patched"] == 1
+        assert body["model_version"] == 1          # patched, not swapped
+
+        status, after = _post(host, port, "/score", _payload(rec))
+        assert status == 200
+        assert after["model_version"] == 1
+        assert after["score"] != pytest.approx(before["score"], abs=1e-9)
+
+        status, health = _get(host, port, "/healthz")
+        fr = health["freshness"]
+        assert fr["patch_seq"] == 1
+        assert fr["last_patch_entities"] == 1
+        assert fr["patched_entities_total"] == 1
+        assert fr["last_event_horizon"] == 41
+        assert fr["seconds_since_patch"] is not None
+        status, m = _get(host, port, "/metrics")
+        assert m["freshness"]["patch_seq"] == 1
+        assert m["patches"] == 1
+        assert m["coefficient_caches"]["perUser"]["store_patched"] == 1
+        assert m["coefficient_caches"]["perUser"]["invalidations"] == 1
+
+        # A malformed delta is a 400 and applies nothing. (Unsorted cols
+        # normalize at the wire layer — EntityPatch sorts defensively —
+        # so the invalid cases are out-of-range columns and unknown
+        # coordinates.)
+        status, body = _post(host, port, "/admin/patch", {
+            "patches": {"perUser": {str(key): {
+                "cols": [len(store.cols) + store.global_dim + 5],
+                "vals": [1.0]}}},
+        })
+        assert status == 400 and "out of range" in body["error"]
+        status, body = _post(host, port, "/admin/patch", {
+            "patches": {"noSuchCoord": {"x": {"cols": [0],
+                                              "vals": [1.0]}}},
+        })
+        assert status == 400 and "noSuchCoord" in body["error"]
+        status, health = _get(host, port, "/healthz")
+        assert health["freshness"]["patch_seq"] == 1   # unchanged
+    finally:
+        server.shutdown()
+
+
+def test_registry_apply_delta_rejects_overwide_patch(trained):
+    """A patch wider than the device-cache row width must refuse the WHOLE
+    delta (atomicity) with actionable guidance, applying nothing."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    cache = registry.current.scorer._caches["perUser"]
+    key = list(cache.store.keys)[0]
+    wide = np.arange(cache.width + 1, dtype=np.int32)
+    with pytest.raises(ValueError, match="cache width"):
+        registry.apply_delta({"perUser": {
+            key: (wide, np.ones(len(wide), np.float32)),
+        }})
+    assert cache.store.n_patched == 0
+    assert registry.freshness_snapshot()["patch_seq"] == 0
