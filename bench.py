@@ -1233,6 +1233,225 @@ def bench_online():
     }
 
 
+def bench_recovery():
+    """Zero-recompile recovery figures (docs/robustness.md §"Recovery
+    time"), both SLO-gateable:
+
+    * ``recovery_restart_to_first_step_seconds`` — a supervised restart
+      drill: training is preempted mid-sweep, the RunSupervisor pre-warms
+      the next attempt from the AOT compile store
+      (runtime/compile_store.py), and the restarted attempt's
+      checkpoint-resume fast-forward + first committed step are timed.
+      The journal's ``prewarm`` row supplies the compile-vs-load split —
+      on a warm restart the XLA share must sit below the I/O share.
+    * ``recovery_swap_to_first_score_seconds`` — a warm-standby registry
+      hot-swap: the next version is built + warmed via
+      ``prepare_standby``, the swap collapses to a pointer move, and the
+      first served score closes the clock — with zero scoring-kernel
+      retraces-after-warmup on the standby path.
+    """
+    import tempfile
+
+    from photon_tpu.checkpoint import CheckpointManager
+    from photon_tpu.estimators.config import (
+        FixedEffectDataConfig,
+        GLMOptimizationConfiguration,
+        RandomEffectDataConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+    from photon_tpu.index.index_map import (
+        DefaultIndexMap,
+        build_mmap_index,
+        feature_key,
+    )
+    from photon_tpu.io.data_reader import FeatureShardConfig
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.obs import retrace
+    from photon_tpu.obs.metrics import REGISTRY
+    from photon_tpu.optim import RegularizationContext, RegularizationType
+    from photon_tpu.runtime import compile_store as cstore
+    from photon_tpu.serving import ModelRegistry, ServingConfig
+    from photon_tpu.supervisor import (
+        RecoveryJournal,
+        RestartPolicy,
+        RunSupervisor,
+    )
+    from photon_tpu.types import TaskType
+
+    n_users, rows_per_user, d_global, d_user = (
+        (24, 8, 64, 3) if SMOKE else (128, 16, 512, 8))
+    bundle = _game_bundle(n_users, rows_per_user, d_global, d_user)
+    base = dict(
+        regularization=RegularizationContext(RegularizationType.L2),
+        max_iterations=10,
+    )
+    cfgs = [{
+        "fixed": GLMOptimizationConfiguration(reg_weight=1.0, **base),
+        "perUser": GLMOptimizationConfiguration(reg_weight=1.0, **base),
+    }]
+
+    def make_estimator():
+        return GameEstimator(
+            task=TaskType.LOGISTIC_REGRESSION,
+            coordinate_data_configs={
+                "fixed": FixedEffectDataConfig("global"),
+                "perUser": RandomEffectDataConfig(re_type="userId",
+                                                  feature_shard="global"),
+            },
+            n_sweeps=2,
+        )
+
+    import jax as _jax
+
+    prev_store = cstore.active()
+    # configure() may point jax's persistent cache at the drill's temp dir
+    # and force the min-compile-time floor to 0 — both must be restored or
+    # every LATER bench stage compiles against a deleted cache path with
+    # altered persistence behavior (cross-stage contamination of the very
+    # figures the PR 6 gate compares).
+    prev_cache_dir = _jax.config.jax_compilation_cache_dir
+    prev_cache_min = _jax.config.jax_persistent_cache_min_compile_time_secs
+    out = {}
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            store = cstore.configure(os.path.join(td, "store"))
+            journal_path = os.path.join(td, "recovery.jsonl")
+            ckdir = os.path.join(td, "ck")
+
+            # ---- restart drill: preempt mid-sweep, pre-warm, resume ----
+            def attempt(i):
+                mgr = CheckpointManager(ckdir)
+                try:
+                    return make_estimator().fit(
+                        bundle, None, cfgs, checkpoint_manager=mgr)
+                finally:
+                    # close() waits for queued snapshots to be DURABLE
+                    # before the restarted attempt's fresh manager resumes
+                    # from this directory — a still-draining writer would
+                    # make the warm restart_to_first_step figure resume
+                    # from an older step nondeterministically. Guarded: a
+                    # writer error must not mask the injected preemption.
+                    try:
+                        mgr.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            plan = FaultPlan(seed=0, specs=[
+                FaultSpec(site="descent.step", error="preemption",
+                          after=2, count=1),
+            ])
+            sup = RunSupervisor(
+                RestartPolicy(max_restarts=2, backoff_seconds=0,
+                              jitter=False),
+                journal=RecoveryJournal(journal_path),
+                sleep=lambda s: None,
+                compile_store=store,
+            )
+            with active_plan(plan):
+                results = sup.run(attempt)
+
+            rows = [json.loads(x)
+                    for x in open(journal_path).read().splitlines()]
+            firsts = [r for r in rows if r["event"] == "first_step"]
+            prewarms = [r for r in rows if r["event"] == "prewarm"]
+            # firsts[0] = attempt 0 (cold), firsts[-1] = the restarted,
+            # pre-warmed attempt — the headline restart-to-first-step.
+            if firsts:
+                out["recovery_restart_to_first_step_seconds"] = (
+                    firsts[-1]["restart_to_first_step_seconds"])
+                out["recovery_restart_to_first_step_cold_seconds"] = (
+                    firsts[0]["restart_to_first_step_seconds"])
+            if prewarms:
+                pw = prewarms[-1]
+                out["recovery_prewarm_entries"] = pw["entries"]
+                out["recovery_prewarm_loaded"] = pw["loaded"]
+                out["recovery_prewarm_compiled"] = pw["compiled"]
+                out["recovery_prewarm_load_seconds"] = pw["load_seconds"]
+                out["recovery_prewarm_xla_seconds"] = pw["xla_seconds"]
+                split = pw["load_seconds"] + pw["xla_seconds"]
+                # The acceptance figure: warm-restart XLA share of the
+                # compile-side work (below 0.5 == load-dominated).
+                out["recovery_warm_xla_share"] = (
+                    round(pw["xla_seconds"] / split, 4) if split > 0
+                    else 0.0)
+
+            # ---- warm-standby hot-swap: pointer move + one dispatch ----
+            model = results[0].model
+            dim = bundle.features["global"].dim
+            imap = DefaultIndexMap(
+                [feature_key("c", str(j)) for j in range(dim)])
+            shard_cfgs = {"global": FeatureShardConfig(
+                ("features",), add_intercept=False)}
+            mdirs = [os.path.join(td, m) for m in ("ma", "mb")]
+            for mdir in mdirs:
+                save_game_model(mdir, model, {"global": imap},
+                                shard_by_coordinate={"perUser": "global"},
+                                shard_configs=shard_cfgs)
+            build_mmap_index(imap, os.path.join(td, "index", "global"))
+            cfg = ServingConfig(max_batch=16, max_wait_ms=1.0,
+                                cache_entities=max(64, n_users),
+                                max_row_nnz=32)
+            registry = ModelRegistry(mdirs[0], cfg)
+            feats = bundle.features["global"]
+            fidx = np.asarray(feats.idx)[0]
+            fval = np.asarray(feats.val)[0]
+            payload = {
+                "features": [
+                    {"name": "c", "term": str(int(c)), "value": float(v)}
+                    for c, v in zip(fidx, fval) if c < dim
+                ],
+                "entities": {
+                    "userId": str(bundle.id_tags["userId"][0])},
+            }
+            row = registry.current.scorer.parse_request(payload)
+            registry.current.scorer.score_rows([row])  # settle version A
+
+            t0 = time.perf_counter()
+            registry.prepare_standby(mdirs[1])
+            out["recovery_standby_prepare_seconds"] = round(
+                time.perf_counter() - t0, 4)
+            rtr0 = retrace.retraces_after_warmup("additive_score_rows")
+            t0 = time.perf_counter()
+            v = registry.swap(mdirs[1])           # pointer move (standby)
+            v.scorer.score_rows([row])            # first served score
+            warm_total = time.perf_counter() - t0
+            out["recovery_swap_to_first_score_seconds"] = round(
+                float(REGISTRY.gauge("swap_to_first_score_seconds").value())
+                or warm_total, 4)
+            out["recovery_swap_retraces_after_warmup"] = int(
+                retrace.retraces_after_warmup("additive_score_rows") - rtr0)
+            # Cold comparison: same swap WITHOUT a prepared standby pays
+            # the full build + warmup before the pointer moves.
+            t0 = time.perf_counter()
+            v2 = registry.swap(mdirs[0])
+            v2.scorer.score_rows([row])
+            out["recovery_swap_cold_build_and_score_seconds"] = round(
+                time.perf_counter() - t0, 4)
+    finally:
+        # The temp store is gone with the drill; never leave the process
+        # default (or jax's cache config) pointing at a deleted directory.
+        cstore.deactivate()
+        _jax.config.update("jax_compilation_cache_dir", prev_cache_dir)
+        _jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_cache_min)
+        cstore._reset_jax_cache_handle()
+        if prev_store is not None and os.path.isdir(prev_store.root):
+            cstore.configure(prev_store.root)
+
+    out["recovery"] = {
+        "backend": _live_backend(),
+        "restart_to_first_step_seconds": out.get(
+            "recovery_restart_to_first_step_seconds"),
+        "swap_to_first_score_seconds": out.get(
+            "recovery_swap_to_first_score_seconds"),
+        "warm_xla_share": out.get("recovery_warm_xla_share"),
+        "swap_retraces_after_warmup": out.get(
+            "recovery_swap_retraces_after_warmup"),
+    }
+    return out
+
+
 def _game_scale_data_path():
     """ISSUE 9 acceptance instrument: same-box A/B of the ingest→device→
     solve data path, judged by the PR 6 timeline analyzer.
@@ -2331,6 +2550,7 @@ def main():
         ("game", bench_game),
         ("serve", bench_serve),
         ("online", bench_online),
+        ("recovery", bench_recovery),
         ("ingest", bench_ingest),
         ("game_scale", bench_game_scale),
         ("tuner", bench_tuner),
@@ -2342,6 +2562,7 @@ def main():
             "game": "game_samples_per_sec",
             "serve": "serve_rows_per_sec",
             "online": "online_freshness_p50_ms",
+            "recovery": "recovery_restart_to_first_step_seconds",
             "ingest": "ingest_rows_per_sec",
             "game_scale": "game_scale_total_seconds",
             "tuner": "tuner_trials",

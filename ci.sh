@@ -51,13 +51,20 @@ echo "order: ${SHUFFLED}" >> ci_random_order.txt
 # shellcheck disable=SC2086
 python -m pytest ${SHUFFLED} -q -p no:cacheprovider
 
-echo "== recovery smoke (fail-fast backend probe; docs/robustness.md) =="
+echo "== recovery smoke (fail-fast backend probe + zero-recompile warm restart) =="
 # Backend-failure resilience without a chip: an injected init HANG dies at
 # the PHOTON_BACKEND_INIT_TIMEOUT_S deadline (seconds, not the ~1500s the
 # operational record shows), injected UNAVAILABLE/OOM inits classify, the
 # strict/failover policy ladder enforces, and a RunSupervisor drill
-# journals a classified restart.
-python scripts/recovery_smoke.py
+# journals a classified restart. The warm-restart drill then asserts the
+# zero-recompile contract (docs/robustness.md §"Recovery time"):
+# restart_to_first_step_seconds is journaled per attempt and the restart's
+# XLA share sits BELOW its I/O share — $PHOTON_XLA_CACHE_DIR is the
+# persistent artifact layer (a fresh dir per CI run, scoped to this stage
+# so later stages keep their own cache defaults) so the drill exercises a
+# real warm restart, never a silent cold one.
+PHOTON_XLA_CACHE_DIR="${PHOTON_XLA_CACHE_DIR:-$(mktemp -d /tmp/photon-ci-xla.XXXXXX)}" \
+  python scripts/recovery_smoke.py
 
 echo "== chaos smoke (deterministic fault injection; docs/robustness.md) =="
 # The chaos suite re-runs standalone so a fault-injection regression is

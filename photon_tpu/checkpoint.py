@@ -147,10 +147,24 @@ class CheckpointManager:
     # ------------------------------------------------------------------ save
 
     def save(self, step: int, state: Any, meta: Optional[dict] = None) -> None:
-        """Snapshot (device → host) now; write to disk in the background."""
+        """Snapshot (device → host) now; write to disk in the background.
+
+        When an AOT compile store is active (runtime/compile_store.py) the
+        metadata carries its manifest reference, so a checkpoint-resume
+        restart — possibly a fresh process on another host sharing the
+        filesystem — knows exactly which compiled artifacts to pre-warm
+        before it starts solving."""
         if self._error is not None:
             raise RuntimeError("checkpoint writer failed") from self._error
         payload = {"state": _to_host(state), "meta": dict(meta or {}), "step": step}
+        try:
+            from photon_tpu.runtime.compile_store import manifest_ref_if_active
+
+            ref = manifest_ref_if_active()
+            if ref is not None:
+                payload["meta"].setdefault("compile_store", ref)
+        except Exception:  # noqa: BLE001 - the stamp is advisory metadata
+            pass
         self._queue.put((step, payload))
         self._saves += 1
         if self.fail_after is not None and self._saves >= self.fail_after:

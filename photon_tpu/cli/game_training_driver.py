@@ -169,6 +169,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     from photon_tpu.cli.params import (
         add_backend_policy_flag,
         add_compilation_cache_flag,
+        add_compile_store_flag,
         add_fault_plan_flag,
         add_re_routing_flags,
         add_trace_flag,
@@ -176,6 +177,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
 
     add_backend_policy_flag(p)
     add_compilation_cache_flag(p)
+    add_compile_store_flag(p)
     add_fault_plan_flag(p)
     add_re_routing_flags(p)
     add_trace_flag(p)
@@ -236,6 +238,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     from photon_tpu.cli.params import (
         enable_backend_guard,
         enable_compilation_cache,
+        enable_compile_store,
         enable_fault_plan,
         enable_re_routing,
         enable_trace,
@@ -246,6 +249,15 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     # anything can initialize a backend in-process and wedge.
     enable_backend_guard(args)
     enable_compilation_cache(args.compilation_cache_dir)
+    # AOT compile store (after the cache flag so an explicit
+    # --compilation-cache-dir stays the artifact layer): records every
+    # blessed-kernel compile and pre-warms restarts/recoveries from it
+    # (docs/robustness.md §"Recovery time"). On by default for every run
+    # that can RESTART (supervised restarts, checkpoint resume) — the only
+    # flows that re-enter compiled state — and opt-in via --compile-store
+    # for one-shot runs.
+    if args.compile_store or args.checkpoint_dir or args.max_restarts > 0:
+        enable_compile_store(args, output_dir=args.output_dir)
     enable_fault_plan(args.fault_plan)
     enable_re_routing(args, output_dir=args.output_dir)
     enable_trace(args.trace_out)

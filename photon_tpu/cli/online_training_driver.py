@@ -82,12 +82,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
     from photon_tpu.cli.params import (
         add_backend_policy_flag,
         add_compilation_cache_flag,
+        add_compile_store_flag,
         add_fault_plan_flag,
         add_trace_flag,
     )
 
     add_backend_policy_flag(p)
     add_compilation_cache_flag(p)
+    add_compile_store_flag(p)
     add_fault_plan_flag(p)
     add_trace_flag(p)
     return p
@@ -156,6 +158,7 @@ def _run(args) -> dict:
     from photon_tpu.cli.params import (
         enable_backend_guard,
         enable_compilation_cache,
+        enable_compile_store,
         enable_fault_plan,
         enable_trace,
     )
@@ -171,6 +174,12 @@ def _run(args) -> dict:
 
     enable_backend_guard(args)
     enable_compilation_cache(args.compilation_cache_dir)
+    # Opt-in AOT compile store: the fixed-ladder refresh kernels record at
+    # first compile, so a device-loss recovery's cache clear repopulates
+    # by LOADING instead of retracing (docs/robustness.md §"Recovery
+    # time"). Opt-in (flag/env), like the serving driver.
+    if getattr(args, "compile_store", None):
+        enable_compile_store(args, output_dir=args.output_dir)
     enable_fault_plan(args.fault_plan)
     enable_trace(args.trace_out)
     plogger = PhotonLogger(args.output_dir)

@@ -267,19 +267,96 @@ def add_compilation_cache_flag(parser) -> None:
 
 def enable_compilation_cache(path) -> None:
     """Turn on jax's persistent compilation cache at ``path`` (no-op if
-    falsy). Must run before the first jit compilation."""
+    falsy). Must run before the first jit compilation — jax only consults
+    the cache dir at compile time, so everything compiled BEFORE this call
+    is silently uncached and will recompile on the next restart. A late
+    call used to be a silent no-op for those programs; now it is detected
+    (any watched kernel already traced in this process) and warned LOUDLY,
+    because a driver that reorders its init quietly loses exactly the
+    warm-restart behavior the recovery stack depends on
+    (docs/robustness.md §"Recovery time")."""
     if not path:
         return
+    import logging
     import os
 
     import jax
 
+    from photon_tpu.runtime.compile_store import process_has_compiled
+
+    if process_has_compiled():
+        logging.getLogger("photon_tpu.cli").warning(
+            "enable_compilation_cache(%r) called AFTER this process already "
+            "compiled kernels: programs compiled before this point were NOT "
+            "persisted and will recompile from scratch on the next restart "
+            "(the cache handle is re-initialized now, so later compiles DO "
+            "persist). Call it (or enable_compile_store) before the first "
+            "jit dispatch — typically first thing in the driver, before "
+            "data loading touches any jitted code.", path,
+        )
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
     jax.config.update(
         "jax_persistent_cache_min_compile_time_secs",
         float(os.environ.get("PHOTON_XLA_CACHE_MIN_SECS", "1.0")),
     )
+    # A late enable used to be a TOTAL silent no-op: jax memoizes the
+    # cache handle at the process's first compile (watched or not — even a
+    # stray jnp.zeros counts), so setting the dir afterwards persisted
+    # nothing, ever. Resetting the handle unconditionally makes the call
+    # effective from here on (the warning above still marks pre-call
+    # compiles as lost).
+    from photon_tpu.runtime.compile_store import _reset_jax_cache_handle
+
+    _reset_jax_cache_handle()
+
+
+def add_compile_store_flag(parser) -> None:
+    """Shared --compile-store flag (default: $PHOTON_COMPILE_STORE, else
+    <output-dir>/compile-store): the AOT compile-artifact store that makes
+    restarts and device-loss recoveries zero-recompile
+    (runtime/compile_store.py; docs/robustness.md §"Recovery time")."""
+    import os
+
+    parser.add_argument(
+        "--compile-store",
+        default=os.environ.get("PHOTON_COMPILE_STORE") or None,
+        help="AOT compile-artifact store directory: compiled-kernel "
+             "signatures are recorded into a manifest and the supervisor / "
+             "device-loss recovery pre-warms them from the persistent "
+             "compilation cache instead of re-paying XLA "
+             "(default: $PHOTON_COMPILE_STORE, else "
+             "<output-dir>/compile-store; 'off' disables)")
+
+
+def enable_compile_store(args, output_dir=None):
+    """Activate the AOT compile store process-wide (``--compile-store off``
+    disables). Defaults to ``<output-dir>/compile-store`` so supervised
+    restarts and checkpoint resumes get zero-recompile behavior out of the
+    box; when the driver wired no ``--compilation-cache-dir``, the store
+    supplies the persistent-cache layer itself (see
+    runtime/compile_store.configure). Returns the store or None."""
+    import logging
+
+    from photon_tpu.runtime import compile_store
+
+    path = getattr(args, "compile_store", None)
+    if path in ("off", "0", "none"):
+        # Pin the opt-out: a fleet-wide $PHOTON_COMPILE_STORE must not
+        # lazily re-activate behind the operator's explicit 'off'.
+        compile_store.disable()
+        return None
+    if path is None and output_dir:
+        import os
+
+        path = os.path.join(output_dir, "compile-store")
+    if not path:
+        return None
+    store = compile_store.configure(path)
+    logging.getLogger("photon_tpu.cli").info(
+        "AOT compile store: %s (%d recorded signature(s))",
+        store.root, len(store.entries()))
+    return store
 
 
 def add_trace_flag(parser) -> None:

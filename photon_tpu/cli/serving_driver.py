@@ -84,6 +84,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     add_compilation_cache_flag(p)
     add_fault_plan_flag(p)
     add_trace_flag(p)
+    from photon_tpu.cli.params import add_compile_store_flag
+
+    add_compile_store_flag(p)
     return p
 
 
@@ -92,6 +95,7 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
     from photon_tpu.cli.params import (
         enable_backend_guard,
         enable_compilation_cache,
+        enable_compile_store,
         enable_fault_plan,
         enable_trace,
     )
@@ -102,6 +106,14 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
     # deploy for 25 minutes inside model warmup's first device touch.
     enable_backend_guard(args)
     enable_compilation_cache(args.compilation_cache_dir)
+    # Opt-in AOT compile store (docs/robustness.md §"Recovery time"):
+    # warmup records every bucket shape, so a RESTARTED serving process
+    # (or the kernel-breaker re-warmup after a device loss) loads its
+    # whole compiled ladder from the persistent cache instead of paying
+    # XLA during the deploy window. No output-dir default here — serving
+    # boxes opt in with --compile-store / $PHOTON_COMPILE_STORE.
+    if getattr(args, "compile_store", None):
+        enable_compile_store(args)
     enable_fault_plan(args.fault_plan)
     enable_trace(args.trace_out)
     plogger = PhotonLogger(args.output_dir)

@@ -259,6 +259,16 @@ class GameEstimator:
                     "resuming from checkpoint step %d (config %d)",
                     payload["step"], start_config,
                 )
+                # Zero-recompile resume (docs/robustness.md §recovery
+                # time): the checkpoint's compile-store manifest reference
+                # pre-warms every executable the interrupted run compiled
+                # BEFORE the first resumed step dispatches — the restart
+                # cost becomes artifact I/O, not XLA.
+                from photon_tpu.runtime.compile_store import (
+                    prewarm_from_checkpoint,
+                )
+
+                prewarm_from_checkpoint(payload, logger_=logger)
 
         # Each config owns steps_per_config descent steps + 1 config-done slot.
         steps_per_config = self.n_sweeps * len(self.update_sequence)

@@ -255,16 +255,16 @@ class GameTransformer:
                 re_parts.append((cid, dcfg.feature_shard))
             else:  # pragma: no cover - union is closed
                 raise TypeError(f"unknown data config {type(dcfg)}")
-        return additive_score_rows(
-            jnp.asarray(data.offsets, jnp.float32),
-            shard_idx,
-            shard_val,
-            fixed_ws,
-            re_proj,
-            re_coef,
-            fixed_parts=tuple(fixed_parts),
-            re_parts=tuple(re_parts),
-        )
+        # AOT compile store (runtime/compile_store.py): the batch-scored
+        # shape joins the manifest so restarts pre-warm it too.
+        from photon_tpu.runtime.compile_store import dispatch_recorded
+
+        return dispatch_recorded(
+            SCORE_KERNEL_NAME, additive_score_rows,
+            (jnp.asarray(data.offsets, jnp.float32), shard_idx, shard_val,
+             fixed_ws, re_proj, re_coef),
+            {"fixed_parts": tuple(fixed_parts),
+             "re_parts": tuple(re_parts)})
 
     def transform_and_evaluate(
         self, data: GameDataBundle, suite: EvaluationSuite

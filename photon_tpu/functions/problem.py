@@ -123,7 +123,14 @@ class GLMOptimizationProblem:
         with trace_span("optim.glm_fit", cat="optim", rows=batch.n_rows,
                         dim=batch.dim,
                         optimizer=self.optimizer_type.name):
-            return _fit_jitted(key, batch, w0, mask, pr, normalization, rw)
+            # First compile of this signature lands in the AOT compile
+            # store (runtime/compile_store.py) so a restart or device-loss
+            # recovery pre-warms it instead of re-tracing.
+            from photon_tpu.runtime.compile_store import dispatch_recorded
+
+            return dispatch_recorded(
+                "glm_fit", _fit_jitted,
+                (key, batch, w0, mask, pr, normalization, rw))
 
     def run(
         self,

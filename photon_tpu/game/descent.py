@@ -287,6 +287,12 @@ class CoordinateDescent:
                             logger=logger,
                         )
                         rearm_sweep = sweep + 1
+                # Close the supervisor's restart→first-step clock on the
+                # FIRST committed step of a supervised attempt (no-op when
+                # no clock is armed — runtime/compile_store.py).
+                from photon_tpu.runtime.compile_store import note_first_step
+
+                note_first_step("descent.step")
                 dt = step_span.seconds
 
                 record = CoordinateStepRecord(sweep, cid, dt)

@@ -325,27 +325,54 @@ def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
 
     compile_by_solver: dict = {}
 
-    def watched(name, fit_fn):
+    def watched(name, fit_fn, record_fn=None):
         """Accumulate compile time of every dispatch, PER solver — under
         measured routing the calibration race compiles every candidate, and
         charging the losers' compiles to the winner's label would corrupt
-        the per-solver compile split the counters exist to report."""
+        the per-solver compile split the counters exist to report.
+
+        ``record_fn(*args)`` runs once per detected compile: it records the
+        compiled signature into the AOT compile store
+        (runtime/compile_store.py) so restarts and device-loss recoveries
+        pre-warm the blessed kernel set instead of re-tracing cold. Not
+        under a mesh — sharded avals would not replay to the same HLO."""
         def run(*args):
             with compile_watch() as cw:
                 out = fit_fn(*args)
             if cw.compile_seconds:
                 compile_by_solver[name] = (
                     compile_by_solver.get(name, 0.0) + cw.compile_seconds)
+                if record_fn is not None:
+                    record_fn(*args)
             return out
         return run
 
+    if mesh_active:
+        rec_primal = rec_dual = rec_vmapped = None
+    else:
+        from photon_tpu.runtime.compile_store import record_if_active
+
+        def rec_primal(b, w, m, pr):
+            record_if_active("fit_bucket_newton", fit_bucket_newton,
+                             (problem, b, w, m, pr))
+
+        def rec_dual(b, w, m, pr):
+            record_if_active("fit_bucket_newton_dual", fit_bucket_newton_dual,
+                             (problem, b, w, m, pr, get_u_max()))
+
+        def rec_vmapped(b, w, m, pr):
+            record_if_active("fit_bucket_vmapped", _fit_bucket_jitted,
+                             (problem, b, w, m, local_norm, pr))
+
     fit_primal = watched(
         "newton_primal",
-        lambda b, w, m, pr: fit_bucket_newton(problem, b, w, m, pr))
+        lambda b, w, m, pr: fit_bucket_newton(problem, b, w, m, pr),
+        record_fn=rec_primal)
     fit_vmapped = watched(
         "vmapped_lbfgs",
         lambda b, w, m, pr: _fit_bucket_jitted(
-            problem, b, w, m, local_norm, pr))
+            problem, b, w, m, local_norm, pr),
+        record_fn=rec_vmapped)
 
     # u_max is a device reduction + blocking D2H sync per bucket — memoized
     # and computed LAZILY, so it is only paid once a bucket actually
@@ -367,7 +394,8 @@ def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
     fit_dual = watched(
         "newton_dual",
         lambda b, w, m, pr: fit_bucket_newton_dual(
-            problem, b, w, m, pr, get_u_max()))
+            problem, b, w, m, pr, get_u_max()),
+        record_fn=rec_dual)
 
     def finish(models, result, **info):
         info.setdefault("chunk", None)

@@ -485,8 +485,17 @@ class OnlineTrainer:
                     "caches and re-running (recovery %d)", e, recoveries,
                 )
                 clear_executable_caches("online refresh recovery")
-                # Every executable is gone; the retry recompiles each shape
-                # class from scratch (declared expected above).
+                # Every executable is gone; repopulate from the AOT compile
+                # store when one is active (docs/robustness.md §"Recovery
+                # time") so the retry LOADS its fixed-ladder kernels instead
+                # of recompiling each shape class from scratch (either way,
+                # declared expected above).
+                from photon_tpu.runtime.compile_store import (
+                    prewarm_if_active,
+                )
+
+                prewarm_if_active(reason="online refresh recovery",
+                                  logger_=logger)
                 self._compiled_shapes.clear()
 
     def _solve_coordinate(self, cid: str, dirty: list) -> dict:
@@ -639,6 +648,10 @@ class OnlineTrainer:
 
             def fit_one(b, w, m, pr):
                 return fit_bucket_newton(problem, b, w, m, pr)
+
+            def record_sig(b, w, m, pr):
+                return ("fit_bucket_newton", fit_bucket_newton,
+                        (problem, b, w, m, pr))
         elif s < p and s <= DUAL_MAX_T:
             u_max = u_max_for(penalty_terms(problem, mask, prior)[3])
             if s + u_max <= DUAL_MAX_T:
@@ -647,19 +660,47 @@ class OnlineTrainer:
                 def fit_one(b, w, m, pr):
                     return fit_bucket_newton_dual(problem, b, w, m, pr,
                                                   u_max)
+
+                def record_sig(b, w, m, pr):
+                    return ("fit_bucket_newton_dual", fit_bucket_newton_dual,
+                            (problem, b, w, m, pr, u_max))
         if solver == "vmapped_lbfgs":
             def fit_one(b, w, m, pr):
                 return _fit_bucket_jitted(problem, b, w, m, None, pr)
+
+            def record_sig(b, w, m, pr):
+                return ("fit_bucket_vmapped", _fit_bucket_jitted,
+                        (problem, b, w, m, None, pr))
         shape_key = (solver, s, p, self.config.chunk,
                      str(batches.features.val.dtype),
                      prior is not None)
         if shape_key not in self._compiled_shapes:
             from photon_tpu.obs import retrace
+            from photon_tpu.runtime.compile_store import record_if_active
 
             self._compiled_shapes.add(shape_key)
+
+            recorded = []
+
+            def fit_recorded(b, w, m, pr):
+                # First cycle of this shape class: the per-chunk args are
+                # the exact padded avals the kernel compiles at — record
+                # them so a device-loss recovery (or restarted trainer)
+                # pre-warms the fixed ladder from the store. Once per
+                # shape class: every chunk is padded to the SAME lanes, so
+                # later chunks would only re-pickle the identical
+                # signature into the dedup check.
+                out = fit_one(b, w, m, pr)
+                if not recorded:
+                    recorded.append(True)
+                    kernel, fn, args = record_sig(b, w, m, pr)
+                    record_if_active(kernel, fn, args)
+                return out
+
             with retrace.expected_compiles():
                 models, _result = fit_bucket_in_chunks(
-                    fit_one, self.config.chunk, batches, w0, mask, prior)
+                    fit_recorded, self.config.chunk, batches, w0, mask,
+                    prior)
         else:
             models, _result = fit_bucket_in_chunks(
                 fit_one, self.config.chunk, batches, w0, mask, prior)

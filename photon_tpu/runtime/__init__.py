@@ -8,6 +8,10 @@ exceptions or, worse, 25-minute silent hangs (TPU_RECOVERY.jsonl).
 ``backend_guard`` makes backend failure a first-class, tested contract:
 fail fast under a hard deadline, classify the cause, and recover under an
 explicit policy (docs/robustness.md §"Backend-failure resilience").
+``compile_store`` makes recovery CHEAP: an AOT compile-artifact store +
+manifest so restarts, device-loss re-steps, and serving hot-swaps load
+compiled executables instead of re-paying XLA (docs/robustness.md
+§"Recovery time").
 """
 from photon_tpu.runtime.backend_guard import (
     BACKEND_POLICIES,
@@ -22,8 +26,14 @@ from photon_tpu.runtime.backend_guard import (
     probe_backend,
     recover_from_device_loss,
 )
+from photon_tpu.runtime.compile_store import (
+    CompileStore,
+    compile_split,
+)
 
 __all__ = [
+    "CompileStore",
+    "compile_split",
     "BACKEND_POLICIES",
     "BackendProbeResult",
     "BackendUnusable",

@@ -574,12 +574,32 @@ def recover_from_device_loss(
                 logger.warning("backend client re-init unavailable (%s: %s); "
                                "executable caches cleared only",
                                type(e).__name__, e)
+
+    # Repopulate from the AOT compile store (runtime/compile_store.py):
+    # every executable the purge dropped loads back from the persistent
+    # cache BEFORE the caller re-enters its step, so the recovery re-step
+    # dispatches warm instead of recompiling the whole kernel set cold.
+    # AFTER the optional client re-init on purpose — clear_backends drops
+    # the client the pre-warmed executables would live in, so warming
+    # first would waste the whole pass and lie in the telemetry. prewarm
+    # emits its own recovery.prewarm instant; a missing/failed store
+    # degrades to the pre-store behavior (recompile on dispatch).
+    from photon_tpu.runtime import compile_store as _cs
+
+    prewarm = _cs.prewarm_if_active(reason=f"device-loss recovery: {reason}",
+                                    logger_=logger)
     instant("recovery.backend_reinit", cat="recovery", reason=reason,
-            caches_released=released, client_reinit=reinit)
+            caches_released=released, client_reinit=reinit,
+            prewarm_loaded=None if prewarm is None else prewarm["loaded"])
     if logger is not None:
         logger.warning(
             "device-loss recovery (%s): executable caches cleared, %d sweep "
-            "cache(s) released%s — resuming from checkpointed state",
+            "cache(s) released%s%s — resuming from checkpointed state",
             reason, released, ", backend client re-initialized"
-            if reinit else "")
-    return {"caches_released": released, "client_reinit": reinit}
+            if reinit else "",
+            "" if prewarm is None else
+            f", {prewarm['loaded']} executable(s) pre-warmed from the "
+            f"compile store ({prewarm['load_seconds']:.3f}s load, "
+            f"{prewarm['xla_seconds']:.3f}s xla)")
+    return {"caches_released": released, "client_reinit": reinit,
+            "prewarm": prewarm}

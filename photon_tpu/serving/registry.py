@@ -70,6 +70,24 @@ class ModelVersion:
         return self.meta["coordinates"]
 
 
+def _model_dir_stamp(model_dir: str) -> tuple:
+    """Content stamp of a model directory's top-level files
+    ((name, mtime_ns, size) per entry, sorted): a re-push between
+    ``prepare_standby`` and ``swap`` changes it, so a warmed-but-stale
+    standby is detected and rebuilt instead of silently published."""
+    out = []
+    try:
+        for name in sorted(os.listdir(model_dir)):
+            try:
+                st = os.stat(os.path.join(model_dir, name))
+            except OSError:
+                continue
+            out.append((name, st.st_mtime_ns, st.st_size))
+    except OSError:
+        pass
+    return tuple(out)
+
+
 def _build_version(
     version: int, model_dir: str, config: ServingConfig,
     index_dir: Optional[str] = None,
@@ -133,6 +151,13 @@ class ModelRegistry:
         self._swap_lock = threading.Lock()  # serializes concurrent swaps
         self._next_version = 1
         self._current: Optional[ModelVersion] = None
+        # Warm standby (docs/robustness.md §"Recovery time"): a fully
+        # built + warmed next version held aside so the swap that publishes
+        # it collapses to a pointer move (prepare_standby / swap). The
+        # directory stamp detects a re-push between prepare and swap.
+        self._standby: Optional[ModelVersion] = None
+        self._standby_prepared_at: Optional[float] = None
+        self._standby_stamp: Optional[tuple] = None
         # Online-delta freshness bookkeeping (docs/online.md): patch_seq /
         # timestamps survive hot-swaps so /healthz freshness is measurable
         # with or without a trainer attached.
@@ -150,6 +175,43 @@ class ModelRegistry:
         with self._lock:
             return self._current
 
+    def prepare_standby(self, model_dir: str) -> dict:
+        """Build + fully WARM ``model_dir`` as a standby version NOW —
+        index preload, coefficient store, and the scorer's whole
+        compiled-shape ladder — without publishing it. The next
+        :meth:`swap` to the same directory then collapses to a pointer
+        move: no load, no warmup, zero scoring-kernel retraces on the
+        serving threads (docs/robustness.md §"Recovery time").
+
+        Serialized against swaps (same lock), invisible to traffic. A
+        failed build leaves any previous standby intact."""
+        with self._swap_lock:
+            stamp = _model_dir_stamp(model_dir)
+            version = _build_version(
+                self._next_version, model_dir, self.config, self._index_dir
+            )
+            with self._lock:
+                self._standby = version
+                self._standby_prepared_at = time.time()
+                self._standby_stamp = stamp
+        from photon_tpu.obs import instant
+
+        instant("serving.standby_prepared", cat="serving",
+                model_dir=model_dir)
+        return {"model_dir": model_dir, "prepared_at": time.time(),
+                "warmed": True}
+
+    def standby_snapshot(self) -> dict:
+        """Standby state for /healthz: is a pre-warmed next version ready,
+        and for which model directory."""
+        with self._lock:
+            sb, at = self._standby, self._standby_prepared_at
+        return {
+            "ready": sb is not None,
+            "model_dir": None if sb is None else sb.model_dir,
+            "prepared_at": at,
+        }
+
     def swap(self, model_dir: str) -> ModelVersion:
         """Load + warm ``model_dir`` as a new version, then publish it.
 
@@ -157,15 +219,60 @@ class ModelRegistry:
         final pointer assignment. Raises (and keeps the current version)
         if the new directory fails to load — a bad push can't take the
         server down.
+
+        When :meth:`prepare_standby` already warmed this directory, the
+        build + warmup are skipped entirely and the swap IS the pointer
+        assignment — the ``swap_to_first_score_seconds`` the scorer stamps
+        then measures one dispatch, not a model load. Either way the
+        published scorer's swap clock is armed at publish time.
         """
         with self._swap_lock:
-            version = _build_version(
-                self._next_version, model_dir, self.config, self._index_dir
-            )
             with self._lock:
+                standby = self._standby
+                stamp = self._standby_stamp
+                if standby is not None and standby.model_dir == model_dir:
+                    self._standby = None
+                    self._standby_prepared_at = None
+                    self._standby_stamp = None
+                else:
+                    standby = None
+            if standby is not None and stamp != _model_dir_stamp(model_dir):
+                # The directory was re-pushed after prepare_standby: the
+                # warmed snapshot no longer matches what's on disk.
+                # Publishing it would silently serve OUTDATED coefficients
+                # under the new version number — discard it and take the
+                # build path (a slower swap, never a stale one).
+                from photon_tpu.obs import instant
+
+                instant("serving.standby_stale", cat="serving",
+                        model_dir=model_dir)
+                standby = None
+            from_standby = standby is not None
+            if from_standby:
+                version = dataclasses.replace(
+                    standby, version=self._next_version,
+                    loaded_at=time.time())
+            else:
+                version = _build_version(
+                    self._next_version, model_dir, self.config,
+                    self._index_dir
+                )
+            with self._lock:
+                hot = self._current is not None
                 self._current = version
                 self._next_version += 1
-            return version
+            if hot:
+                # Swap→first-score clock (docs/robustness.md §recovery
+                # time): armed at the pointer move, closed by the first
+                # served batch. Not armed for the registry's initial load —
+                # "time since construction" is startup, not a swap.
+                version.scorer.arm_swap_clock()
+        if hot:
+            from photon_tpu.obs import instant
+
+            instant("serving.hot_swap", cat="serving",
+                    version=version.version, from_standby=from_standby)
+        return version
 
     def apply_delta(self, patches_by_coordinate, seq: Optional[int] = None,
                     event_horizon: Optional[int] = None) -> dict:

@@ -219,6 +219,37 @@ class RowScorer:
         longer sequences score in max_batch-sized chunks."""
         return self.score_rows_flagged(rows)[0]
 
+    def arm_swap_clock(self, t0: Optional[float] = None) -> None:
+        """Start the hot-swap→first-score clock (the registry arms it the
+        moment this scorer's version is published). The first SERVED batch
+        after arming stamps ``swap_to_first_score_seconds`` — on the
+        standby path this collapses to the pointer-swap + one dispatch,
+        which is the whole point (docs/robustness.md §"Recovery time")."""
+        import time as _time
+
+        self._swap_armed_t0 = _time.monotonic() if t0 is None else t0
+
+    def _note_swap_first_score(self) -> None:
+        # dict.pop is atomic under the GIL: exactly one serving thread
+        # claims the armed clock, the rest see a no-op.
+        t0 = self.__dict__.pop("_swap_armed_t0", None)
+        if t0 is None:
+            return
+        import time as _time
+
+        from photon_tpu.obs import instant
+        from photon_tpu.obs.metrics import REGISTRY
+
+        seconds = _time.monotonic() - t0
+        REGISTRY.gauge(
+            "swap_to_first_score_seconds",
+            "seconds from a registry hot-swap publishing a version to its "
+            "first completed scored batch (docs/robustness.md §recovery "
+            "time)",
+        ).set(round(seconds, 4))
+        instant("recovery.swap_first_score", cat="recovery",
+                seconds=round(seconds, 4))
+
     def score_rows_flagged(
         self, rows: Sequence[ParsedRow]
     ) -> tuple[np.ndarray, list]:
@@ -232,6 +263,8 @@ class RowScorer:
             s, f = self._score_chunk(rows[lo: lo + cap])
             out.append(s)
             flags.extend(f)
+        if rows:
+            self._note_swap_first_score()
         return (
             np.concatenate(out) if out else np.zeros(0, np.float32),
             flags,
@@ -277,16 +310,17 @@ class RowScorer:
             # during warmup so a plan's `after` counts only served batches.
             if not self._warming:
                 fault_point("serving.kernel", rows=b, bucket=bp)
-            scores = additive_score_rows(
-                jnp.asarray(offsets),
-                shard_idx,
-                shard_val,
-                self._fixed_ws,
-                re_proj,
-                re_coef,
-                fixed_parts=self.fixed_parts,
-                re_parts=self.re_parts,
-            )
+            # First compile of a bucket shape is recorded in the AOT
+            # compile store so a restarted serving process (or a standby
+            # scorer) pre-warms the whole ladder instead of re-tracing.
+            from photon_tpu.runtime.compile_store import dispatch_recorded
+
+            scores = dispatch_recorded(
+                SCORE_KERNEL_NAME, additive_score_rows,
+                (jnp.asarray(offsets), shard_idx, shard_val,
+                 self._fixed_ws, re_proj, re_coef),
+                {"fixed_parts": self.fixed_parts,
+                 "re_parts": self.re_parts})
             # The D2H fetch below is the sync point; inside the span so the
             # kernel span reports completed compute, not async dispatch.
             return np.asarray(scores)

@@ -207,6 +207,9 @@ class ScoringServer:
                         # watermarks, so freshness SLOs are measurable
                         # whether or not an online trainer is attached.
                         "freshness": server.freshness(),
+                        # Recovery latency watermarks + standby readiness
+                        # (docs/robustness.md §"Recovery time").
+                        "recovery": server.recovery_snapshot(),
                     }
                     if not server.batcher.healthy:
                         self._reply(503, {
@@ -234,6 +237,8 @@ class ScoringServer:
                     self._score()
                 elif self.path == "/admin/swap":
                     self._swap()
+                elif self.path == "/admin/standby":
+                    self._standby()
                 elif self.path == "/admin/patch":
                     self._patch()
                 else:
@@ -337,6 +342,31 @@ class ScoringServer:
                         "hot-swapped to version %d (%s)", v.version, model_dir
                     )
                 self._reply(200, {"model_version": v.version})
+
+            def _standby(self):
+                """Pre-warm the NEXT version (docs/robustness.md §"Recovery
+                time"): build + warm model_dir off the hot path so the
+                following /admin/swap to the same directory is a pointer
+                move with zero scoring-kernel retraces."""
+                try:
+                    payload = self._read_json()
+                    if not isinstance(payload, dict):
+                        raise RequestError(
+                            "request body must be a JSON object")
+                    model_dir = payload.get("model_dir")
+                    if not model_dir:
+                        raise RequestError("model_dir required")
+                    info = server.registry.prepare_standby(model_dir)
+                except RequestError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 - bad dir, keep old
+                    server._count(errors=1)
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                if server.logger is not None:
+                    server.logger.info("standby prepared: %s", model_dir)
+                self._reply(200, {"status": "prepared", **info})
 
             def _patch(self):
                 """Online model delta (docs/online.md §"Delta protocol"):
@@ -448,6 +478,26 @@ class ScoringServer:
             return self.registry.freshness_snapshot()
         except Exception:  # noqa: BLE001 - harness fakes lack a registry
             return {}
+
+    def recovery_snapshot(self) -> dict:
+        """Recovery-time watermarks for /healthz (docs/robustness.md
+        §"Recovery time"): the two latency gauges the zero-recompile stack
+        stamps (None until first stamped) and standby readiness."""
+        out: dict = {
+            "restart_to_first_step_seconds": None,
+            "swap_to_first_score_seconds": None,
+        }
+        try:
+            for name in out:
+                v = GLOBAL_REGISTRY.gauge(name).value()
+                out[name] = v if v > 0 else None
+        except Exception:  # noqa: BLE001 - health must answer regardless
+            pass
+        try:
+            out["standby"] = self.registry.standby_snapshot()
+        except Exception:  # noqa: BLE001 - harness fakes lack a registry
+            out["standby"] = {"ready": False}
+        return out
 
     def degraded_reasons(self, version=None) -> list:
         """Why this (otherwise alive) server is serving worse answers:

@@ -391,6 +391,7 @@ class RunSupervisor:
         logger=None,
         failover_policy: str = "strict",
         sleep: Callable[[float], None] = time.sleep,
+        compile_store: object = "auto",
     ):
         if isinstance(journal, str):
             journal = RecoveryJournal(journal)
@@ -399,6 +400,18 @@ class RunSupervisor:
         self.logger = logger
         self.failover_policy = failover_policy
         self.sleep = sleep
+        # AOT compile-artifact store (runtime/compile_store.py): "auto"
+        # resolves the process's active store at restart time; None
+        # disables the between-attempt pre-warm; an explicit CompileStore
+        # pins one (tests, bench drills).
+        self.compile_store = compile_store
+
+    def _store(self):
+        if self.compile_store == "auto":
+            from photon_tpu.runtime import compile_store as cs
+
+            return cs.active()
+        return self.compile_store
 
     @staticmethod
     def classify(err: BaseException) -> str:
@@ -471,11 +484,18 @@ class RunSupervisor:
             "training restarts/recoveries by classified cause "
             "(docs/robustness.md §recovery journal)",
         )
+        from photon_tpu.runtime import compile_store as cs_mod
+
         failures: list[AttemptFailure] = []
         delays = self.policy.delays()
         for attempt in range(self.policy.max_restarts + 1):
             t0 = time.monotonic()
             self._journal("attempt_start", attempt=attempt)
+            # restart→first-step clock (docs/robustness.md §recovery time):
+            # the attempt's first committed training step closes it
+            # (descent stamps it), journaling restart_to_first_step_seconds
+            # and setting the gauge /healthz and bench read.
+            cs_mod.arm_first_step_clock(attempt=attempt, journal=self.journal)
             try:
                 result = make_attempt(attempt)
             except BaseException as e:  # noqa: BLE001 - classified below
@@ -496,14 +516,39 @@ class RunSupervisor:
                         "restarting" if will_restart
                         else "fatal" if not retryable else "budget exhausted")
                 if not retryable:
+                    cs_mod.disarm_first_step_clock()
                     self._journal("fatal", attempt=attempt, cause=cause)
                     raise
                 if not will_restart:
+                    cs_mod.disarm_first_step_clock()
                     self._journal("exhausted", attempts=len(failures),
                                   cause=cause)
                     raise RestartsExhausted(failures, e) from e
                 restarts.inc(cause=cause)
                 self._maybe_failover(cause)
+                # Pre-warm the NEXT attempt from the compile store's
+                # manifest: every executable the failed attempt compiled
+                # loads from the persistent cache before the restart goes
+                # live, so the retry's restart-to-first-step is I/O-bound,
+                # not XLA-bound. prewarm() emits the recovery.prewarm trace
+                # instant itself; the journal row is written un-mirrored so
+                # one pre-warm is ONE timeline event.
+                store = self._store()
+                if store is not None:
+                    try:
+                        summary = store.prewarm(
+                            logger_=self.logger,
+                            reason=f"restart attempt {attempt + 1}")
+                    except Exception as pe:  # noqa: BLE001 - never re-fail
+                        summary = None
+                        if self.logger is not None:
+                            self.logger.warning(
+                                "compile-store prewarm failed (%s: %s); "
+                                "restarting cold", type(pe).__name__, pe)
+                    if summary is not None and self.journal is not None:
+                        self.journal.record(
+                            "prewarm", _mirror=False,
+                            attempt=attempt + 1, **summary)
                 delay = next(delays)
                 self._journal("restart", attempt=attempt + 1, cause=cause,
                               backoff_s=round(delay, 3))
@@ -511,6 +556,8 @@ class RunSupervisor:
                     self.sleep(delay)
                 continue
             took = round(time.monotonic() - t0, 3)
+            cs_mod.disarm_first_step_clock()  # a stepless success (full
+            # checkpoint fast-forward) must not leave a stale armed clock
             self._journal("run_ok", attempt=attempt, seconds=took, ok=True,
                           prior_failures=len(failures))
             return result

@@ -15,7 +15,16 @@ the probe's injection seam (``probe_code`` runs arbitrary child code):
 5. a ``RunSupervisor`` drill: a flaky attempt restarts with the cause
    classified and journaled (valid JSONL rows, ``run_restarts_total``
    counter bumped), then an always-failing attempt exhausts the budget
-   and surfaces the last classified cause.
+   and surfaces the last classified cause;
+6. a WARM-RESTART drill (docs/robustness.md §"Recovery time"): a real
+   kernel compiles cold into the AOT compile store
+   (``$PHOTON_XLA_CACHE_DIR`` is the persistent artifact layer — ci.sh
+   wires a fresh dir so this stage actually exercises warm-restart
+   behavior instead of always restarting cold), the attempt dies on a
+   device loss after the executable caches clear, and the supervisor's
+   pre-warmed retry must journal ``restart_to_first_step_seconds`` with
+   the pre-warm's XLA share BELOW its I/O share and ZERO kernel re-traces
+   on the restarted attempt.
 """
 import json
 import os
@@ -141,7 +150,108 @@ def main() -> None:
                   f"exhaustion surfaces the last classified cause "
                   f"({e.cause})")
 
+    warm_restart_drill()
+
     print("recovery smoke ok")
+
+
+def warm_restart_drill() -> None:
+    """Zero-recompile warm restart, end to end (docs/robustness.md
+    §"Recovery time"): cold compile → record → device loss + cache clear →
+    supervisor pre-warm from the store → restarted attempt re-dispatches
+    with NO new kernel trace, journaling restart_to_first_step_seconds and
+    a prewarm row whose XLA share sits below its I/O share."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_tpu.data.batch import LabeledBatch, SparseFeatures
+    from photon_tpu.faults import DeviceLostError
+    from photon_tpu.functions.problem import GLMOptimizationProblem
+    from photon_tpu.obs import retrace
+    from photon_tpu.optim import (
+        OptimizerConfig,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_tpu.runtime import compile_store as cs
+    from photon_tpu.supervisor import clear_executable_caches
+    from photon_tpu.types import TaskType
+
+    print("== warm-restart drill: compile store + supervisor pre-warm ==")
+    # $PHOTON_XLA_CACHE_DIR is the artifact layer (ci.sh wires a fresh
+    # temp dir); without it the drill provisions its own so the assertion
+    # below always exercises a real warm restart, never a silent cold one.
+    if not os.environ.get("PHOTON_XLA_CACHE_DIR"):
+        os.environ["PHOTON_XLA_CACHE_DIR"] = tempfile.mkdtemp(
+            prefix="photon-xla-cache-")
+    print(f"  artifact layer: PHOTON_XLA_CACHE_DIR="
+          f"{os.environ['PHOTON_XLA_CACHE_DIR']}")
+
+    rng = np.random.default_rng(0)
+    n, d, k = 4096, 64, 6
+    idx = rng.integers(0, d, size=(n, k)).astype(np.int32)
+    val = rng.normal(size=(n, k)).astype(np.float32)
+    y = (rng.random(n) < 0.5).astype(np.float32)
+    batch = LabeledBatch(
+        features=SparseFeatures(jnp.asarray(idx), jnp.asarray(val), d),
+        labels=jnp.asarray(y), offsets=jnp.zeros(n), weights=jnp.ones(n))
+    problem = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        regularization=RegularizationContext(RegularizationType.L2),
+        reg_weight=1.0, optimizer_config=OptimizerConfig(max_iterations=10))
+    w0 = jnp.zeros(d)
+
+    with tempfile.TemporaryDirectory() as td:
+        store = cs.configure(os.path.join(td, "store"))
+        journal_path = os.path.join(td, "recovery.jsonl")
+        traces_in_attempt = {}
+
+        def attempt(i):
+            t_before = retrace.traces("glm_fit")
+            model, _ = problem.fit(batch, w0)
+            np.asarray(model.coefficients.means[:1])  # completed-solve sync
+            traces_in_attempt[i] = retrace.traces("glm_fit") - t_before
+            cs.note_first_step("smoke.step")
+            if i == 0:
+                # The device dies AND takes every compiled executable with
+                # it — the exact state a restart re-enters from.
+                clear_executable_caches("smoke: injected device loss")
+                raise DeviceLostError("injected: chip fell off the bus")
+            return np.asarray(model.coefficients.means)
+
+        sup = RunSupervisor(
+            RestartPolicy(max_restarts=1, backoff_seconds=0, jitter=False),
+            journal=RecoveryJournal(journal_path),
+            sleep=lambda s: None,
+            compile_store=store,
+        )
+        coefs = sup.run(attempt)
+        check(np.isfinite(coefs).all(), "restarted attempt solved")
+        check(traces_in_attempt[0] >= 1, "attempt 0 compiled cold")
+        check(traces_in_attempt[1] == 0,
+              "restarted attempt re-traced NOTHING (pre-warm made the "
+              "dispatch warm)")
+
+        rows = [json.loads(x)
+                for x in open(journal_path).read().splitlines()]
+        prewarms = [r for r in rows if r["event"] == "prewarm"]
+        check(len(prewarms) == 1, "supervisor journaled one prewarm row")
+        pw = prewarms[0]
+        check(pw["loaded"] >= 1,
+              f"prewarm LOADED from the store ({pw['loaded']} loaded, "
+              f"{pw['compiled']} compiled)")
+        check(pw["xla_seconds"] < max(pw["load_seconds"], 1e-9),
+              f"warm restart XLA share below I/O share "
+              f"(xla {pw['xla_seconds']}s vs load {pw['load_seconds']}s)")
+        firsts = [r for r in rows if r["event"] == "first_step"]
+        check(len(firsts) == 2 and all(
+            "restart_to_first_step_seconds" in r for r in firsts),
+            "restart_to_first_step_seconds journaled per attempt")
+        check(firsts[-1]["restart_to_first_step_seconds"]
+              < firsts[0]["restart_to_first_step_seconds"],
+              f"warm restart beat the cold one "
+              f"({firsts[-1]['restart_to_first_step_seconds']}s vs "
+              f"{firsts[0]['restart_to_first_step_seconds']}s)")
 
 
 if __name__ == "__main__":

@@ -167,6 +167,64 @@ def test_device_lost_mid_sweep_recovers_in_run_bit_identical(
     assert recovered[0].evaluation.values == ref[0].evaluation.values
 
 
+def test_device_lost_recovery_prewarms_from_compile_store(
+    tmp_path, reference
+):
+    """ISSUE 12 chaos drill: the PR 8 device-loss drill re-run with the
+    AOT compile store enabled. The recovery re-step must LOAD from the
+    store — the retrace sentinel counts the pre-warm's expected loads,
+    never an alarm retrace — and the final model stays bit-identical to
+    the uninterrupted run."""
+    import jax
+
+    from photon_tpu.obs.metrics import REGISTRY
+    from photon_tpu.runtime import compile_store as cs
+
+    bundle, vbundle, ref = reference
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    store = cs.configure(str(tmp_path / "store"))
+    try:
+        # Cold-start the drill: the module-scoped reference fixture already
+        # compiled every shape in-process, and only a fresh compile hits
+        # the record sites (and the now-enabled persistent cache).
+        from photon_tpu.supervisor import clear_executable_caches
+
+        clear_executable_caches("chaos: compile-store drill cold start")
+        plan = FaultPlan(seed=0, specs=[
+            FaultSpec(site="descent.device", error="device_lost", after=2,
+                      count=1),
+        ])
+        loads0 = REGISTRY.counter(
+            "compile_store_prewarm_loads_total").value()
+        retr0 = sum(v for _, v in REGISTRY.counter(
+            "kernel_retraces_after_warmup_total").collect())
+        mgr = CheckpointManager(str(tmp_path / "ck"))
+        with active_plan(plan) as inj:
+            recovered = _estimator().fit(
+                bundle, vbundle, _config(), checkpoint_manager=mgr
+            )
+        mgr.close()
+        assert inj.fired("descent.device") == 1
+        # The in-run recovery pre-warmed from the store: expected LOADS
+        # (persistent-cache hits counted by the store's counters) ...
+        assert REGISTRY.counter(
+            "compile_store_prewarm_loads_total").value() > loads0
+        # ... and zero alarm retraces-after-warmup anywhere.
+        assert sum(v for _, v in REGISTRY.counter(
+            "kernel_retraces_after_warmup_total").collect()) == retr0
+        # The drill's compiles all landed in the manifest (glm + RE set).
+        assert len(store.entries()) >= 2
+        for a, b in zip(_final_arrays(recovered), _final_arrays(ref)):
+            np.testing.assert_array_equal(a, b)
+    finally:
+        cs.deactivate()
+        jax.config.update("jax_compilation_cache_dir", prev_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev_min)
+        cs._reset_jax_cache_handle()
+
+
 def test_device_lost_escalates_to_supervisor_past_budget(
     tmp_path, reference, monkeypatch
 ):

@@ -356,6 +356,97 @@ def test_server_end_to_end_with_hot_swap(trained, tmp_path):
     assert lines and lines[-1]["model_version"] == 2
 
 
+def test_registry_warm_standby_swap_is_pointer_move(trained, tmp_path):
+    """ISSUE 12 acceptance (hot-swap half): a prepared standby makes the
+    registry swap a pointer move — ZERO scoring-kernel traces during the
+    swap itself, ``swap_to_first_score_seconds`` stamped by the first
+    served batch, standby readiness visible on /healthz, and
+    POST /admin/standby drives the whole flow over HTTP."""
+    from photon_tpu.obs.metrics import REGISTRY
+
+    d, (m1, m2), _ = trained
+    config = ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32)
+    registry = ModelRegistry(m1, config)
+    recs = read_records(str(d / "val.avro"))
+    row = registry.current.scorer.parse_request(_payload(recs[0]))
+    before = float(registry.current.scorer.score_rows([row])[0])
+    assert registry.standby_snapshot() == {
+        "ready": False, "model_dir": None, "prepared_at": None}
+
+    registry.prepare_standby(m2)
+    snap = registry.standby_snapshot()
+    assert snap["ready"] and snap["model_dir"] == m2
+
+    traces0 = SCORE_KERNEL_STATS["traces"]
+    v = registry.swap(m2)
+    # The pointer move compiled nothing — the standby was already warm.
+    assert SCORE_KERNEL_STATS["traces"] == traces0
+    assert registry.current is v and v.version == 2
+    assert registry.standby_snapshot()["ready"] is False
+
+    got = float(v.scorer.score_rows(
+        [v.scorer.parse_request(_payload(recs[0]))])[0])
+    assert got != pytest.approx(before, abs=1e-6)  # m2 really serves
+    assert REGISTRY.gauge("swap_to_first_score_seconds").value() > 0
+    assert SCORE_KERNEL_STATS["traces"] == traces0  # still zero retraces
+
+    # A swap with NO standby (or a stale one) takes the build path as
+    # before — standby is an optimization, never a correctness gate.
+    registry.prepare_standby(m2)      # stale: names the OTHER dir
+    v3 = registry.swap(m1)
+    assert v3.version == 3 and registry.standby_snapshot()["ready"]
+
+    # Re-push detection: the directory changing AFTER prepare_standby
+    # must discard the warmed snapshot (build path, never a stale serve).
+    import os as _os
+
+    from photon_tpu.serving import registry as _reg_mod
+
+    _os.utime(_os.path.join(m2, "game-metadata.json"))
+    builds = []
+    orig_build = _reg_mod._build_version
+
+    def counting_build(*a, **kw):
+        builds.append(a)
+        return orig_build(*a, **kw)
+
+    _reg_mod._build_version = counting_build
+    try:
+        v4 = registry.swap(m2)
+    finally:
+        _reg_mod._build_version = orig_build
+    assert v4.version == 4 and builds, "stale standby must rebuild"
+    assert registry.standby_snapshot()["ready"] is False
+
+    # ---- over HTTP: /admin/standby prepares, /healthz reports, swap
+    # publishes, and the recovery block carries the latency watermarks.
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=1.0)
+    server = ScoringServer(ModelRegistry(m1, config), batcher, port=0)
+    server.start()
+    host, port = server.address
+    try:
+        status, body = _get(host, port, "/healthz")
+        assert status == 200
+        assert body["recovery"]["standby"] == {
+            "ready": False, "model_dir": None, "prepared_at": None}
+        status, body = _post(host, port, "/admin/standby",
+                             {"model_dir": m2})
+        assert status == 200 and body["status"] == "prepared"
+        status, body = _get(host, port, "/healthz")
+        assert body["recovery"]["standby"]["ready"] is True
+        status, body = _post(host, port, "/admin/swap", {"model_dir": m2})
+        assert status == 200 and body["model_version"] == 2
+        status, body = _post(host, port, "/score", _payload(recs[0]))
+        assert status == 200 and body["model_version"] == 2
+        status, body = _get(host, port, "/healthz")
+        assert body["recovery"]["swap_to_first_score_seconds"] > 0
+        # missing model_dir is a client error, not a 500
+        status, body = _post(host, port, "/admin/standby", {})
+        assert status == 400
+    finally:
+        server.shutdown()
+
+
 def test_serving_driver_build(trained, tmp_path):
     """The CLI driver builds, warms, and reports through run() (the
     serve_forever=False smoke entry used by deploy checks)."""
