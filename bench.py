@@ -1233,6 +1233,109 @@ def bench_online():
     }
 
 
+def _recovery_oom_drill():
+    """OOM degradation-ladder drill (docs/robustness.md §"Memory
+    pressure"): ONE injected ``device_oom`` at the RE bucket dispatch must
+    be absorbed by a chunk-tier downshift — zero supervisor restarts, run
+    completes — and the figures become SLO-gateable flat keys:
+
+    * ``recovery_oom_downshift_recovery_seconds`` — wall of the faulted
+      (downshifted) solve, the time-to-recover under memory pressure;
+    * ``recovery_oom_degraded_entities_per_sec`` — the degraded-throughput
+      floor the downshifted plan still sustains.
+    """
+    import jax.numpy as jnp
+
+    from photon_tpu.data.random_effect import build_random_effect_dataset
+    from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+    from photon_tpu.functions.problem import GLMOptimizationProblem
+    from photon_tpu.game import train_random_effects
+    from photon_tpu.obs.metrics import REGISTRY
+    from photon_tpu.optim import (
+        OptimizerConfig,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_tpu.runtime import memory_guard as mg
+    from photon_tpu.types import TaskType
+
+    n_entities, rows, k, dim = (64, 8, 4, 64) if SMOKE else (512, 16, 6, 256)
+    rng = np.random.default_rng(7)
+    idx_rows, val_rows, labels, keys = [], [], [], []
+    for e in range(n_entities):
+        support = rng.choice(dim, size=2 * k, replace=False)
+        for _ in range(rows):
+            cols = rng.choice(support, size=k, replace=False)
+            idx_rows.append(cols.astype(np.int64))
+            val_rows.append(rng.normal(size=k))
+            labels.append(float(rng.random() < 0.5))
+            keys.append(f"u{e}")
+    ds = build_random_effect_dataset(
+        "userId", np.asarray(keys, object), np.asarray(idx_rows),
+        np.asarray(val_rows), np.asarray(labels, np.float32),
+        global_dim=dim)
+    problem = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=30),
+        optimizer_type=OptimizerType.LBFGS,
+        regularization=RegularizationContext(RegularizationType.L2),
+        reg_weight=1.0,
+    )
+    offsets = jnp.zeros((ds.n_rows,), jnp.float32)
+    # A ladder with a Newton tier below the bucket size, so the downshift
+    # is a chunk-tier drop (the equivalence-preserving rung), not a
+    # solver-family demotion.
+    prev_ladder = os.environ.get("PHOTON_RE_CHUNK_LADDER")
+    os.environ["PHOTON_RE_CHUNK_LADDER"] = (
+        f"{max(2, n_entities // 4)},{max(4, n_entities // 2)}")
+    mg.reset_state()
+    out = {}
+    from photon_tpu.obs import retrace as _retrace
+
+    try:
+        # The drill's tiny ladder compiles new shapes while the restart
+        # drill's fit may have left the RE kernels marked warm — these
+        # compiles are the drill's own doing, not hot-path retraces.
+        with _retrace.expected_compiles():
+            train_random_effects(problem, ds, offsets)  # warm + settle
+        mg.reset_state()
+        restarts0 = sum(
+            v for _, v in REGISTRY.counter("run_restarts_total").collect())
+        shifts0 = REGISTRY.counter("oom_downshifts_total").value(
+            site="re.solve", cause="oom")
+        plan = FaultPlan(seed=0, specs=[
+            FaultSpec(site="re.solve", error="device_oom", count=1)])
+        t0 = time.perf_counter()
+        with active_plan(plan) as inj, _retrace.expected_compiles():
+            model, _ = train_random_effects(problem, ds, offsets)
+        np.asarray(model.bucket_coefs[0][:1])  # completed-solve sync
+        wall = time.perf_counter() - t0
+        restarts = sum(
+            v for _, v in REGISTRY.counter("run_restarts_total").collect()
+        ) - restarts0
+        out["recovery_oom_downshift_recovery_seconds"] = round(wall, 4)
+        out["recovery_oom_degraded_entities_per_sec"] = round(
+            n_entities / wall, 1)
+        out["recovery_oom_downshifts"] = int(
+            REGISTRY.counter("oom_downshifts_total").value(
+                site="re.solve", cause="oom") - shifts0)
+        out["recovery_oom_supervisor_restarts"] = int(restarts)
+        out["recovery_oom_injected"] = inj.fired("re.solve")
+        if restarts != 0 or out["recovery_oom_downshifts"] != 1:
+            raise RuntimeError(
+                "OOM drill contract broken: expected 1 downshift and 0 "
+                f"supervisor restarts, got {out['recovery_oom_downshifts']}"
+                f" downshift(s) and {restarts} restart(s)")
+    finally:
+        if prev_ladder is None:
+            os.environ.pop("PHOTON_RE_CHUNK_LADDER", None)
+        else:
+            os.environ["PHOTON_RE_CHUNK_LADDER"] = prev_ladder
+        mg.reset_state()
+    return out
+
+
 def bench_recovery():
     """Zero-recompile recovery figures (docs/robustness.md §"Recovery
     time"), both SLO-gateable:
@@ -1439,6 +1542,9 @@ def bench_recovery():
         if prev_store is not None and os.path.isdir(prev_store.root):
             cstore.configure(prev_store.root)
 
+    # ---- OOM degradation-ladder drill (docs/robustness.md §memory) ----
+    out.update(_recovery_oom_drill())
+
     out["recovery"] = {
         "backend": _live_backend(),
         "restart_to_first_step_seconds": out.get(
@@ -1448,6 +1554,10 @@ def bench_recovery():
         "warm_xla_share": out.get("recovery_warm_xla_share"),
         "swap_retraces_after_warmup": out.get(
             "recovery_swap_retraces_after_warmup"),
+        "oom_downshift_recovery_seconds": out.get(
+            "recovery_oom_downshift_recovery_seconds"),
+        "oom_degraded_entities_per_sec": out.get(
+            "recovery_oom_degraded_entities_per_sec"),
     }
     return out
 

@@ -51,7 +51,7 @@ echo "order: ${SHUFFLED}" >> ci_random_order.txt
 # shellcheck disable=SC2086
 python -m pytest ${SHUFFLED} -q -p no:cacheprovider
 
-echo "== recovery smoke (fail-fast backend probe + zero-recompile warm restart) =="
+echo "== recovery smoke (fail-fast probe + warm restart + OOM downshift) =="
 # Backend-failure resilience without a chip: an injected init HANG dies at
 # the PHOTON_BACKEND_INIT_TIMEOUT_S deadline (seconds, not the ~1500s the
 # operational record shows), injected UNAVAILABLE/OOM inits classify, the
@@ -62,7 +62,10 @@ echo "== recovery smoke (fail-fast backend probe + zero-recompile warm restart) 
 # XLA share sits BELOW its I/O share — $PHOTON_XLA_CACHE_DIR is the
 # persistent artifact layer (a fresh dir per CI run, scoped to this stage
 # so later stages keep their own cache defaults) so the drill exercises a
-# real warm restart, never a silent cold one.
+# real warm restart, never a silent cold one. The OOM drill then asserts
+# the memory-pressure contract (docs/robustness.md §"Memory pressure"):
+# one injected device_oom -> exactly one oom_downshift journal row, ZERO
+# supervisor restarts, the run completes within 1e-12 of uninterrupted.
 PHOTON_XLA_CACHE_DIR="${PHOTON_XLA_CACHE_DIR:-$(mktemp -d /tmp/photon-ci-xla.XXXXXX)}" \
   python scripts/recovery_smoke.py
 

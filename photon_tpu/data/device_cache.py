@@ -38,7 +38,8 @@ import numpy as np
 from photon_tpu.obs import trace_span
 from photon_tpu.obs.metrics import REGISTRY
 
-__all__ = ["DeviceSweepCache", "default_budget_bytes", "release_all_caches"]
+__all__ = ["DeviceSweepCache", "default_budget_bytes", "release_all_caches",
+           "shed_pins"]
 
 # Live-instance registry (weak: the cache's own lifetime is unchanged) so
 # device-loss recovery (runtime/backend_guard.recover_from_device_loss)
@@ -56,6 +57,21 @@ def release_all_caches() -> int:
     for c in caches:
         c.release()
     return len(caches)
+
+
+def shed_pins(max_bytes: int) -> int:
+    """Spill up to ``max_bytes`` of pinned chunk entries across every live
+    cache (oldest pins first) — the device-memory watchdog's pressure
+    valve (``runtime/memory_guard.MemoryGuard.check``). Returns the bytes
+    freed. Spilled entries re-stream on their next use, exactly the
+    budget-spill behavior, so this trades throughput for headroom, never
+    correctness."""
+    freed = 0
+    for c in list(_LIVE_CACHES):
+        if freed >= max_bytes:
+            break
+        freed += c.shed(max_bytes - freed)
+    return freed
 
 _CACHE_BYTES = REGISTRY.gauge(
     "sweep_cache_bytes",
@@ -106,10 +122,23 @@ class DeviceSweepCache:
     """
 
     def __init__(self, budget_bytes: Optional[int] = None):
-        self.budget_bytes = (
+        requested = (
             default_budget_bytes() if budget_bytes is None
             else max(0, int(budget_bytes))
         )
+        if requested:
+            # Live-device clamp + run-wide degradation scale
+            # (runtime/memory_guard): the static 2048 MB default can
+            # exceed the whole device on small parts, and an
+            # OOM-pre-degraded restart must not re-pin the budget that
+            # just killed the attempt. Backends with no memory stats
+            # (CPU) keep the requested budget.
+            from photon_tpu.runtime.memory_guard import (
+                effective_sweep_budget,
+            )
+
+            requested = effective_sweep_budget(requested)
+        self.budget_bytes = requested
         # key -> (device pytree, nbytes, retained-host-referent). The
         # referent is whatever object the KEY was derived from (an id());
         # retaining it pins the id, so a freed-and-recycled address can
@@ -158,11 +187,17 @@ class DeviceSweepCache:
         key was derived from (see ``_entries``)."""
         with self._lock:
             hit = self._entries.get(key)
+            # Once spilled, a key stays spilled (and its bytes stay counted
+            # once): budget pressure or a watchdog shed proved it doesn't
+            # fit, and re-pinning it later would both flap the residency
+            # and double-count the spill accounting.
+            spilled = key in self._spilled_keys
         if hit is not None:
             _CACHE_HITS.inc()
             return hit[0]
         _CACHE_MISSES.inc()
-        fits = self.enabled and self._bytes + nbytes <= self.budget_bytes
+        fits = (self.enabled and not spilled
+                and self._bytes + nbytes <= self.budget_bytes)
         with trace_span("ingest.device_put", cat="ingest",
                         bytes=int(nbytes), cached=bool(fits)):
             built = build()
@@ -198,6 +233,38 @@ class DeviceSweepCache:
             _CACHE_ENTRIES.inc(-1)
         if spilled is not None:
             _CACHE_SPILLED.inc(-spilled[1])
+
+    def shed(self, max_bytes: int) -> int:
+        """Spill up to ``max_bytes`` of pinned CHUNK entries, oldest pin
+        first, marking them spilled (sticky: they re-stream every later
+        pass instead of re-pinning — memory pressure proved they don't
+        fit). Dataset mirrors are exempt: their device arrays must stay
+        the same object for the cache's lifetime (identity contract,
+        module doc), so converting one back to streaming mid-run is not an
+        option. Returns the bytes freed."""
+        if max_bytes <= 0:
+            return 0
+        freed = entries = newly_spilled = 0
+        with self._lock:
+            for key in list(self._entries):
+                if freed >= max_bytes:
+                    break
+                if key in self._mirrors:
+                    continue
+                _built, nbytes, retain = self._entries.pop(key)
+                self._bytes -= nbytes
+                freed += nbytes
+                entries += 1
+                if key not in self._spilled_keys:
+                    self._spilled_keys[key] = (retain, nbytes)
+                    self._spilled += nbytes
+                    newly_spilled += nbytes
+        if freed:
+            _CACHE_BYTES.inc(-freed)
+            _CACHE_ENTRIES.inc(-entries)
+        if newly_spilled:
+            _CACHE_SPILLED.inc(newly_spilled)
+        return freed
 
     def release(self) -> None:
         """Drop every pinned entry (device memory frees once consumers drop

@@ -11,6 +11,7 @@ degradation).
 from photon_tpu.faults.chaos import bit_flip, torn_write
 from photon_tpu.faults.plan import (
     DeviceLostError,
+    DeviceOomError,
     FaultInjector,
     FaultPlan,
     FaultSpec,
@@ -24,6 +25,7 @@ from photon_tpu.faults.plan import (
 
 __all__ = [
     "DeviceLostError",
+    "DeviceOomError",
     "FaultInjector",
     "FaultPlan",
     "FaultSpec",

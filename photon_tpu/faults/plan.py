@@ -34,6 +34,15 @@ site                        where / what a fired fault simulates
 ``optim.ooc_iteration``     top of each out-of-core optimizer iteration
                             (same in-run device-loss recovery, resuming
                             from the solver's own .npz checkpoint)
+``optim.ooc_chunk``         per streamed ELL chunk on an out-of-core pass
+                            (``error="device_oom"`` here drives the OOM
+                            degradation ladder: the solver halves
+                            ``chunk_rows`` and re-enters —
+                            ``runtime/memory_guard``)
+``re.solve``                random-effect bucket-solver dispatch
+                            (``game/random_effect.py``;
+                            ``error="device_oom"`` drives the chunk-tier
+                            downshift ladder instead of a restart)
 ``heartbeat.beat``          heartbeat file write (stale-heartbeat peers)
 ``serving.store_lookup``    coefficient-store point lookup (latency
                             spikes via ``delay_s``, errors via ``error``)
@@ -41,7 +50,9 @@ site                        where / what a fired fault simulates
                             (unexpected worker death)
 ``serving.kernel``          scoring-kernel invocation on the batcher
                             worker (``error="device_lost"`` exercises the
-                            scorer's breaker-gated re-init + retry)
+                            scorer's breaker-gated re-init + retry;
+                            ``error="device_oom"`` the bounded max-batch
+                            downshift)
 ``online.refresh``          top of each online refresh cycle's solve
                             (``online/trainer.py``; ``error="device_lost"``
                             drives the in-run recovery: cache clear +
@@ -74,6 +85,7 @@ from typing import Callable, Optional, Sequence
 __all__ = [
     "PreemptionError",
     "DeviceLostError",
+    "DeviceOomError",
     "FaultSpec",
     "FaultPlan",
     "FaultInjector",
@@ -106,6 +118,18 @@ class DeviceLostError(RuntimeError):
     losses escalate to the supervisor restart."""
 
 
+class DeviceOomError(RuntimeError):
+    """A device out-of-memory failure surfaced mid-computation.
+
+    Subclasses ``RuntimeError`` like jaxlib's XlaRuntimeError (whose real
+    OOM text is ``RESOURCE_EXHAUSTED``), so the supervisor's retryable set
+    admits it — but it classifies ``oom`` by TYPE
+    (``runtime/backend_guard.classify_backend_error``), which routes it to
+    the DEGRADATION LADDER, not a same-shapes retry: the failing site
+    downshifts to a cheaper plan (``runtime/memory_guard``) because
+    re-running the identical allocation deterministically re-OOMs."""
+
+
 # JSON-able error names -> exception types raised by a firing spec.
 _ERROR_TYPES = {
     "os": OSError,
@@ -114,6 +138,7 @@ _ERROR_TYPES = {
     "connection": ConnectionError,
     "preemption": PreemptionError,
     "device_lost": DeviceLostError,
+    "device_oom": DeviceOomError,
     "memory": MemoryError,
 }
 

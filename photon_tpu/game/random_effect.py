@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from photon_tpu.data.random_effect import EntityBucket, RandomEffectDataset
+from photon_tpu.faults import fault_point
 from photon_tpu.functions.problem import GLMOptimizationProblem
 from photon_tpu.parallel.mesh import axes_size, batch_sharding
 from photon_tpu.optim.base import OptimizerResult
@@ -281,6 +282,58 @@ def _fit_bucket_jitted(problem, batches, w0, local_mask, local_norm, local_prior
     )(batches, w0, local_mask, local_norm, local_prior)
 
 
+def _plan_desc(solver: str, chunk) -> str:
+    return f"{solver}@{'full' if chunk is None else chunk}"
+
+
+def _oom_next_tier(solver: str, chunk, e: int,
+                   vmapped_chunkable: bool = True):
+    """The next-cheaper (solver, chunk) plan below ``(solver, chunk)`` for
+    an E-entity bucket, or None when the degradation ladder is exhausted.
+    ``chunk`` None means the full-bucket solve (effective chunk = E).
+
+    Order (docs/robustness.md §"Memory pressure"): the SAME solver one
+    blessed chunk tier down — PR 4's chunked==full equivalence keeps the
+    result unchanged — until the smallest tier, then the vmapped fallback
+    (chunked when the bucket outgrows the smallest blessed size), then
+    nothing: an OOM below the cheapest plan is a real capacity wall.
+    ``vmapped_chunkable=False`` (a per-entity normalization context is in
+    play — it is NOT sliced by ``fit_bucket_in_chunks``) restricts the
+    vmapped fallback to the full-bucket dispatch."""
+    from photon_tpu.game.newton_re import chunk_ladder
+
+    ladder = chunk_ladder()
+    eff = e if chunk is None else chunk
+    smaller = [c for c in ladder if c < eff]
+    if solver != "vmapped_lbfgs":
+        if smaller:
+            return solver, max(smaller)
+        if vmapped_chunkable and e > ladder[0]:
+            return "vmapped_lbfgs", ladder[0]
+        return "vmapped_lbfgs", None
+    if smaller and vmapped_chunkable:
+        return "vmapped_lbfgs", max(smaller)
+    return None
+
+
+def _apply_sticky_plan(plan, sticky, e: int, vmapped_chunkable: bool = True):
+    """Clamp a static plan to the run's sticky OOM downshift (the proven-
+    too-big tiers are skipped outright instead of re-OOMing per sweep)."""
+    if not sticky:
+        return plan
+    solver, chunk = plan
+    if sticky.get("solver"):
+        solver = sticky["solver"]
+    cap = sticky.get("chunk")
+    if cap:
+        eff = e if chunk is None else chunk
+        if eff > cap:
+            chunk = cap
+    if solver == "vmapped_lbfgs" and not vmapped_chunkable:
+        chunk = None
+    return solver, chunk
+
+
 def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
                   local_prior, normalization, mesh_active=False):
     """Pick and dispatch one bucket's solver; ``(models, result, info)``.
@@ -407,46 +460,149 @@ def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
             k: round(v, 3) for k, v in compile_by_solver.items()}
         return models, result, info
 
-    if solver_routing.routing_mode() == "measured" and not mesh_active:
-        fits = {"newton_primal": fit_primal, "newton_dual": fit_dual,
-                "vmapped_lbfgs": fit_vmapped}
+    from photon_tpu.runtime import memory_guard as _mg
 
+    fits = {"newton_primal": fit_primal, "newton_dual": fit_dual,
+            "vmapped_lbfgs": fit_vmapped}
+
+    def dispatch(solver, chunk):
+        """One (solver, chunk) plan; ``chunk`` None = full bucket."""
+        fit = fits[solver]
+        if chunk is None:
+            return fit(batches, w0, local_mask, local_prior)
+        return fit_bucket_in_chunks(
+            fit, chunk, batches, w0, local_mask, local_prior)
+
+    def run_ladder(solver, chunk, downshifted=False):
+        """Dispatch with the OOM degradation ladder (docs/robustness.md
+        §"Memory pressure"): an ``oom``-classified failure retries at the
+        next-cheaper plan — one blessed chunk tier down, then the vmapped
+        fallback — bounded per run and STICKY (later buckets/sweeps start
+        at the surviving tier; re-promotion only on a fresh run's cost-
+        table race). Anything else propagates untouched. ``downshifted``
+        starts True when the plan was sticky-clamped on entry: a degraded
+        plan's first compile of a new shape class — possibly after the
+        descent loop marked the kernels warm — is deliberate, not an
+        alarm."""
+        while True:
+            try:
+                # Chaos hook: error="device_oom" here drives this ladder
+                # deterministically on CPU (sibling of descent.device's
+                # device_lost).
+                fault_point("re.solve", solver=solver,
+                            chunk=0 if chunk is None else chunk)
+                if downshifted:
+                    # The cheaper tier may compile a shape first seen
+                    # after the warm mark — deliberate, not an alarm.
+                    with _retrace_mod.expected_compiles():
+                        models, result = dispatch(solver, chunk)
+                else:
+                    models, result = dispatch(solver, chunk)
+                return models, result, solver, chunk
+            except Exception as err:  # noqa: BLE001 - classified below
+                if not _mg.is_oom(err):
+                    raise
+                nxt = _oom_next_tier(solver, chunk, int(w0.shape[0]),
+                                     vmapped_chunkable=local_norm is None)
+                before = _plan_desc(solver, chunk)
+                if nxt is None:
+                    _mg.journal_event(
+                        "oom_exhausted", site="re.solve", cause="oom",
+                        plan=before,
+                        reason=f"no cheaper plan below {before}")
+                    raise
+                if not _mg.downshifter("re.solve").absorb(
+                        err, before=before, after=_plan_desc(*nxt)):
+                    raise
+                solver, chunk = nxt
+                _mg.set_sticky_plan("re.solve", {
+                    "chunk": chunk,
+                    "solver": (solver if solver == "vmapped_lbfgs"
+                               else None),
+                })
+                downshifted = True
+
+    from photon_tpu.obs import retrace as _retrace_mod
+
+    sticky = None if mesh_active else _mg.sticky_plan("re.solve")
+
+    measured_oom = None
+    if (solver_routing.routing_mode() == "measured" and not mesh_active
+            and sticky is None):
         def sync(out):
             np.asarray(out[1].value[:1])  # tiny D2H (repo-standard sync)
 
-        models, result, info = solver_routing.solve_measured(
-            problem, bucket, batches, w0, local_mask, local_prior,
-            normalization, get_u_max(), fits.__getitem__, sync,
-        )
-        return finish(models, result, **info)
+        try:
+            # Same chaos hook as the static ladder: an injected
+            # device_oom here drives the measured-plan demotion below.
+            fault_point("re.solve", routing="measured")
+            models, result, info = solver_routing.solve_measured(
+                problem, bucket, batches, w0, local_mask, local_prior,
+                normalization, get_u_max(), fits.__getitem__, sync,
+            )
+            return finish(models, result, **info)
+        except Exception as err:  # noqa: BLE001 - classified below
+            if not _mg.is_oom(err):
+                raise
+            # The measured plan (or its calibration race) OOM'd. The
+            # downshift tier is computed from the STATIC plan below — the
+            # plan that will actually run next — not guessed from the
+            # (unknown) measured winner, so the absorbed downshift can
+            # never be a no-op or an up-shift.
+            measured_oom = err
 
+    # Static preference ladder (now expressed as a plan): full primal ->
+    # full dual -> chunked primal -> chunked dual -> vmapped. Chunked
+    # tiers and the OOM ladder are skipped under a mesh — the bucket was
+    # padded to the entity-axis size and sharded over it, and chunk
+    # slicing would break that contract.
+    plan = ("vmapped_lbfgs", None)
     if newton_eligible(problem, bucket, normalization):
-        models, result = fit_primal(batches, w0, local_mask, local_prior)
-        return finish(models, result, solver="newton_primal")
-    u_max = get_u_max()
-    if u_max >= 0 and dual_eligible(problem, bucket, normalization, u_max):
-        models, result = fit_dual(batches, w0, local_mask, local_prior)
-        return finish(models, result, solver="newton_dual")
-    # Entity-sub-batched Newton tiers: the budget gate refused the full
-    # bucket, but chunks of a blessed size still fit — solve in chunks
-    # instead of burning full-history L-BFGS iterations on every entity.
-    # Not under a mesh: the bucket was padded to the entity-axis size and
-    # sharded over it, and chunk slicing would break that contract.
-    if not mesh_active:
-        chunk = newton_chunk_size(problem, bucket, normalization)
-        if chunk:
-            models, result = fit_bucket_in_chunks(
-                fit_primal, chunk, batches, w0, local_mask, local_prior)
-            return finish(models, result, solver="newton_primal",
-                          chunk=chunk)
-        chunk = (dual_chunk_size(problem, bucket, normalization, u_max)
-                 if u_max >= 0 else None)
-        if chunk:
-            models, result = fit_bucket_in_chunks(
-                fit_dual, chunk, batches, w0, local_mask, local_prior)
-            return finish(models, result, solver="newton_dual", chunk=chunk)
-    models, result = fit_vmapped(batches, w0, local_mask, local_prior)
-    return finish(models, result, solver="vmapped_lbfgs")
+        plan = ("newton_primal", None)
+    else:
+        u_max = get_u_max()
+        if u_max >= 0 and dual_eligible(problem, bucket, normalization,
+                                        u_max):
+            plan = ("newton_dual", None)
+        elif not mesh_active:
+            chunk = newton_chunk_size(problem, bucket, normalization)
+            if chunk:
+                plan = ("newton_primal", chunk)
+            else:
+                chunk = (dual_chunk_size(problem, bucket, normalization,
+                                         u_max) if u_max >= 0 else None)
+                if chunk:
+                    plan = ("newton_dual", chunk)
+
+    if mesh_active:
+        models, result = dispatch(*plan)
+        return finish(models, result, solver=plan[0], chunk=plan[1])
+
+    clamped = _apply_sticky_plan(plan, sticky, int(w0.shape[0]),
+                                 vmapped_chunkable=local_norm is None)
+    if measured_oom is not None:
+        # Demote one tier below the static plan and make it sticky, so
+        # later buckets skip the measured winner that cannot fit.
+        nxt = _oom_next_tier(*clamped, int(w0.shape[0]),
+                             vmapped_chunkable=local_norm is None)
+        before = f"measured({_plan_desc(*clamped)})"
+        if nxt is None:
+            _mg.journal_event(
+                "oom_exhausted", site="re.solve", cause="oom", plan=before,
+                reason=f"no cheaper plan below {before}")
+            raise measured_oom
+        if not _mg.downshifter("re.solve").absorb(
+                measured_oom, before=before, after=_plan_desc(*nxt)):
+            raise measured_oom
+        clamped = nxt
+        _mg.set_sticky_plan("re.solve", {
+            "chunk": clamped[1],
+            "solver": (clamped[0] if clamped[0] == "vmapped_lbfgs"
+                       else None),
+        })
+    models, result, solver, chunk = run_ladder(
+        *clamped, downshifted=clamped != plan)
+    return finish(models, result, solver=solver, chunk=chunk)
 
 
 def train_random_effects(

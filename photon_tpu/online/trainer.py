@@ -447,8 +447,18 @@ class OnlineTrainer:
         ``PHOTON_DEVICE_LOST_MAX_RECOVERIES`` classified device losses by
         clearing the executable caches and re-running bit-identically
         (windows/priors are immutable until publish, so the retry solves
-        the exact same problem)."""
+        the exact same problem).
+
+        An ``oom``-classified failure takes the DEGRADATION ladder instead
+        (docs/robustness.md §"Memory pressure"): ``refresh_batch`` halves
+        — sticky, the config stays halved for the trainer's lifetime — and
+        the PLAN is trimmed in place to the new cap, so this cycle
+        publishes a smaller delta and the un-trimmed entities simply stay
+        dirty for the next cycle (exactly the existing refresh-batch cap
+        semantics; no state mutates until publish, so nothing tears).
+        Bounded by ``PHOTON_OOM_MAX_DOWNSHIFTS``."""
         from photon_tpu.obs import retrace
+        from photon_tpu.runtime import memory_guard as _mg
         from photon_tpu.runtime.backend_guard import (
             is_device_lost,
             max_inrun_recoveries,
@@ -456,11 +466,12 @@ class OnlineTrainer:
         from photon_tpu.supervisor import clear_executable_caches
 
         recoveries = 0
+        downshifted = False
         while True:
             try:
                 fault_point("online.refresh",
                             entities=sum(len(d) for d in plan.values()))
-                if recoveries:
+                if recoveries or downshifted:
                     with retrace.expected_compiles():
                         out = {cid: self._solve_coordinate(cid, dirty)
                                for cid, dirty in plan.items()}
@@ -472,6 +483,28 @@ class OnlineTrainer:
             except KeyboardInterrupt:
                 raise  # a user abort is never a retryable device loss
             except Exception as e:  # noqa: BLE001 - classified below
+                if _mg.is_oom(e):
+                    cur = self.config.refresh_batch
+                    new = max(1, cur // 2)
+                    if new >= cur:
+                        # No cheaper rung: journal the classified
+                        # exhaustion before escalating (re.solve contract).
+                        _mg.journal_event(
+                            "oom_exhausted", site="online.refresh",
+                            cause="oom", plan=f"refresh_batch={cur}",
+                            reason="refresh_batch already 1")
+                        raise
+                    if not _mg.downshifter("online.refresh").absorb(
+                            e, before=f"refresh_batch={cur}",
+                            after=f"refresh_batch={new}"):
+                        raise  # absorb journaled the spent budget
+                    # Sticky: every later cycle plans at the halved cap.
+                    self.config = dataclasses.replace(
+                        self.config, refresh_batch=new)
+                    for cid in list(plan):
+                        plan[cid] = plan[cid][:new]
+                    downshifted = True
+                    continue
                 if not is_device_lost(e) or \
                         recoveries >= max_inrun_recoveries():
                     raise

@@ -261,6 +261,44 @@ class ChunkedGLMData:
             raise ValueError("no rows streamed")
         return out
 
+    def rechunk(self, factor: int = 2) -> "ChunkedGLMData":
+        """The same dataset re-cut at ``chunk_rows / factor`` — the OOM
+        degradation ladder's out-of-core rung (docs/robustness.md
+        §"Memory pressure"): when a streamed pass OOMs, halving the chunk
+        shape halves the live per-chunk device footprint, and the solve
+        re-enters over smaller chunks with identical (weight-0 ghost-
+        padded) row content. Raises ValueError when no smaller cut exists
+        (``chunk_rows == 1``)."""
+        if factor < 2:
+            raise ValueError(f"rechunk factor must be >= 2, got {factor}")
+        new_rows = -(-self.chunk_rows // factor)  # ceil division
+        if new_rows >= self.chunk_rows:
+            raise ValueError(
+                f"cannot rechunk below chunk_rows={self.chunk_rows}")
+        k = self.chunks[0].idx.shape[1]
+        chunks, lab, off, wgt = [], [], [], []
+        for i, c in enumerate(self.chunks):
+            for lo in range(0, self.chunk_rows, new_rows):
+                hi = min(lo + new_rows, self.chunk_rows)
+                pad = new_rows - (hi - lo)
+                ci = c.idx[lo:hi]
+                cv = c.val[lo:hi]
+                if pad:
+                    ci = np.concatenate(
+                        [ci, np.full((pad, k), self.dim, np.int32)])
+                    cv = np.concatenate(
+                        [cv, np.zeros((pad, k), c.val.dtype)])
+                chunks.append(_HostChunk(idx=ci, val=cv))
+                for src, dst in ((self.labels, lab), (self.offsets, off),
+                                 (self.weights, wgt)):
+                    piece = src[i][lo:hi]
+                    if pad:
+                        piece = jnp.pad(piece, (0, pad))
+                    dst.append(piece)
+        return ChunkedGLMData(
+            chunks=chunks, labels=lab, offsets=off, weights=wgt,
+            dim=self.dim, n_rows=self.n_rows, chunk_rows=new_rows)
+
     @property
     def n_chunks(self) -> int:
         return len(self.chunks)
@@ -514,6 +552,12 @@ class OutOfCoreLBFGS:
         # sharded device_put, which commits directly to the right layout.
         put_dev = put_ell if self.mesh is not None else jnp.asarray
 
+        def feed_one(c):
+            # Chaos hook: error="device_oom" per streamed chunk drives the
+            # halve-chunk_rows degradation ladder in optimize() on CPU.
+            fault_point("optim.ooc_chunk", chunk_rows=data.chunk_rows)
+            return _feed_chunk(c, self.device_cache, put_dev)
+
         def ell_feed():
             """Per-pass (idx, val) device feed, DOUBLE-BUFFERED: chunk i+1's
             transfer is issued before chunk i is handed to its kernel, so an
@@ -521,11 +565,7 @@ class OutOfCoreLBFGS:
             Chunks pinned by the sweep cache skip the transfer entirely."""
             from photon_tpu.io.prefetch import pipelined_puts
 
-            return pipelined_puts(
-                data.chunks,
-                lambda c: _feed_chunk(c, self.device_cache, put_dev),
-                ahead=1,
-            )
+            return pipelined_puts(data.chunks, feed_one, ahead=1)
 
         # Per-chunk compute spans (cat "optim") cover ONLY the kernel call;
         # the feed is pulled from the generator BEFORE the span opens, so
@@ -731,21 +771,66 @@ class OutOfCoreLBFGS:
         the last saved iteration (or restarts the deterministic loop from
         scratch without a checkpoint path) — bit-identical either way.
         Bounded by ``PHOTON_DEVICE_LOST_MAX_RECOVERIES``; past it the
-        error escalates to the supervisor restart."""
+        error escalates to the supervisor restart.
+
+        An ``oom``-classified failure takes the DEGRADATION ladder instead
+        (docs/robustness.md §"Memory pressure"): restarting with identical
+        chunk shapes would deterministically re-OOM, so the solve halves
+        ``chunk_rows`` (``ChunkedGLMData.rechunk``) and re-enters — the
+        per-chunk device footprint halves while the row content (weight-0
+        ghost padding) is unchanged. Bounded by
+        ``PHOTON_OOM_MAX_DOWNSHIFTS``; the downshift is journaled, counted
+        in ``oom_downshifts_total{site="optim.ooc_chunk"}``, and sticky
+        for this solve (the re-cut data IS the new plan). Note the
+        rechunked solve restarts its iteration loop from scratch: the
+        checkpoint tag covers the chunking, so a cross-chunking resume is
+        refused by design."""
         recoveries = 0
         while True:
             try:
                 return self._optimize_impl(data, x0, primed=primed)
             except Exception as e:  # noqa: BLE001 - classified below
-                from photon_tpu.runtime import backend_guard as _bg
+                import logging
 
+                from photon_tpu.runtime import backend_guard as _bg
+                from photon_tpu.runtime import memory_guard as _mg
+
+                log = logging.getLogger("photon_tpu.ooc")
+                if _mg.is_oom(e):
+                    # Rechunking under a mesh must keep chunk_rows evenly
+                    # divisible over the data axis (_mesh_puts contract).
+                    new_rows = -(-data.chunk_rows // 2)
+                    divisible = (self.mesh is None or new_rows
+                                 % self.mesh.shape[self.data_axis] == 0)
+                    if data.chunk_rows <= 1 or not divisible:
+                        # No cheaper cut exists: journal the classified
+                        # exhaustion (same contract as re.solve) so the
+                        # recovery record shows WHY the OOM escalated.
+                        _mg.journal_event(
+                            "oom_exhausted", site="optim.ooc_chunk",
+                            cause="oom",
+                            plan=f"chunk_rows={data.chunk_rows}",
+                            reason=("chunk_rows already 1" if divisible
+                                    else "half-cut not divisible over the "
+                                         "mesh data axis"))
+                        raise
+                    if not _mg.downshifter("optim.ooc_chunk").absorb(
+                            e, before=f"chunk_rows={data.chunk_rows}",
+                            after=f"chunk_rows={new_rows}"):
+                        raise  # absorb journaled the spent budget
+                    if self.device_cache is not None:
+                        # The old cut's pins can never be hit again.
+                        for c in data.chunks:
+                            self.device_cache.discard(
+                                ("ooc_ell", id(c.idx)))
+                    data = data.rechunk(2)
+                    primed = None  # margins were cut for the old shape
+                    continue
                 if (not _bg.is_device_lost(e)
                         or recoveries >= _bg.max_inrun_recoveries()):
                     raise
                 recoveries += 1
-                import logging
-
-                logging.getLogger("photon_tpu.ooc").warning(
+                log.warning(
                     "device lost mid-solve (%s: %s); in-run recovery %d/%d"
                     "%s", type(e).__name__, e, recoveries,
                     _bg.max_inrun_recoveries(),

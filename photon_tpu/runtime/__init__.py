@@ -30,10 +30,20 @@ from photon_tpu.runtime.compile_store import (
     CompileStore,
     compile_split,
 )
+from photon_tpu.runtime.memory_guard import (
+    MemoryGuard,
+    OomDownshifter,
+    is_oom,
+    max_oom_downshifts,
+)
 
 __all__ = [
     "CompileStore",
     "compile_split",
+    "MemoryGuard",
+    "OomDownshifter",
+    "is_oom",
+    "max_oom_downshifts",
     "BACKEND_POLICIES",
     "BackendProbeResult",
     "BackendUnusable",

@@ -145,11 +145,11 @@ def classify_backend_error(err) -> str:
     classifies by what it is, not what it says."""
     text = err if isinstance(err, str) else f"{type(err).__name__}: {err}"
     if not isinstance(err, str):
-        from photon_tpu.faults import DeviceLostError
+        from photon_tpu.faults import DeviceLostError, DeviceOomError
 
         if isinstance(err, DeviceLostError):
             return CAUSE_DEVICE_LOST
-        if isinstance(err, MemoryError):
+        if isinstance(err, (MemoryError, DeviceOomError)):
             return CAUSE_OOM
         if isinstance(err, (OSError, ConnectionError)):
             # A plain I/O error whose MESSAGE happens to say "connection

@@ -1,0 +1,509 @@
+"""Memory-pressure resilience: the OOM degradation ladder + device watchdog.
+
+Why this module exists: PR 8 classified ``oom`` as a first-class failure
+cause (``backend_guard.CAUSE_OOM``) but every recovery path treated it like
+a transient — ``RunSupervisor`` restarted the attempt with IDENTICAL
+shapes, which deterministically re-OOMs until the restart budget is gone.
+Restarts cannot fix resource exhaustion; only a *smaller plan* can.
+Upstream photon-ml never met this wall because Spark spills per-partition
+work to disk (PAPER.md §0 ``treeAggregate``/``mapPartitions``); the
+TPU-native analogue is a degradation ladder that trades throughput for
+survival (docs/robustness.md §"Memory pressure"):
+
+* **Classified-OOM retry-with-downshift** — when a solve raises an
+  ``oom``-classified error, the failing site retries at the next-cheaper
+  plan instead of escalating: RE bucket solves drop one blessed chunk
+  tier (PR 4's chunked==full equivalence keeps the result unchanged),
+  then fall to the vmapped/streamed path; out-of-core solvers halve
+  ``chunk_rows``; the online trainer halves ``refresh_batch``; the
+  serving micro-batcher halves its effective max batch (already a warmed
+  padded shape). Each downshift is bounded per site
+  (``PHOTON_OOM_MAX_DOWNSHIFTS``, default 3), journaled as a
+  ``recovery.oom_downshift`` row/instant with the before→after plan,
+  counted in ``oom_downshifts_total{site,cause}``, and STICKY for the
+  rest of the run (re-promotion only via a fresh run's cost-table race).
+* **Device-memory watchdog** — :class:`MemoryGuard` samples the live jax
+  device memory stats (riding the PR 2 heartbeat), exports the
+  ``device_memory_{bytes_in_use,bytes_limit,watermark}`` gauges,
+  proactively asks ``DeviceSweepCache`` to spill LRU pins above the
+  high-water fraction BEFORE XLA ever OOMs, and clamps the default
+  sweep-cache budget to the live device limit instead of the static MB
+  constant (:func:`effective_sweep_budget`).
+* **Pressure-aware load shedding** — serving admission sheds (503 +
+  Retry-After) once the watermark crosses critical, and ``/healthz``
+  reports ``degraded: ["memory_pressure"]`` while above high water.
+* **Supervisor policy** — an OOM-caused restart is attempted at most
+  once, pre-degraded (:func:`pre_degrade_for_restart` shrinks the
+  sweep-cache budget and caps the RE chunk ladder for the next attempt),
+  and never burns backoff sleep (a deterministic failure does not heal
+  with time — ``supervisor.py``).
+
+Everything degrades honestly: on a backend with no ``memory_stats()``
+(CPU) the watchdog reports unavailable and sheds nothing, while the
+classified-OOM ladder still works — which is what makes the whole ladder
+chaos-testable on CPU via the injected ``device_oom`` fault.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from photon_tpu.obs import instant
+from photon_tpu.obs.metrics import REGISTRY
+
+__all__ = [
+    "MemoryGuard",
+    "OomDownshifter",
+    "downshifter",
+    "effective_sweep_budget",
+    "guard",
+    "is_oom",
+    "journal_event",
+    "max_oom_downshifts",
+    "pre_degrade_for_restart",
+    "reset_state",
+    "set_journal",
+    "set_sticky_plan",
+    "sticky_plan",
+]
+
+logger = logging.getLogger("photon_tpu.memory_guard")
+
+_OOM_DOWNSHIFTS = REGISTRY.counter(
+    "oom_downshifts_total",
+    "OOM-classified failures absorbed by downshifting to a cheaper plan, "
+    "by site (docs/robustness.md §memory pressure)",
+)
+_PRESSURE_SPILLS = REGISTRY.counter(
+    "memory_pressure_spills_total",
+    "proactive sweep-cache spills triggered by the device-memory watchdog",
+)
+_PRESSURE_SHEDS = REGISTRY.counter(
+    "memory_pressure_sheds_total",
+    "serving requests shed because the device-memory watermark crossed "
+    "critical",
+)
+_MEM_IN_USE = REGISTRY.gauge(
+    "device_memory_bytes_in_use",
+    "live device bytes in use (max across local devices with stats)",
+)
+_MEM_LIMIT = REGISTRY.gauge(
+    "device_memory_bytes_limit",
+    "device memory capacity (bytes_limit of the most-loaded local device)",
+)
+_MEM_WATERMARK = REGISTRY.gauge(
+    "device_memory_watermark",
+    "bytes_in_use / bytes_limit of the most-loaded local device (0 when "
+    "the backend exposes no memory stats)",
+)
+
+
+def max_oom_downshifts(default: int = 3) -> int:
+    """Per-site bound on OOM downshifts (``PHOTON_OOM_MAX_DOWNSHIFTS``);
+    past it the original error escalates (journaled exhaustion)."""
+    try:
+        return max(0, int(os.environ.get(
+            "PHOTON_OOM_MAX_DOWNSHIFTS", default)))
+    except (TypeError, ValueError):
+        return int(default)
+
+
+def is_oom(err) -> bool:
+    """Is this failure the one cause the downshift ladder may absorb?"""
+    from photon_tpu.runtime.backend_guard import (
+        CAUSE_OOM,
+        classify_backend_error,
+    )
+
+    return classify_backend_error(err) == CAUSE_OOM
+
+
+# ------------------------------------------------------------ journal hook
+#
+# Downshifts happen deep inside solves, far from the RunSupervisor that
+# owns the recovery journal. The supervisor (and the drivers) register
+# their journal here for the duration of a run, so in-run OOM events land
+# as real journal rows next to the restart story; without one, the trace
+# instant alone is the record (same contract as the device-loss recovery).
+
+_journal_lock = threading.Lock()
+_JOURNAL = None
+
+
+def set_journal(journal):
+    """Register the active :class:`~photon_tpu.supervisor.RecoveryJournal`
+    (or None to detach). Downshift/exhaustion/pre-degrade events then
+    write journal rows; the ``recovery.*`` trace instant is emitted either
+    way. Returns the PREVIOUSLY registered journal so a scoped caller
+    (the supervisor) can restore it instead of detaching an outer one."""
+    global _JOURNAL
+    with _journal_lock:
+        prev = _JOURNAL
+        _JOURNAL = journal
+        return prev
+
+
+def journal_event(event: str, **fields) -> None:
+    """One recovery event: a journal row when a journal is registered
+    (``RecoveryJournal.record`` mirrors the trace instant), else the
+    ``recovery.<event>`` instant alone."""
+    with _journal_lock:
+        j = _JOURNAL
+    if j is not None:
+        try:
+            j.record(event, **fields)
+            return
+        except Exception:  # noqa: BLE001 - evidence, never a failure mode
+            pass
+    instant(f"recovery.{event}", cat="recovery", **fields)
+
+
+# ------------------------------------------------------------ sticky plans
+#
+# A downshift is sticky for the rest of the run: the OOM proved the bigger
+# plan does not fit, and flapping back up would re-OOM on the next sweep.
+# Sites record their surviving plan here; re-promotion happens only on a
+# fresh run (the PR 4 cost-table race, or a new process).
+
+_sticky_lock = threading.Lock()
+_STICKY: dict = {}
+
+
+def sticky_plan(site: str) -> Optional[dict]:
+    """The sticky degraded plan for ``site`` (e.g. ``{"chunk": 1024}`` for
+    ``re.solve``), or None when the site runs at full plan."""
+    with _sticky_lock:
+        p = _STICKY.get(site)
+        return dict(p) if p is not None else None
+
+
+def set_sticky_plan(site: str, plan: dict) -> None:
+    with _sticky_lock:
+        _STICKY[site] = dict(plan)
+
+
+class OomDownshifter:
+    """Bounded absorber of OOM-classified failures at one site.
+
+    ``absorb(err, before=..., after=...)`` returns True when the caller
+    may retry at the cheaper plan (the downshift is journaled + counted);
+    False once the per-site bound is spent (the exhaustion is journaled
+    and the caller must re-raise — a classified escalation, not a loop).
+    Thread-safe: serving worker threads share one per-site instance.
+    """
+
+    def __init__(self, site: str):
+        self.site = site
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def absorb(self, err, before=None, after=None, **ctx) -> bool:
+        from photon_tpu.runtime.backend_guard import classify_backend_error
+
+        cause = classify_backend_error(err)
+        with self._lock:
+            if self.count >= max_oom_downshifts():
+                journal_event(
+                    "oom_exhausted", site=self.site, cause=cause,
+                    downshifts=self.count,
+                    error=f"{type(err).__name__}: {str(err)[:200]}", **ctx)
+                logger.error(
+                    "OOM at %s with the downshift budget spent (%d/%d) — "
+                    "escalating: %s", self.site, self.count,
+                    max_oom_downshifts(), err)
+                return False
+            self.count += 1
+            n = self.count
+        _OOM_DOWNSHIFTS.inc(site=self.site, cause=cause)
+        journal_event(
+            "oom_downshift", site=self.site, cause=cause, downshift=n,
+            before=before, after=after,
+            error=f"{type(err).__name__}: {str(err)[:200]}", **ctx)
+        logger.warning(
+            "OOM at %s (%s: %s) — downshifting %s -> %s (%d/%d; sticky for "
+            "this run)", self.site, type(err).__name__, err, before, after,
+            n, max_oom_downshifts())
+        return True
+
+
+_shifter_lock = threading.Lock()
+_SHIFTERS: dict = {}
+
+
+def downshifter(site: str) -> OomDownshifter:
+    """The process-global downshifter for ``site`` (bound shared across
+    every solve at that site — the budget is per run, not per bucket)."""
+    with _shifter_lock:
+        s = _SHIFTERS.get(site)
+        if s is None:
+            s = _SHIFTERS[site] = OomDownshifter(site)
+        return s
+
+
+# --------------------------------------------------------- memory watchdog
+
+
+def _default_stats() -> Optional[dict]:
+    """``{bytes_in_use, bytes_limit, watermark}`` of the MOST-LOADED local
+    device, or None when no device exposes memory stats (CPU)."""
+    try:
+        import jax
+
+        worst = None
+        for d in jax.local_devices():
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            in_use = float(stats.get("bytes_in_use", 0.0))
+            limit = float(stats.get("bytes_limit", 0.0))
+            if limit <= 0:
+                continue
+            frac = in_use / limit
+            if worst is None or frac > worst["watermark"]:
+                worst = {"bytes_in_use": in_use, "bytes_limit": limit,
+                         "watermark": frac}
+        return worst
+    except Exception:  # noqa: BLE001 - a sick backend must not break callers
+        return None
+
+
+def _env_fraction(name: str, default: float) -> float:
+    try:
+        v = float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+    return v if 0.0 < v <= 1.0 else default
+
+
+class MemoryGuard:
+    """Device-memory watchdog: sample, export, spill, shed.
+
+    One instance per process (:func:`guard`). ``stats_fn`` is the test/
+    chaos seam — drills substitute a fake returning any watermark, so the
+    spill and shed paths run for real on CPU. Samples are throttled
+    (``min_sample_interval_s``) so the serving admission path can consult
+    :meth:`should_shed` per request without a per-request device call.
+
+    Thresholds (fractions of ``bytes_limit``):
+
+    * ``high_water`` (``PHOTON_MEM_HIGH_WATER``, default 0.85) — above it
+      :meth:`check` proactively spills sweep-cache pins and ``/healthz``
+      reports ``memory_pressure``;
+    * ``critical`` (``PHOTON_MEM_CRITICAL``, default 0.95) — above it
+      serving admission sheds (503 + Retry-After).
+    """
+
+    def __init__(
+        self,
+        high_water: Optional[float] = None,
+        critical: Optional[float] = None,
+        stats_fn: Optional[Callable[[], Optional[dict]]] = None,
+        min_sample_interval_s: float = 0.5,
+    ):
+        self.high_water = (
+            _env_fraction("PHOTON_MEM_HIGH_WATER", 0.85)
+            if high_water is None else float(high_water))
+        self.critical = (
+            _env_fraction("PHOTON_MEM_CRITICAL", 0.95)
+            if critical is None else float(critical))
+        self.stats_fn = stats_fn if stats_fn is not None else _default_stats
+        self.min_sample_interval_s = float(min_sample_interval_s)
+        self._lock = threading.Lock()
+        self._last_sample: Optional[dict] = None
+        self._last_sample_t = float("-inf")
+        self._spills = 0
+
+    def sample(self, force: bool = False) -> Optional[dict]:
+        """Latest ``{bytes_in_use, bytes_limit, watermark}`` (throttled;
+        ``force`` bypasses the throttle), or None when the backend exposes
+        no memory stats. Sets the ``device_memory_*`` gauges."""
+        now = time.monotonic()
+        with self._lock:
+            if (not force
+                    and now - self._last_sample_t
+                    < self.min_sample_interval_s):
+                return self._last_sample
+        s = self.stats_fn()
+        with self._lock:
+            self._last_sample = s
+            self._last_sample_t = now
+        if s is not None:
+            _MEM_IN_USE.set(s["bytes_in_use"])
+            _MEM_LIMIT.set(s["bytes_limit"])
+            _MEM_WATERMARK.set(round(s["watermark"], 4))
+        else:
+            _MEM_WATERMARK.set(0.0)
+        return s
+
+    def watermark(self) -> Optional[float]:
+        s = self.sample()
+        return None if s is None else s["watermark"]
+
+    def under_pressure(self) -> bool:
+        """Watermark at or above high water (the /healthz degraded gate)."""
+        w = self.watermark()
+        return w is not None and w >= self.high_water
+
+    def should_shed(self) -> bool:
+        """Watermark at or above critical (the admission-control gate);
+        counts the shed decision so the drill is metric-visible."""
+        w = self.watermark()
+        if w is None or w < self.critical:
+            return False
+        _PRESSURE_SHEDS.inc()
+        return True
+
+    def check(self) -> dict:
+        """One watchdog pass (rides the heartbeat loop): fresh sample +
+        proactive sweep-cache spill when above high water. Returns
+        ``{available, watermark, spilled_bytes}``."""
+        s = self.sample(force=True)
+        if s is None:
+            return {"available": False, "watermark": None,
+                    "spilled_bytes": 0}
+        freed = 0
+        if s["watermark"] >= self.high_water:
+            # Free enough pinned bytes to get back under the high-water
+            # line. The sweep cache is the one device consumer whose
+            # contents are EXPENDABLE by contract (a spilled entry
+            # re-streams next pass — a throughput regression, never a
+            # wrong answer), so it is the pressure valve.
+            target = int(s["bytes_in_use"]
+                         - self.high_water * s["bytes_limit"])
+            from photon_tpu.data.device_cache import shed_pins
+
+            freed = shed_pins(max(0, target))
+            if freed:
+                self._spills += 1
+                _PRESSURE_SPILLS.inc()
+                instant("memory.pressure_spill", cat="recovery",
+                        watermark=round(s["watermark"], 4),
+                        freed_bytes=int(freed))
+                logger.warning(
+                    "device memory watermark %.2f >= high water %.2f — "
+                    "spilled %d sweep-cache bytes (next pass re-streams "
+                    "them)", s["watermark"], self.high_water, freed)
+        return {"available": True,
+                "watermark": round(s["watermark"], 4),
+                "spilled_bytes": int(freed)}
+
+    def snapshot(self) -> dict:
+        s = self._last_sample
+        return {
+            "high_water": self.high_water,
+            "critical": self.critical,
+            "watermark": None if s is None else round(s["watermark"], 4),
+            "spills": self._spills,
+        }
+
+
+_guard_lock = threading.Lock()
+_GUARD: Optional[MemoryGuard] = None
+
+
+def guard() -> MemoryGuard:
+    """The process-global :class:`MemoryGuard` (created on first use)."""
+    global _GUARD
+    with _guard_lock:
+        if _GUARD is None:
+            _GUARD = MemoryGuard()
+        return _GUARD
+
+
+# ----------------------------------------------- sweep-cache budget policy
+
+_budget_lock = threading.Lock()
+_BUDGET_SCALE = 1.0
+_clamp_warned = False
+
+
+def sweep_budget_scale() -> float:
+    """Run-wide degradation multiplier on sweep-cache budgets (halved by
+    each :func:`pre_degrade_for_restart`)."""
+    with _budget_lock:
+        return _BUDGET_SCALE
+
+
+def effective_sweep_budget(requested_bytes: int) -> int:
+    """The budget a ``DeviceSweepCache`` actually gets:
+
+    * scaled by the run's degradation multiplier (an OOM-pre-degraded
+      restart must not re-pin the budget that just killed the attempt);
+    * clamped to ``PHOTON_SWEEP_CACHE_DEVICE_FRACTION`` (default 0.5) of
+      the LIVE device ``bytes_limit`` when the backend reports one — the
+      static 2048 MB default can exceed the whole device on small parts,
+      and a budget the device cannot hold is an OOM schedule, not a
+      cache. One-time warning when the clamp fires; backends with no
+      memory stats (CPU) keep the requested budget.
+    """
+    global _clamp_warned
+    b = int(requested_bytes * sweep_budget_scale())
+    if b <= 0:
+        return 0
+    s = guard().sample()
+    if s is None or s["bytes_limit"] <= 0:
+        return b
+    frac = _env_fraction("PHOTON_SWEEP_CACHE_DEVICE_FRACTION", 0.5)
+    cap = int(s["bytes_limit"] * frac)
+    if b > cap:
+        with _budget_lock:
+            warn = not _clamp_warned
+            _clamp_warned = True
+        if warn:
+            logger.warning(
+                "sweep-cache budget %d bytes exceeds %.0f%% of the live "
+                "device limit (%d bytes) — clamping to %d. Set "
+                "PHOTON_SWEEP_CACHE_MB (or PHOTON_SWEEP_CACHE_DEVICE_"
+                "FRACTION) to size the cache to this part.",
+                b, 100.0 * frac, int(s["bytes_limit"]), cap)
+        return cap
+    return b
+
+
+def pre_degrade_for_restart(reason: str = "supervised OOM restart") -> dict:
+    """Shrink the NEXT attempt's memory plan after an OOM-caused attempt
+    failure (the supervisor's one pre-degraded restart): halve the
+    sweep-cache budget scale and cap the RE chunk ladder one blessed tier
+    below its current cap. Journaled so the degraded plan the next attempt
+    runs under is in the recovery record. Returns the plan."""
+    global _BUDGET_SCALE
+    with _budget_lock:
+        _BUDGET_SCALE *= 0.5
+        scale = _BUDGET_SCALE
+    from photon_tpu.game.newton_re import chunk_ladder
+
+    ladder = chunk_ladder()
+    cur = sticky_plan("re.solve") or {}
+    eff = cur.get("chunk") or ladder[-1] + 1
+    smaller = [c for c in ladder if c < eff]
+    new_chunk = max(smaller) if smaller else ladder[0]
+    set_sticky_plan("re.solve", {**cur, "chunk": new_chunk})
+    plan = {
+        "sweep_cache_budget_scale": scale,
+        "re_chunk_cap": new_chunk,
+        "reason": reason,
+    }
+    journal_event("oom_predegrade", **plan)
+    logger.warning(
+        "pre-degrading the next attempt after OOM: sweep-cache budget "
+        "scale %.3f, RE chunk cap %d (%s)", scale, new_chunk, reason)
+    return plan
+
+
+def reset_state() -> None:
+    """Test hook: forget sticky plans, downshift counts, budget scale,
+    the journal hook, and the guard singleton."""
+    global _GUARD, _BUDGET_SCALE, _clamp_warned
+    with _sticky_lock:
+        _STICKY.clear()
+    with _shifter_lock:
+        _SHIFTERS.clear()
+    with _budget_lock:
+        _BUDGET_SCALE = 1.0
+        _clamp_warned = False
+    with _guard_lock:
+        _GUARD = None
+    set_journal(None)

@@ -133,6 +133,22 @@ class RowScorer:
             )
             if kernel_failures > 0 else None
         )
+        # Effective micro-batch cap under the OOM degradation ladder
+        # (docs/robustness.md §"Memory pressure"): an oom-classified
+        # kernel failure halves it to the next-smaller WARMED bucket shape
+        # (the power-of-two ladder warmup() compiles), sticky for the
+        # RUN — the cap is seeded from the process-wide sticky plan, so a
+        # registry hot-swap's fresh scorer starts at the proven-fitting
+        # cap instead of re-OOMing its way back down (and re-burning the
+        # shared downshift budget). The stable-shape no-recompile contract
+        # is preserved: every downshifted shape is on the warmup ladder.
+        cap = int(config.max_batch)
+        from photon_tpu.runtime.memory_guard import sticky_plan
+
+        sticky = sticky_plan("serving.kernel")
+        if sticky and sticky.get("max_batch"):
+            cap = max(1, min(cap, int(sticky["max_batch"])))
+        self._max_batch_cap = cap
         self._warming = False
 
     # -------------------------------------------------------------- parsing
@@ -212,7 +228,7 @@ class RowScorer:
     # -------------------------------------------------------------- scoring
 
     def _bucket(self, n: int) -> int:
-        return min(_next_pow2(n), self.config.max_batch)
+        return min(_next_pow2(n), self._max_batch_cap)
 
     def score_rows(self, rows: Sequence[ParsedRow]) -> np.ndarray:
         """Scores for up to ``max_batch`` rows as ONE padded kernel call;
@@ -256,19 +272,84 @@ class RowScorer:
         """``(scores, flags)``: ``flags[i]`` is the tuple of RE coordinate
         ids whose contribution row ``i`` LOST to an open coefficient-store
         circuit breaker (fixed-effect-only degradation, docs/robustness.md);
-        empty for fully-scored rows."""
+        empty for fully-scored rows.
+
+        An ``oom``-classified kernel failure is absorbed by the bounded
+        max-batch downshift (``_absorb_kernel_oom``): only the failed
+        chunk onward re-scores at the smaller cap (already-completed
+        chunks and their store resolves are kept — no extra device work
+        under exactly the pressure that caused the OOM) — the waiters see
+        a slower answer, never a 500, until the downshift budget (or the
+        kernel breaker) says the device is truly out of room."""
         out, flags = [], []
-        cap = self.config.max_batch
-        for lo in range(0, len(rows), cap):
-            s, f = self._score_chunk(rows[lo: lo + cap])
+        lo = 0
+        downshifted = False
+        while lo < len(rows):
+            chunk = rows[lo: lo + self._max_batch_cap]
+            try:
+                if downshifted:
+                    with retrace.expected_compiles():
+                        s, f = self._score_chunk(chunk)
+                else:
+                    s, f = self._score_chunk(chunk)
+            except Exception as e:  # noqa: BLE001 - classified below
+                if not self._absorb_kernel_oom(e):
+                    raise
+                downshifted = True
+                continue  # retry THIS chunk's rows at the smaller cap
             out.append(s)
             flags.extend(f)
+            lo += len(chunk)
+            # Only the retried chunk's dispatch is "expected": the shapes
+            # at the smaller cap are warmed, so later chunks must keep
+            # the retrace sentinel armed.
+            downshifted = False
         if rows:
             self._note_swap_first_score()
         return (
             np.concatenate(out) if out else np.zeros(0, np.float32),
             flags,
         )
+
+    def _absorb_kernel_oom(self, err) -> bool:
+        """May the scoring path retry ``err`` at a halved micro-batch?
+
+        The kernel CircuitBreaker treats repeated OOM like device errors —
+        every OOM records a failure, and an OPEN breaker short-circuits
+        the downshift into fast failures — but the ladder runs FIRST:
+        halving to the next-smaller warmed power-of-two shape (floor 1
+        row) usually fits, and shedding throughput beats shedding
+        requests. Bounded by ``PHOTON_OOM_MAX_DOWNSHIFTS``; each
+        downshift is journaled + counted (``runtime/memory_guard``) and
+        sticky for this scorer."""
+        from photon_tpu.runtime import memory_guard as _mg
+
+        if not _mg.is_oom(err):
+            return False
+        if self.kernel_breaker is not None:
+            self.kernel_breaker.record_failure()
+            if not self.kernel_breaker.allow():
+                _mg.journal_event(
+                    "oom_exhausted", site="serving.kernel", cause="oom",
+                    plan=f"max_batch={self._max_batch_cap}",
+                    reason="kernel breaker open")
+                return False
+        cap = self._max_batch_cap
+        half = cap // 2
+        if half < 1:
+            _mg.journal_event(
+                "oom_exhausted", site="serving.kernel", cause="oom",
+                plan="max_batch=1", reason="no smaller batch shape")
+            return False
+        new_cap = 1 << (half.bit_length() - 1)  # largest warmed pow2 <= half
+        if not _mg.downshifter("serving.kernel").absorb(
+                err, before=f"max_batch={cap}",
+                after=f"max_batch={new_cap}"):
+            return False
+        self._max_batch_cap = new_cap
+        # Process-sticky: the next hot-swap's scorer starts here too.
+        _mg.set_sticky_plan("serving.kernel", {"max_batch": new_cap})
+        return True
 
     def _score_chunk(
         self, rows: Sequence[ParsedRow]
@@ -407,10 +488,13 @@ class RowScorer:
             entity_keys={cid: None for cid, _ in self.re_parts},
         )
         sizes, b = [], 1
-        while b < self.config.max_batch:
+        # Ladder tops out at the EFFECTIVE cap: under a sticky OOM
+        # downshift the shapes above it are unreachable (_bucket clamps),
+        # and warming them would dispatch more rows than the cap admits.
+        while b < self._max_batch_cap:
             sizes.append(b)
             b <<= 1
-        sizes.append(self.config.max_batch)  # reachable even when not pow2
+        sizes.append(self._max_batch_cap)  # reachable even when not pow2
         # A NEW version's warmup legitimately compiles new shapes (hot swap
         # to different max_batch/nnz). Suppress the sentinel for THIS
         # thread only: the old version keeps serving during a swap, and a

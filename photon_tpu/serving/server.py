@@ -265,6 +265,17 @@ class ScoringServer:
                 try:
                     with trace_span("serve.admission", cat="serving"):
                         payload = self._read_json()
+                        # Pressure-aware load shedding (docs/robustness.md
+                        # §"Memory pressure"): past the critical device-
+                        # memory watermark, admitting more rows only
+                        # manufactures the next OOM — shed with the same
+                        # 503 + Retry-After contract as a full queue. The
+                        # body is read FIRST (an unread body would desync
+                        # the kept-alive connection).
+                        if server.shed_for_memory_pressure():
+                            raise Overloaded(
+                                "device memory watermark over critical; "
+                                "shedding until pressure drains")
                         version = server.registry.current
                         row = version.scorer.parse_request(payload)
                         deadline = (
@@ -479,6 +490,16 @@ class ScoringServer:
         except Exception:  # noqa: BLE001 - harness fakes lack a registry
             return {}
 
+    def memory_snapshot(self) -> dict:
+        """Device-memory watchdog state (thresholds + last watermark) for
+        the metrics snapshot (docs/robustness.md §"Memory pressure")."""
+        try:
+            from photon_tpu.runtime.memory_guard import guard
+
+            return guard().snapshot()
+        except Exception:  # noqa: BLE001 - metrics must answer regardless
+            return {}
+
     def recovery_snapshot(self) -> dict:
         """Recovery-time watermarks for /healthz (docs/robustness.md
         §"Recovery time"): the two latency gauges the zero-recompile stack
@@ -499,20 +520,39 @@ class ScoringServer:
             out["standby"] = {"ready": False}
         return out
 
+    def shed_for_memory_pressure(self) -> bool:
+        """Admission gate: shed once the device-memory watermark crosses
+        critical (``runtime/memory_guard``; throttled sample, so this is a
+        cached-float compare per request, not a device call)."""
+        try:
+            from photon_tpu.runtime.memory_guard import guard
+
+            return guard().should_shed()
+        except Exception:  # noqa: BLE001 - shedding must never 500
+            return False
+
     def degraded_reasons(self, version=None) -> list:
         """Why this (otherwise alive) server is serving worse answers:
-        open/half-open circuit breakers, both the per-coordinate store
-        breakers and the scorer's kernel breaker. Empty = fully healthy."""
+        open/half-open circuit breakers (per-coordinate store breakers and
+        the scorer's kernel breaker) and device memory pressure over the
+        high-water mark. Empty = fully healthy."""
         v = version if version is not None else self.registry.current
         reasons = []
         try:
             snap = v.scorer.breaker_snapshot()
         except Exception:  # noqa: BLE001 - harness fakes lack a scorer
-            return reasons
+            snap = {}
         for cid, s in sorted(snap.items()):
             if s.get("state") in ("open", "half_open"):
                 kind = "kernel" if cid == "__kernel__" else f"store:{cid}"
                 reasons.append(f"breaker_{s['state']}:{kind}")
+        try:
+            from photon_tpu.runtime.memory_guard import guard
+
+            if guard().under_pressure():
+                reasons.append("memory_pressure")
+        except Exception:  # noqa: BLE001 - health must answer regardless
+            pass
         return reasons
 
     @property
@@ -555,6 +595,7 @@ class ScoringServer:
             "interval_s": round(dt, 3),
             **counters,
             "freshness": self.freshness(),
+            "memory": self.memory_snapshot(),
             "batcher": self.batcher.snapshot(),
             "coefficient_caches": v.scorer.cache_snapshot(),
             "breakers": v.scorer.breaker_snapshot(),

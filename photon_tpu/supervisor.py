@@ -310,7 +310,15 @@ def run_with_recovery(
                 )
             if attempt >= policy.max_restarts:
                 raise RestartsExhausted(failures, e) from e
-            delay = next(delays)
+            # OOM is deterministic-unless-degraded: the same shapes re-OOM
+            # no matter how long we wait, so neither sleep on it nor DRAW
+            # from the decorrelated-jitter schedule (a drawn-but-unslept
+            # delay would still inflate the next transient's backoff) —
+            # the TPU_RECOVERY.jsonl pattern of repeated identical
+            # failures (runtime/memory_guard).
+            from photon_tpu.runtime.memory_guard import is_oom
+
+            delay = 0.0 if is_oom(e) else next(delays)
             if delay > 0:
                 sleep(delay)
     raise AssertionError("unreachable")
@@ -476,7 +484,31 @@ class RunSupervisor:
         the first successful attempt's result. Non-retryable errors
         propagate immediately (journaled as ``fatal``); an exhausted
         budget raises :class:`RestartsExhausted` whose ``cause`` is the
-        last classified failure."""
+        last classified failure.
+
+        OOM policy (docs/robustness.md §"Memory pressure"): restarts
+        cannot fix resource exhaustion, so an ``oom``-classified failure
+        never burns the normal budget/backoff schedule — it is restarted
+        AT MOST ONCE, immediately (no backoff sleep), pre-degraded
+        (``memory_guard.pre_degrade_for_restart`` shrinks the sweep-cache
+        budget and caps the RE chunk ladder, journaled as the plan the
+        next attempt runs under); a second OOM escalates as a classified
+        ``RestartsExhausted(cause="oom")``."""
+        from photon_tpu.runtime import memory_guard as mg_mod
+
+        # Register the journal for the attempt's lifetime so in-run OOM
+        # downshifts land as journal rows next to the restart story —
+        # restoring whatever was registered before, so a journal-less
+        # supervisor can never detach an outer supervisor's journal.
+        if self.journal is None:
+            return self._run(make_attempt)
+        prev_journal = mg_mod.set_journal(self.journal)
+        try:
+            return self._run(make_attempt)
+        finally:
+            mg_mod.set_journal(prev_journal)
+
+    def _run(self, make_attempt: Callable[[int], object]):
         from photon_tpu.obs.metrics import REGISTRY
 
         restarts = REGISTRY.counter(
@@ -488,7 +520,10 @@ class RunSupervisor:
 
         failures: list[AttemptFailure] = []
         delays = self.policy.delays()
-        for attempt in range(self.policy.max_restarts + 1):
+        attempt = 0
+        oom_restarts = 0
+        other_restarts = 0
+        while True:
             t0 = time.monotonic()
             self._journal("attempt_start", attempt=attempt)
             # restart→first-step clock (docs/robustness.md §recovery time):
@@ -502,7 +537,22 @@ class RunSupervisor:
                 took = round(time.monotonic() - t0, 3)
                 cause = self.classify(e)
                 retryable = self.policy.is_retryable(e)
-                will_restart = retryable and attempt < self.policy.max_restarts
+                from photon_tpu.runtime.backend_guard import CAUSE_OOM
+
+                is_oom_failure = cause == CAUSE_OOM
+                if is_oom_failure:
+                    # The one pre-degraded OOM restart rides OUTSIDE the
+                    # transient budget (a capacity wall and a flaky device
+                    # are different failure classes, and charging the OOM
+                    # retry against max_restarts would shortchange later
+                    # genuine transients). A PRE-DEGRADED attempt that
+                    # still OOMs is a doomed loop, not recovery; a zero
+                    # budget still means "never restart anything".
+                    will_restart = (retryable and oom_restarts < 1
+                                    and self.policy.max_restarts > 0)
+                else:
+                    will_restart = (retryable and other_restarts
+                                    < self.policy.max_restarts)
                 failures.append(AttemptFailure(
                     attempt, type(e).__name__, str(e), took, cause=cause))
                 self._journal(
@@ -526,6 +576,18 @@ class RunSupervisor:
                     raise RestartsExhausted(failures, e) from e
                 restarts.inc(cause=cause)
                 self._maybe_failover(cause)
+                if is_oom_failure:
+                    # The one OOM restart goes out PRE-DEGRADED: same
+                    # shapes would deterministically re-OOM, so the next
+                    # attempt gets a shrunken sweep-cache budget and a
+                    # capped RE chunk ladder (journaled plan).
+                    from photon_tpu.runtime import memory_guard as mg_mod
+
+                    oom_restarts += 1
+                    mg_mod.pre_degrade_for_restart(
+                        f"attempt {attempt} oom: {str(e)[:120]}")
+                else:
+                    other_restarts += 1
                 # Pre-warm the NEXT attempt from the compile store's
                 # manifest: every executable the failed attempt compiled
                 # loads from the persistent cache before the restart goes
@@ -549,11 +611,16 @@ class RunSupervisor:
                         self.journal.record(
                             "prewarm", _mirror=False,
                             attempt=attempt + 1, **summary)
-                delay = next(delays)
+                # OOM skips the backoff sleep entirely (deterministic-
+                # unless-degraded — waiting cannot free device memory the
+                # plan shrink didn't; the jitter schedule is preserved for
+                # genuinely transient causes).
+                delay = 0.0 if is_oom_failure else next(delays)
                 self._journal("restart", attempt=attempt + 1, cause=cause,
                               backoff_s=round(delay, 3))
                 if delay > 0:
                     self.sleep(delay)
+                attempt += 1
                 continue
             took = round(time.monotonic() - t0, 3)
             cs_mod.disarm_first_step_clock()  # a stepless success (full
@@ -561,7 +628,6 @@ class RunSupervisor:
             self._journal("run_ok", attempt=attempt, seconds=took, ok=True,
                           prior_failures=len(failures))
             return result
-        raise AssertionError("unreachable")
 
 
 # ---------------------------------------------------------------------------
@@ -597,6 +663,7 @@ class Heartbeat:
         process_id: Optional[int] = None,
         interval_seconds: float = 10.0,
         slo_watchdog=None,
+        memory_guard="auto",
     ):
         if process_id is None:
             import jax
@@ -610,6 +677,11 @@ class Heartbeat:
         # from the same surviving daemon thread as the map-count check, so
         # a wedged main thread still reports SLO state.
         self.slo_watchdog = slo_watchdog
+        # Device-memory watchdog (runtime/memory_guard): every long-lived
+        # training process already heartbeats, so the memory sample +
+        # high-water sweep-cache spill ride the same loop for free.
+        # "auto" resolves the process guard at start(); None disables.
+        self.memory_guard = memory_guard
         self.epoch = 0
         self._stop = None
         self._thread = None
@@ -702,6 +774,11 @@ class Heartbeat:
         # the same number scrapeable wherever /metrics is served.
         map_watch = MapCountWatchdog()
         install_map_count_gauge()
+        mem_guard = self.memory_guard
+        if mem_guard == "auto":
+            from photon_tpu.runtime.memory_guard import guard
+
+            mem_guard = guard()
 
         def loop():
             while not self._stop.wait(self.interval_seconds):
@@ -710,6 +787,11 @@ class Heartbeat:
                 except OSError:
                     pass  # shared fs hiccup; next beat retries
                 map_watch.check()
+                if mem_guard is not None:
+                    try:
+                        mem_guard.check()
+                    except Exception:  # noqa: BLE001 - the watchdog must
+                        pass  # never take the liveness beacon down with it
                 if self.slo_watchdog is not None:
                     try:
                         self.slo_watchdog.check()

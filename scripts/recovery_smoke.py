@@ -151,6 +151,7 @@ def main() -> None:
                   f"({e.cause})")
 
     warm_restart_drill()
+    oom_drill()
 
     print("recovery smoke ok")
 
@@ -252,6 +253,117 @@ def warm_restart_drill() -> None:
               f"warm restart beat the cold one "
               f"({firsts[-1]['restart_to_first_step_seconds']}s vs "
               f"{firsts[0]['restart_to_first_step_seconds']}s)")
+
+
+def oom_drill() -> None:
+    """OOM degradation-ladder drill (docs/robustness.md §"Memory
+    pressure"): an injected ``device_oom`` at the RE bucket dispatch of a
+    SUPERVISED run must be absorbed by a chunk-tier downshift — exactly
+    ONE ``oom_downshift`` journal row, ZERO supervisor restarts, the run
+    completes, and the result matches the uninterrupted run to 1e-12 (the
+    PR 4 chunked==full equivalence; the drill is f64)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from photon_tpu.data.random_effect import build_random_effect_dataset
+    from photon_tpu.faults import FaultPlan, FaultSpec, active_plan
+    from photon_tpu.functions.problem import GLMOptimizationProblem
+    from photon_tpu.game import train_random_effects
+    from photon_tpu.obs.metrics import REGISTRY
+    from photon_tpu.optim import (
+        OptimizerConfig,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+    )
+    from photon_tpu.runtime import memory_guard as mg
+    from photon_tpu.supervisor import RunSupervisor
+    from photon_tpu.types import TaskType
+
+    print("== OOM drill: downshift-not-restart ==")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    rng = np.random.default_rng(3)
+    n_entities, rows, k, dim = 12, 6, 4, 40
+    idx_rows, val_rows, labels, keys = [], [], [], []
+    for e in range(n_entities):
+        support = rng.choice(dim, size=2 * k, replace=False)
+        for _ in range(rows):
+            cols = rng.choice(support, size=k, replace=False)
+            idx_rows.append(cols.astype(np.int64))
+            val_rows.append(rng.normal(size=k))
+            labels.append(float(rng.random() < 0.5))
+            keys.append(f"u{e}")
+    ds = build_random_effect_dataset(
+        "userId", np.asarray(keys, object), np.asarray(idx_rows),
+        np.asarray(val_rows), np.asarray(labels, np.float64),
+        global_dim=dim, dtype=np.float64)
+    problem = GLMOptimizationProblem(
+        task=TaskType.LOGISTIC_REGRESSION,
+        optimizer_config=OptimizerConfig(max_iterations=40),
+        optimizer_type=OptimizerType.LBFGS,
+        regularization=RegularizationContext(RegularizationType.L2),
+        reg_weight=1.0,
+    )
+    offsets = jnp.zeros((ds.n_rows,), jnp.float64)
+    prev_ladder = os.environ.get("PHOTON_RE_CHUNK_LADDER")
+    os.environ["PHOTON_RE_CHUNK_LADDER"] = "4,8"  # a tier below 12 entities
+    mg.reset_state()
+    try:
+        ref, _ = train_random_effects(problem, ds, offsets)
+        mg.reset_state()
+        restarts0 = sum(
+            v for _, v in REGISTRY.counter("run_restarts_total").collect())
+        shifts0 = REGISTRY.counter("oom_downshifts_total").value(
+            site="re.solve", cause="oom")
+        with tempfile.TemporaryDirectory() as td:
+            journal_path = os.path.join(td, "recovery.jsonl")
+            attempts = []
+
+            def attempt(i):
+                attempts.append(i)
+                return train_random_effects(problem, ds, offsets)
+
+            plan = FaultPlan(seed=0, specs=[
+                FaultSpec(site="re.solve", error="device_oom", count=1)])
+            sup = RunSupervisor(journal=journal_path, sleep=lambda s: None)
+            with active_plan(plan) as inj:
+                model, _ = sup.run(attempt)
+            check(inj.fired("re.solve") == 1, "the device_oom really fired")
+            check(attempts == [0],
+                  "ZERO supervisor restarts (downshift-not-restart)")
+            check(sum(v for _, v in REGISTRY.counter(
+                "run_restarts_total").collect()) == restarts0,
+                "run_restarts_total unmoved")
+            shifts = REGISTRY.counter("oom_downshifts_total").value(
+                site="re.solve", cause="oom") - shifts0
+            check(shifts == 1,
+                  f"oom_downshifts_total matches the injection count "
+                  f"({int(shifts)})")
+            rows_j = [json.loads(x)
+                      for x in open(journal_path).read().splitlines()]
+            downshifts = [r for r in rows_j
+                          if r["event"] == "oom_downshift"]
+            check(len(downshifts) == 1,
+                  "exactly one oom_downshift journal row")
+            check(downshifts[0]["site"] == "re.solve"
+                  and downshifts[0]["cause"] == "oom",
+                  f"journal row carries site+cause "
+                  f"({downshifts[0]['before']} -> "
+                  f"{downshifts[0]['after']})")
+            diff = max(
+                float(np.abs(np.asarray(a) - np.asarray(b)).max())
+                for a, b in zip(model.bucket_coefs, ref.bucket_coefs))
+            check(diff <= 1e-12,
+                  f"downshifted result within 1e-12 of the uninterrupted "
+                  f"run (max diff {diff:.2e})")
+    finally:
+        if prev_ladder is None:
+            os.environ.pop("PHOTON_RE_CHUNK_LADDER", None)
+        else:
+            os.environ["PHOTON_RE_CHUNK_LADDER"] = prev_ladder
+        mg.reset_state()
 
 
 if __name__ == "__main__":

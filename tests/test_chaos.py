@@ -255,6 +255,107 @@ def test_device_lost_escalates_to_supervisor_past_budget(
                         "cause": "device_lost"}
 
 
+def test_device_oom_mid_re_sweep_downshifts_not_restarts(
+    tmp_path, reference, monkeypatch
+):
+    """ISSUE 13 acceptance (chaos drill): a device_oom injected at the RE
+    bucket dispatch is absorbed by the DEGRADATION ladder — one blessed
+    chunk tier down, sticky — with ZERO supervisor restarts (restarts
+    cannot fix resource exhaustion), the run completes, and the final
+    model matches the uninterrupted run up to the chunk-tier change
+    (chunked==full equivalence). The downshift is visible in
+    oom_downshifts_total{site="re.solve"}."""
+    from photon_tpu.obs.metrics import REGISTRY
+    from photon_tpu.runtime import memory_guard as mg
+
+    # A tiny blessed ladder so the 6-entity perUser bucket HAS a smaller
+    # Newton tier to drop to (the default 256+ ladder would skip straight
+    # to the vmapped solver on buckets this small).
+    monkeypatch.setenv("PHOTON_RE_CHUNK_LADDER", "2,4")
+    mg.reset_state()
+    try:
+        bundle, vbundle, ref = reference
+        plan = FaultPlan(seed=0, specs=[
+            FaultSpec(site="re.solve", error="device_oom", count=1),
+        ])
+        restarts_before = sum(
+            v for _, v in REGISTRY.counter("run_restarts_total").collect())
+        shifts_before = REGISTRY.counter("oom_downshifts_total").value(
+            site="re.solve", cause="oom")
+        attempts = []
+
+        def attempt(i):
+            attempts.append(i)
+            return _attempt_factory(
+                str(tmp_path / "ck"), bundle, vbundle)(i)
+
+        with active_plan(plan) as inj:
+            result = run_with_recovery(
+                attempt,
+                RestartPolicy(max_restarts=2, backoff_seconds=0,
+                              jitter=False),
+                sleep=lambda s: None,
+            )
+        assert inj.fired("re.solve") == 1        # the OOM really happened
+        assert attempts == [0]                   # downshift, NOT restart
+        assert sum(
+            v for _, v in REGISTRY.counter("run_restarts_total").collect()
+        ) == restarts_before
+        assert REGISTRY.counter("oom_downshifts_total").value(
+            site="re.solve", cause="oom") == shifts_before + 1
+        assert mg.sticky_plan("re.solve") is not None   # sticky for the run
+        for a, b in zip(_final_arrays(result), _final_arrays(ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-5, rtol=0)
+    finally:
+        mg.reset_state()
+
+
+def test_device_oom_exhausted_escalates_classified_supervised(
+    tmp_path, reference, monkeypatch
+):
+    """Bounded downshifts exhausted: with the downshift budget at zero,
+    every OOM escalates; the supervisor grants its ONE pre-degraded OOM
+    restart (no backoff burned) and then raises a classified
+    RestartsExhausted(cause="oom") — the whole story journaled."""
+    import json
+
+    from photon_tpu.faults import DeviceOomError
+    from photon_tpu.runtime import memory_guard as mg
+    from photon_tpu.supervisor import RestartsExhausted, RunSupervisor
+
+    monkeypatch.setenv("PHOTON_OOM_MAX_DOWNSHIFTS", "0")
+    mg.reset_state()
+    try:
+        bundle, vbundle, _ = reference
+        plan = FaultPlan(seed=0, specs=[
+            FaultSpec(site="re.solve", error="device_oom"),  # every dispatch
+        ])
+        journal = str(tmp_path / "recovery.jsonl")
+        sleeps = []
+        sup = RunSupervisor(
+            RestartPolicy(max_restarts=4, backoff_seconds=9.0,
+                          jitter=False),
+            journal=journal,
+            sleep=sleeps.append,
+        )
+        with active_plan(plan):
+            with pytest.raises(RestartsExhausted) as ei:
+                sup.run(_attempt_factory(str(tmp_path / "ck"), bundle,
+                                         vbundle))
+        assert ei.value.cause == "oom"
+        assert isinstance(ei.value.last, DeviceOomError)
+        # ONE pre-degraded restart despite the 4-deep budget, no backoff.
+        assert len(ei.value.failures) == 2 and sleeps == []
+        rows = [json.loads(x) for x in open(journal).read().splitlines()]
+        events = [r["event"] for r in rows]
+        assert "oom_exhausted" in events      # ladder refused, journaled
+        assert "oom_predegrade" in events     # the one degraded retry plan
+        assert events[-1] == "exhausted" and rows[-1]["cause"] == "oom"
+    finally:
+        mg.reset_state()
+
+
 def test_checkpoint_write_fault_surfaces_as_retryable(tmp_path, reference):
     """An injected IO error in the background checkpoint writer surfaces on
     the next save as a RuntimeError — retryable by the supervisor, never a

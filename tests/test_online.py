@@ -431,6 +431,58 @@ def test_chaos_device_lost_mid_refresh_publishes_bitidentical_delta():
 
 
 @pytest.mark.chaos
+def test_chaos_oom_mid_refresh_halves_batch_no_torn_delta():
+    """ISSUE 13 online leg: a device_oom injected mid-refresh halves
+    refresh_batch (sticky on the config) and the cycle still publishes a
+    delta bit-identical to the uninterrupted run's — no state mutated
+    before the downshifted retry, so nothing tears. The dirty set covered
+    by the halved cap is unchanged here (4 entities <= 8/2), so the delta
+    content is EXACTLY the clean run's."""
+    from photon_tpu.obs.metrics import REGISTRY
+    from photon_tpu.runtime import memory_guard as mg
+
+    mg.reset_state()
+    try:
+        events, _ = _gen_events(TaskType.LOGISTIC_REGRESSION, n_entities=4,
+                                rows=6, seed=3)
+
+        def run_one(plan):
+            pub = RecordingPublisher()
+            tr = _trainer(publisher=pub, max_iterations=15,
+                          dtype="float32", refresh_batch=8)
+            if plan is not None:
+                with active_plan(plan) as inj:
+                    tr.run(events)
+                    assert inj.fired("online.refresh") == 1
+            else:
+                tr.run(events)
+            return tr, pub
+
+        clean_tr, clean_pub = run_one(None)
+        shifts_before = REGISTRY.counter("oom_downshifts_total").value(
+            site="online.refresh", cause="oom")
+        plan = FaultPlan(seed=5, specs=[
+            FaultSpec(site="online.refresh", error="device_oom", count=1),
+        ])
+        faulted_tr, faulted_pub = run_one(plan)
+        assert faulted_tr.config.refresh_batch == 4      # halved, sticky
+        assert clean_tr.config.refresh_batch == 8
+        assert REGISTRY.counter("oom_downshifts_total").value(
+            site="online.refresh", cause="oom") == shifts_before + 1
+        assert faulted_tr.totals["device_loss_recoveries"] == 0
+        assert len(faulted_pub.deltas) == len(clean_pub.deltas) == 1
+        a, b = clean_pub.deltas[0], faulted_pub.deltas[0]
+        assert a.event_horizon == b.event_horizon
+        assert set(a.patches["perUser"]) == set(b.patches["perUser"])
+        for key in a.patches["perUser"]:
+            pa, pb = a.patches["perUser"][key], b.patches["perUser"][key]
+            np.testing.assert_array_equal(pa.cols, pb.cols)
+            np.testing.assert_array_equal(pa.vals, pb.vals)  # bit-identical
+    finally:
+        mg.reset_state()
+
+
+@pytest.mark.chaos
 def test_chaos_device_lost_escalates_past_recovery_budget(monkeypatch):
     monkeypatch.setenv("PHOTON_DEVICE_LOST_MAX_RECOVERIES", "1")
     events, _ = _gen_events(TaskType.LOGISTIC_REGRESSION, n_entities=2,

@@ -810,6 +810,110 @@ def test_chaos_kernel_repeated_errors_open_breaker_fast_fail(trained):
 
 @pytest.mark.chaos
 @pytest.mark.slow
+def test_chaos_kernel_oom_downshifts_max_batch_and_answers(trained):
+    """ISSUE 13 serving leg: a device_oom out of the scoring kernel is
+    absorbed by the bounded max-batch downshift — the request still
+    answers 200 (the halved batch is an already-warmed padded shape, zero
+    retraces), the cap is sticky, and the downshift is counted."""
+    from photon_tpu.obs import retrace
+    from photon_tpu.obs.metrics import REGISTRY
+    from photon_tpu.runtime import memory_guard as mg
+
+    mg.reset_state()
+    d, (m1, m2), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=4, max_wait_ms=1.0, cache_entities=16,
+                          max_row_nnz=32, breaker_failures=3))
+    batcher = MicroBatcher(max_batch=4, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    rec = read_records(str(d / "val.avro"))[0]
+    plan = FaultPlan(seed=0, specs=[
+        FaultSpec(site="serving.kernel", error="device_oom", count=1),
+    ])
+    shifts_before = REGISTRY.counter("oom_downshifts_total").value(
+        site="serving.kernel", cause="oom")
+    retr_before = retrace.retraces_after_warmup(
+        "additive_score_rows")
+    try:
+        with active_plan(plan) as inj:
+            status, body = _post(host, port, "/score", _payload(rec))
+        assert inj.fired("serving.kernel") == 1  # the OOM really happened
+        assert status == 200 and "score" in body  # ...and was absorbed
+        scorer = registry.current.scorer
+        assert scorer._max_batch_cap == 2        # halved, sticky
+        assert REGISTRY.counter("oom_downshifts_total").value(
+            site="serving.kernel", cause="oom") == shifts_before + 1
+        # Zero retraces: the downshifted shape was warmed at startup.
+        assert retrace.retraces_after_warmup(
+            "additive_score_rows") == retr_before
+        # Closed-loop: the next request answers identically at the
+        # degraded cap, and health reports no breaker trouble.
+        status, body2 = _post(host, port, "/score", _payload(rec))
+        assert status == 200
+        assert body2["score"] == pytest.approx(body["score"], abs=1e-6)
+        status, health = _get(host, port, "/healthz")
+        assert status == 200
+        # The cap is sticky for the RUN, not the scorer: a hot-swap's
+        # fresh scorer starts at the proven cap instead of re-OOMing its
+        # way back down (and re-burning the shared downshift budget).
+        v2 = registry.swap(m2)
+        assert v2.scorer._max_batch_cap == 2
+    finally:
+        server.shutdown()
+        mg.reset_state()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_memory_pressure_sheds_and_recovers(trained):
+    """Pressure-aware load shedding end to end: over the critical
+    watermark /score sheds 503 + Retry-After (never hangs) and /healthz
+    reports degraded ["memory_pressure"]; when pressure drains, serving
+    recovers closed-loop with no operator action."""
+    from photon_tpu.obs.metrics import REGISTRY
+    from photon_tpu.runtime import memory_guard as mg
+
+    mg.reset_state()
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=4, max_wait_ms=1.0, cache_entities=16,
+                          max_row_nnz=32))
+    batcher = MicroBatcher(max_batch=4, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    rec = read_records(str(d / "val.avro"))[0]
+    level = {"in_use": 990.0}
+    g = mg.guard()
+    g.stats_fn = lambda: {"bytes_in_use": level["in_use"],
+                          "bytes_limit": 1000.0,
+                          "watermark": level["in_use"] / 1000.0}
+    g.min_sample_interval_s = 0.0
+    sheds_before = REGISTRY.counter("memory_pressure_sheds_total").value()
+    try:
+        status, body = _post(host, port, "/score", _payload(rec))
+        assert status == 503 and body.get("shed") is True
+        status, health = _get(host, port, "/healthz")
+        assert status == 200 and health["status"] == "degraded"
+        assert "memory_pressure" in health["degraded"]
+        assert REGISTRY.counter(
+            "memory_pressure_sheds_total").value() > sheds_before
+        # Pressure drains -> full service resumes, health goes clean.
+        level["in_use"] = 400.0
+        status, body = _post(host, port, "/score", _payload(rec))
+        assert status == 200 and "score" in body
+        status, health = _get(host, port, "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        assert health["degraded"] == []
+    finally:
+        server.shutdown()
+        mg.reset_state()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
 def test_chaos_batcher_crash_fails_fast_and_flags_healthz(trained):
     """Satellite: if the micro-batcher worker dies, queued futures fail
     immediately (not after the full request timeout) and /healthz flips to
