@@ -1737,6 +1737,172 @@ def _game_scale_multisweep():
     return out
 
 
+def _game_scale_mesh():
+    """Mesh-sharded RE-step scaling A/B (ROADMAP item 1): the same
+    entity bucket solved on 1 device vs entity-sharded across every
+    visible device, BOTH arms pinned to the same chunked-Newton tier
+    (scoped ladder + budget) so the delta isolates the sharding, and the
+    chunked tiers provably carry the rows under the mesh. Reports warm
+    step seconds per arm, the scaling factor and efficiency vs ideal,
+    the retrace-after-warmup count across the warm mesh run (must be 0),
+    and the fraction of routed rows on chunked Newton tiers.
+
+    Scaling needs real cores: on a box with fewer cores than devices
+    (this container's CI rig is 1-core) the 8 virtual host devices
+    timeshare one core and efficiency reads ~1/n by construction —
+    ``host_cpu_count`` is stamped so the rig's numbers are filtered
+    honestly (MULTICHIP_r0x is the 8-device rig of record)."""
+    import jax
+    import jax.numpy as jnp
+
+    from photon_tpu.data.random_effect import build_random_effect_dataset
+    from photon_tpu.game import random_effect as re_mod
+    from photon_tpu.game.newton_re import _primal_need_bytes
+    from photon_tpu.game.random_effect import train_random_effects
+    from photon_tpu.obs import retrace
+    from photon_tpu.parallel.mesh import make_mesh
+    from photon_tpu.types import TaskType as _TT
+
+    n_dev = len(jax.devices())
+    if n_dev < 2:
+        return {"game_scale_mesh_note": "single device — mesh leg skipped"}
+
+    n_users, rows = (1_024, 8) if SMOKE else (32_768, 16)
+    d_user = 24 if SMOKE else 48
+    rng = np.random.default_rng(11)
+    n = n_users * rows
+    keys = np.char.add("u", (np.arange(n) // rows).astype(str))
+    idx = rng.integers(0, d_user, size=(n, 6)).astype(np.int32)
+    val = rng.normal(size=(n, 6)).astype(np.float32)
+    labels = (rng.random(n) < 0.5).astype(np.float32)
+    ds = build_random_effect_dataset(
+        "userId", keys, idx, val, labels, global_dim=d_user)
+    offsets = jnp.zeros((n,), jnp.float32)
+
+    from photon_tpu.functions.problem import GLMOptimizationProblem
+    from photon_tpu.optim import (
+        OptimizerConfig,
+        OptimizerType,
+        RegularizationContext,
+        RegularizationType,
+    )
+
+    problem = GLMOptimizationProblem(
+        task=_TT.LOGISTIC_REGRESSION,
+        optimizer_type=OptimizerType.LBFGS,
+        optimizer_config=OptimizerConfig(max_iterations=15),
+        regularization=RegularizationContext(RegularizationType.L2),
+        reg_weight=1.0,
+    )
+
+    # Pin BOTH arms to the same chunked-primal plan: chunk < E/n_dev so
+    # the mesh arm's per-device-priced FULL tiers (primal AND dual) are
+    # refused, budget between the chunk's cost and the cheapest
+    # per-device full cost — the A/B then isolates sharding, not solver
+    # choice.
+    from photon_tpu.game.newton_re import _dual_need_bytes
+
+    big = max(ds.buckets, key=lambda b: b.n_entities)
+    e, s, _ = big.idx.shape
+    p = big.local_dim
+    e_dev = -(-e // n_dev)
+    b_hi = min(_primal_need_bytes(e_dev, s, p, 4.0),
+               _dual_need_bytes(e_dev, s, p, 1, 4.0))
+    chunk = n_dev
+    while (chunk * 2 <= e // (2 * n_dev)
+           and _primal_need_bytes(chunk * 2, s, p, 4.0) < b_hi):
+        chunk *= 2
+    b_lo = _primal_need_bytes(chunk, s, p, 4.0)
+    if b_lo >= b_hi:
+        return {"game_scale_mesh_note":
+                "no budget window pins both arms to one chunked tier at "
+                f"this shape (e={e}, s={s}, p={p}, devices={n_dev})"}
+    budget_mb = ((b_lo + b_hi) / 2) / 1e6
+
+    env_keys = ("PHOTON_RE_CHUNK_LADDER", "PHOTON_RE_NEWTON_BUDGET_MB")
+    saved = {k: os.environ.get(k) for k in env_keys}
+    os.environ["PHOTON_RE_CHUNK_LADDER"] = str(chunk)
+    os.environ["PHOTON_RE_NEWTON_BUDGET_MB"] = str(budget_mb)
+
+    def timed_arm(mesh):
+        # cold (compiles) then warm (the routed production number)
+        m, _ = train_random_effects(problem, ds, offsets, mesh=mesh)
+        np.asarray(m.bucket_coefs[0][:1])
+        t0 = time.perf_counter()
+        m, _ = train_random_effects(problem, ds, offsets, mesh=mesh)
+        for c in m.bucket_coefs:
+            np.asarray(c[:1])
+        np.asarray(m.bucket_coefs[-1])
+        dt = time.perf_counter() - t0
+        plans = [(t["solver"], t["chunk"], t["row_slots"])
+                 for t in re_mod.LAST_BUCKET_TIMINGS]
+        return dt, plans, m
+
+    try:
+        t1, _, m1 = timed_arm(None)
+        mesh = make_mesh()
+        # warm-mark AFTER the mesh arm's cold run so the warm run proves
+        # retrace quietness under the mesh (acceptance criterion).
+        tm_cold0 = time.perf_counter()
+        mm_cold, _ = train_random_effects(problem, ds, offsets, mesh=mesh)
+        np.asarray(mm_cold.bucket_coefs[-1])
+        mesh_cold = time.perf_counter() - tm_cold0
+        for k in retrace.RE_SOLVER_KERNELS:
+            retrace.mark_warm(k)
+        retr0 = sum(retrace.retraces_after_warmup(k)
+                    for k in retrace.RE_SOLVER_KERNELS)
+        t0 = time.perf_counter()
+        mm, _ = train_random_effects(problem, ds, offsets, mesh=mesh)
+        np.asarray(mm.bucket_coefs[-1])
+        tm = time.perf_counter() - t0
+        retr = sum(retrace.retraces_after_warmup(k)
+                   for k in retrace.RE_SOLVER_KERNELS) - retr0
+        plans_m = [(t["solver"], t["chunk"], t["row_slots"])
+                   for t in re_mod.LAST_BUCKET_TIMINGS]
+    finally:
+        # Warm marks are process-global: a mesh-arm failure after
+        # mark_warm must not leave later stages' first compiles counting
+        # as retraces (clear on an unmarked kernel is a no-op).
+        for k in retrace.RE_SOLVER_KERNELS:
+            retrace.clear_warm(k)
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    slots = sum(sl for _, _, sl in plans_m) or 1
+    chunked_newton = sum(sl for sv, ch, sl in plans_m
+                         if sv.startswith("newton") and ch)
+    newton_rows = sum(sl for sv, _, sl in plans_m
+                      if sv.startswith("newton"))
+    # Numerical agreement between the arms (f32 reduction noise only).
+    worst = max(
+        float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+        for a, b in zip(m1.bucket_coefs, mm.bucket_coefs)
+    )
+    scaling = t1 / tm if tm > 0 else float("nan")
+    return {
+        "game_scale_mesh_devices": n_dev,
+        "game_scale_mesh_host_cpu_count": os.cpu_count(),
+        "game_scale_mesh_entities": n_users,
+        "game_scale_mesh_chunk": chunk,
+        "game_scale_mesh_re_step_seconds_1dev": round(t1, 3),
+        "game_scale_mesh_re_step_seconds": round(tm, 3),
+        "game_scale_mesh_re_step_seconds_cold": round(mesh_cold, 3),
+        "game_scale_mesh_re_scaling_x": round(scaling, 3),
+        "game_scale_mesh_re_scaling_efficiency": round(scaling / n_dev, 3),
+        "game_scale_mesh_re_entities_per_sec": round(n_users / tm, 1),
+        "game_scale_mesh_retraces_after_warmup": int(retr),
+        "game_scale_mesh_chunked_newton_row_fraction": round(
+            chunked_newton / slots, 4),
+        "game_scale_mesh_newton_row_fraction": round(newton_rows / slots, 4),
+        "game_scale_mesh_plans": sorted({
+            f"{sv}@{ch}" if ch else f"{sv}@full" for sv, ch, _ in plans_m}),
+        "game_scale_mesh_vs_1dev_coef_gap": float(worst),
+    }
+
+
 def bench_game_scale():
     """Config-3 at MovieLens scale (VERDICT round-3 ask #9): >=100K users,
     per-coordinate-step time and RE-solve throughput."""
@@ -1845,9 +2011,11 @@ def bench_game_scale():
         "game_scale_re_history_free_row_fraction": round(
             free_rows / total_rows, 4) if total_rows else None,
     }
-    # Pipelined data-path A/B + multi-sweep sweep-cache legs (ISSUE 9).
+    # Pipelined data-path A/B + multi-sweep sweep-cache legs (ISSUE 9) +
+    # mesh-sharded RE scaling leg (ISSUE 14).
     # Isolated: a failure records a note but never loses the base figures.
-    for extra in (_game_scale_data_path, _game_scale_multisweep):
+    for extra in (_game_scale_data_path, _game_scale_multisweep,
+                  _game_scale_mesh):
         try:
             out.update(extra())
         except Exception as e:  # noqa: BLE001 - recorded, not fatal
@@ -2224,11 +2392,23 @@ def _provenance(details: dict) -> dict:
     except Exception:  # noqa: BLE001
         jax_version = "unknown"
     backends = sorted(set((details.get("stage_backends") or {}).values()))
+    try:
+        import jax
+
+        n_devices = len(jax.devices())
+        mesh_shape = {"data": n_devices}
+    except Exception:  # noqa: BLE001
+        n_devices, mesh_shape = None, None
     return {
         "git_sha": _git_sha(),
         "code_fingerprint": _git_head(),
         "jax_version": jax_version,
         "hostname": socket.gethostname(),
+        # Device topology (read by bench_compare.py): an 8-device mesh
+        # round and a 1-device round are different programs — cross-
+        # device-count comparisons are refused like cross-backend ones.
+        "n_devices": n_devices,
+        "mesh_shape": mesh_shape,
         "backend_summary": {
             "backend": details.get("backend"),
             "stage_backends_distinct": backends,

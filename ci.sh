@@ -108,6 +108,17 @@ echo "== bench analysis (advisory compare of newest artifacts + doc sync) =="
 python scripts/bench_compare.py --newest 2
 python scripts/sync_bench_docs.py --check
 
+echo "== multichip smoke (8-device mesh: sharded game_scale + shard-loss drill) =="
+# MULTICHIP_r0x graduated from an rc-check into a harness (ROADMAP item 1,
+# docs/scaling.md §"Device mesh"): the mesh-sharded game_scale leg must run
+# its chunked-Newton tiers UNDER the 8-device mesh with zero retraces after
+# warmup and match the 1-device arm, and losing exactly one shard mid-sweep
+# must redistribute that shard's entities over the survivors and complete
+# in-process, journaled as a classified recovery row (docs/robustness.md
+# §"Shard loss"). Scaling efficiency gates only on a multi-core rig — the
+# harness prints it honestly either way.
+python scripts/multichip_smoke.py
+
 echo "== multichip dryrun (8-device mesh: dp, dp x mp, RE, dcn x dp) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 

@@ -96,7 +96,15 @@ _CACHE_SPILLED = REGISTRY.gauge(
 
 
 def default_budget_bytes() -> int:
-    """``PHOTON_SWEEP_CACHE_MB`` (default 2048 MB; 0 disables caching)."""
+    """``PHOTON_SWEEP_CACHE_MB`` (default 2048 MB; 0 disables caching).
+
+    PER-DEVICE: a mesh-attached cache multiplies by the entity-axis device
+    count, because its pins are sharded — each device holds 1/n of every
+    pinned array, so the budget the operator sizes against one device's
+    HBM scales with the mesh instead of silently confining an 8-device
+    rig to one device's allowance (and the PR 13
+    ``PHOTON_SWEEP_CACHE_DEVICE_FRACTION`` clamp applies per device too —
+    ``memory_guard.effective_sweep_budget`` sees the per-device figure)."""
     try:
         mb = float(os.environ.get("PHOTON_SWEEP_CACHE_MB", "2048"))
     except ValueError:
@@ -121,7 +129,8 @@ class DeviceSweepCache:
     goes out of scope releases via ``__del__`` as a backstop.
     """
 
-    def __init__(self, budget_bytes: Optional[int] = None):
+    def __init__(self, budget_bytes: Optional[int] = None, mesh=None,
+                 entity_axis="data"):
         requested = (
             default_budget_bytes() if budget_bytes is None
             else max(0, int(budget_bytes))
@@ -132,13 +141,25 @@ class DeviceSweepCache:
             # exceed the whole device on small parts, and an
             # OOM-pre-degraded restart must not re-pin the budget that
             # just killed the attempt. Backends with no memory stats
-            # (CPU) keep the requested budget.
+            # (CPU) keep the requested budget. BOTH the requested budget
+            # and the clamp are PER-DEVICE figures; the mesh multiplier
+            # below converts to the cache-wide total.
             from photon_tpu.runtime.memory_guard import (
                 effective_sweep_budget,
             )
 
             requested = effective_sweep_budget(requested)
-        self.budget_bytes = requested
+        self.mesh = mesh
+        self.entity_axis = entity_axis
+        if mesh is not None:
+            from photon_tpu.parallel.mesh import axes_size
+
+            self.n_devices = axes_size(mesh, entity_axis)
+        else:
+            self.n_devices = 1
+        # Per-device figure; ``budget_bytes`` (the cache-wide total) is a
+        # property so the run's sticky shard degradation shrinks it live.
+        self._per_device_budget = requested
         # key -> (device pytree, nbytes, retained-host-referent). The
         # referent is whatever object the KEY was derived from (an id());
         # retaining it pins the id, so a freed-and-recycled address can
@@ -152,8 +173,70 @@ class DeviceSweepCache:
         self._spilled_keys: dict = {}
         self._bytes = 0
         self._spilled = 0
+        self._labels = None
+        # key -> device labels the entry's bytes were credited to (None =
+        # construction-mesh default); removal must credit the same series.
+        self._entry_labels: dict = {}
         self._lock = threading.Lock()
         _LIVE_CACHES.add(self)
+
+    @staticmethod
+    def _labels_for(mesh) -> list:
+        """Device-id labels for the per-device ``sweep_cache_bytes``
+        series: the given mesh's devices or the default device."""
+        try:
+            if mesh is not None:
+                devs = list(np.asarray(mesh.devices).flat)
+            else:
+                import jax
+
+                devs = [jax.devices()[0]]
+            return [str(getattr(d, "id", i)) for i, d in enumerate(devs)]
+        except Exception:  # noqa: BLE001 - labels are telemetry only
+            return ["0"]
+
+    def _device_labels(self) -> list:
+        """Construction-mesh labels, memoized. Lazy — reading
+        jax.devices() at construction would initialize the backend before
+        the owner wants it."""
+        if self._labels is None:
+            self._labels = self._labels_for(self.mesh)
+        return self._labels
+
+    def effective_devices(self) -> int:
+        """The entity-axis device count pins actually shard over NOW: the
+        construction mesh size, shrunk by the run's sticky shard-loss
+        degradation (docs/robustness.md §"Shard loss")."""
+        if self.mesh is None or self.n_devices <= 1:
+            return self.n_devices
+        try:
+            from photon_tpu.runtime import memory_guard as _mg
+
+            m = int((_mg.sticky_plan("re.shard") or {}).get("shards") or 0)
+        except Exception:  # noqa: BLE001 - degradation lookup is advisory
+            m = 0
+        return m if 0 < m < self.n_devices else self.n_devices
+
+    @property
+    def budget_bytes(self) -> int:
+        """Cache-wide total: per-device budget × the EFFECTIVE device
+        count. After a shard loss the total shrinks with the surviving
+        mesh, so survivors are never loaded past the per-device allowance
+        the operator (and the memory_guard clamp) sized."""
+        return self._per_device_budget * max(1, self.effective_devices())
+
+    def _bytes_gauge(self, delta: float, labels=None) -> None:
+        """Move the resident-bytes gauge: the unlabelled TOTAL (existing
+        consumers — descent residency instants, bench artifacts — keep
+        their series) plus a per-device-labelled series splitting the
+        delta across the devices THIS pin shards over (callers pass the
+        labels recorded at put time, so removal credits the same series
+        even after the effective mesh changed)."""
+        _CACHE_BYTES.inc(delta)
+        labels = labels or self._device_labels()
+        share = delta / len(labels)
+        for lbl in labels:
+            _CACHE_BYTES.inc(share, device=lbl)
 
     # -- core --------------------------------------------------------------
 
@@ -212,7 +295,7 @@ class DeviceSweepCache:
             if key not in self._entries:
                 self._entries[key] = (built, int(nbytes), retain)
                 self._bytes += int(nbytes)
-                _CACHE_BYTES.inc(int(nbytes))
+                self._bytes_gauge(int(nbytes))
                 _CACHE_ENTRIES.inc()
         return built
 
@@ -223,13 +306,14 @@ class DeviceSweepCache:
         re-fed chunk is not double-counted."""
         with self._lock:
             entry = self._entries.pop(key, None)
+            labels = self._entry_labels.pop(key, None)
             spilled = self._spilled_keys.pop(key, None)
             if entry is not None:
                 self._bytes -= entry[1]
             if spilled is not None:
                 self._spilled -= spilled[1]
         if entry is not None:
-            _CACHE_BYTES.inc(-entry[1])
+            self._bytes_gauge(-entry[1], labels)
             _CACHE_ENTRIES.inc(-1)
         if spilled is not None:
             _CACHE_SPILLED.inc(-spilled[1])
@@ -245,6 +329,7 @@ class DeviceSweepCache:
         if max_bytes <= 0:
             return 0
         freed = entries = newly_spilled = 0
+        freed_series: list = []
         with self._lock:
             for key in list(self._entries):
                 if freed >= max_bytes:
@@ -252,6 +337,8 @@ class DeviceSweepCache:
                 if key in self._mirrors:
                     continue
                 _built, nbytes, retain = self._entries.pop(key)
+                freed_series.append((nbytes, self._entry_labels.pop(key,
+                                                                    None)))
                 self._bytes -= nbytes
                 freed += nbytes
                 entries += 1
@@ -260,7 +347,8 @@ class DeviceSweepCache:
                     self._spilled += nbytes
                     newly_spilled += nbytes
         if freed:
-            _CACHE_BYTES.inc(-freed)
+            for nbytes, labels in freed_series:
+                self._bytes_gauge(-nbytes, labels)
             _CACHE_ENTRIES.inc(-entries)
         if newly_spilled:
             _CACHE_SPILLED.inc(newly_spilled)
@@ -273,13 +361,19 @@ class DeviceSweepCache:
             freed = self._bytes
             n = len(self._entries)
             spilled = self._spilled
+            freed_series = [
+                (nb, self._entry_labels.get(k))
+                for k, (_b, nb, _r) in self._entries.items()
+            ]
             self._entries.clear()
             self._mirrors.clear()
             self._spilled_keys.clear()
+            self._entry_labels.clear()
             self._bytes = 0
             self._spilled = 0
         if freed:
-            _CACHE_BYTES.inc(-freed)
+            for nb, labels in freed_series:
+                self._bytes_gauge(-nb, labels)
         if n:
             _CACHE_ENTRIES.inc(-n)
         if spilled:
@@ -338,16 +432,55 @@ class DeviceSweepCache:
         with trace_span("ingest.device_put", cat="ingest",
                         bytes=int(nbytes), cached=True,
                         what=f"re_dataset:{dataset.re_type}"):
-            dev_buckets = tuple(
-                jax.tree.map(jax.numpy.asarray, b) for b in buckets
-            )
+            if self.mesh is not None:
+                # Per-shard pins: each bucket's entity axis is padded to
+                # the mesh multiple (the same inert-lane convention the
+                # solve would apply) and device_put row-sharded over the
+                # entity axis — every device holds 1/n of the pin instead
+                # of device 0 holding everything, and the mesh solve's
+                # per-bucket placement becomes a no-op re-put. Consumers
+                # always read THIS mirror (train and score), so the padded
+                # lanes (zero coefs, ghost rows) stay invisible. The mesh
+                # resolves through the run's sticky shard degradation at
+                # PUT time, not construction time: after a real shard loss
+                # the recovery releases every mirror, and the rebuild here
+                # must land on the SURVIVING devices — re-putting onto the
+                # construction-time mesh would re-raise device_lost outside
+                # the solve's shard-loss catch (docs/robustness.md §"Shard
+                # loss": later sweeps start degraded, never re-fail).
+                from photon_tpu.game.random_effect import (
+                    _effective_mesh,
+                    _pad_bucket,
+                )
+                from photon_tpu.parallel.mesh import axes_size, batch_sharding
+
+                mesh, axis = _effective_mesh(self.mesh, self.entity_axis)
+                n_dev = axes_size(mesh, axis)
+                labels = self._labels_for(mesh)
+                sharding = batch_sharding(mesh, axis)
+                dev_buckets = tuple(
+                    jax.tree.map(
+                        lambda leaf: jax.device_put(leaf, sharding),
+                        _pad_bucket(b, n_dev, dataset.n_rows,
+                                    dataset.global_dim),
+                    )
+                    for b in buckets
+                )
+                nbytes = sum(_tree_nbytes(b) for b in dev_buckets)
+            else:
+                labels = None
+                dev_buckets = tuple(
+                    jax.tree.map(jax.numpy.asarray, b) for b in buckets
+                )
         mirror = dataclasses.replace(dataset, buckets=dev_buckets)
         with self._lock:
             if key not in self._mirrors:
                 self._mirrors[key] = mirror
                 self._entries[key] = (dev_buckets, int(nbytes), dataset)
+                if labels is not None:
+                    self._entry_labels[key] = labels
                 self._bytes += int(nbytes)
-                _CACHE_BYTES.inc(int(nbytes))
+                self._bytes_gauge(int(nbytes), labels)
                 _CACHE_ENTRIES.inc()
             mirror = self._mirrors[key]
         return mirror

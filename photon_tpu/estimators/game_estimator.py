@@ -371,10 +371,13 @@ class GameEstimator:
 
         prep: dict = {"train": {}, "norm": {}, "batches": {}}
         # One sweep cache per prepared bundle, shared across the whole
-        # config sweep (same data ⇒ one upload for every λ).
+        # config sweep (same data ⇒ one upload for every λ). Mesh-attached:
+        # pins shard over the entity axis (per-shard residency, per-device
+        # budget × device count) instead of pinning to device 0.
         prep["device_cache"] = DeviceSweepCache(
             None if self.sweep_cache_mb is None
-            else int(self.sweep_cache_mb * 1e6)
+            else int(self.sweep_cache_mb * 1e6),
+            mesh=self.mesh, entity_axis=self.data_axis,
         )
         shards_used = {
             c.feature_shard for c in self.coordinate_data_configs.values()

@@ -185,8 +185,14 @@ def _dual_need_bytes(e: int, s: int, p: int, u: int, esize: float) -> float:
                     + 12 * e * (2 * s + s + u))
 
 
-def newton_eligible(problem, bucket, normalization) -> bool:
-    """True when this bucket's solve may take the PRIMAL dense-Newton path."""
+def newton_eligible(problem, bucket, normalization, shards: int = 1) -> bool:
+    """True when this bucket's solve may take the PRIMAL dense-Newton path.
+
+    ``shards`` is the entity-axis mesh size: a sharded dispatch places
+    E/shards lanes per device, so the budget gate prices the PER-DEVICE
+    footprint — a bucket too big for one device's budget can still run
+    full-bucket across the mesh (the gates get MORE permissive, exactly
+    the reference's "add executors" scaling axis)."""
     if os.environ.get("PHOTON_RE_NEWTON", "") == "dual":
         return False  # test/debug override: route to the dual path
     if not _smooth_ok(problem, normalization):
@@ -196,10 +202,11 @@ def newton_eligible(problem, bucket, normalization) -> bool:
     if p > NEWTON_MAX_P:
         return False
     esize = float(np.dtype(bucket.val.dtype).itemsize)
-    return _primal_need_bytes(e, s, p, esize) <= _budget_bytes()
+    e_dev = -(-e // max(1, shards))
+    return _primal_need_bytes(e_dev, s, p, esize) <= _budget_bytes()
 
 
-def _largest_fitting_chunk(need_at, e: int):
+def _largest_fitting_chunk(need_at, e: int, multiple_of: int = 1):
     """Best blessed chunk size for an E-entity bucket, or None when even
     the smallest ladder size busts the budget. Padding lanes do FULL
     solver work, so a 2000-entity bucket should solve as 2x1024, not one
@@ -207,12 +214,17 @@ def _largest_fitting_chunk(need_at, e: int):
     worth an order of magnitude more dispatches (100K entities at chunk
     256 is 391 kernel calls). Rule: the LARGEST budget-fitting size whose
     total padded lanes ``ceil(E/C)*C`` stay within 12.5% of E; if none
-    qualifies (tiny buckets), the size minimizing padded lanes."""
+    qualifies (tiny buckets), the size minimizing padded lanes.
+    ``multiple_of`` (the entity-axis mesh size) filters the ladder to
+    sizes that shard evenly — a chunk that doesn't divide over the mesh
+    would leave devices with ragged lanes and re-lay the sharding out."""
     budget = _budget_bytes()
     fitting = []
     for c in chunk_ladder():
         if need_at(c) > budget:
             break  # ladder is sorted: larger sizes only need more
+        if c % multiple_of:
+            continue
         fitting.append(c)
         if c >= e:
             break  # larger sizes only add padding
@@ -225,11 +237,13 @@ def _largest_fitting_chunk(need_at, e: int):
 
 
 def newton_chunk_size(problem, bucket, normalization,
-                      max_p: int = NEWTON_MAX_P):
+                      max_p: int = NEWTON_MAX_P, shards: int = 1):
     """Blessed chunk size for an entity-sub-batched PRIMAL solve of this
     bucket, or None when the primal path is shape-excluded or even the
     smallest chunk busts the budget. ``max_p`` lets MEASURED routing admit
-    wider subspaces (NEWTON_CHUNK_MAX_P) than the static gate."""
+    wider subspaces (NEWTON_CHUNK_MAX_P) than the static gate. ``shards``
+    > 1 prices the per-device slice of each sharded chunk and restricts
+    the ladder to mesh-divisible sizes."""
     if os.environ.get("PHOTON_RE_NEWTON", "") == "dual":
         return None
     if not _smooth_ok(problem, normalization):
@@ -239,11 +253,14 @@ def newton_chunk_size(problem, bucket, normalization,
     if p > max_p:
         return None
     esize = float(np.dtype(bucket.val.dtype).itemsize)
+    sh = max(1, shards)
     return _largest_fitting_chunk(
-        lambda c: _primal_need_bytes(c, s, p, esize), e)
+        lambda c: _primal_need_bytes(-(-c // sh), s, p, esize), e,
+        multiple_of=sh)
 
 
-def dual_chunk_size(problem, bucket, normalization, u_max: int):
+def dual_chunk_size(problem, bucket, normalization, u_max: int,
+                    shards: int = 1):
     """Blessed chunk size for an entity-sub-batched DUAL solve, or None."""
     if not dual_precheck(problem, bucket, normalization):
         return None
@@ -252,8 +269,10 @@ def dual_chunk_size(problem, bucket, normalization, u_max: int):
     if s + u_max > DUAL_MAX_T:
         return None
     esize = float(np.dtype(bucket.val.dtype).itemsize)
+    sh = max(1, shards)
     return _largest_fitting_chunk(
-        lambda c: _dual_need_bytes(c, s, p, u_max, esize), e)
+        lambda c: _dual_need_bytes(-(-c // sh), s, p, u_max, esize), e,
+        multiple_of=sh)
 
 
 def dual_precheck(problem, bucket, normalization) -> bool:
@@ -274,8 +293,10 @@ def dual_precheck(problem, bucket, normalization) -> bool:
     return s < p and s <= DUAL_MAX_T
 
 
-def dual_eligible(problem, bucket, normalization, u_max: int) -> bool:
-    """True when this bucket may take the span-reduced Newton path."""
+def dual_eligible(problem, bucket, normalization, u_max: int,
+                  shards: int = 1) -> bool:
+    """True when this bucket may take the span-reduced Newton path.
+    ``shards`` prices the per-device slice (see ``newton_eligible``)."""
     if not dual_precheck(problem, bucket, normalization):
         return False
     e, s, _ = bucket.idx.shape
@@ -283,7 +304,8 @@ def dual_eligible(problem, bucket, normalization, u_max: int) -> bool:
     if s + u_max > DUAL_MAX_T:
         return False
     esize = float(np.dtype(bucket.val.dtype).itemsize)
-    return _dual_need_bytes(e, s, p, u_max, esize) <= _budget_bytes()
+    e_dev = -(-e // max(1, shards))
+    return _dual_need_bytes(e_dev, s, p, u_max, esize) <= _budget_bytes()
 
 
 def _dense_design(batches, dtype):
@@ -691,17 +713,25 @@ def _slice_pad_batches(batches, lo: int, hi: int, chunk: int):
 
 
 def _slice_pad_lanes(a, lo: int, hi: int, chunk: int, fill=0):
-    """One [E, ...] per-entity leaf sliced and padded to ``chunk`` lanes."""
+    """One [E, ...] per-entity leaf sliced and padded to ``chunk`` lanes.
+
+    Host numpy leaves stay HOST numpy (np.pad, not jnp.pad): under a mesh
+    the per-chunk placement device_puts each chunk row-sharded, and a host
+    source streams each shard straight to its device — a jnp.pad here
+    would first commit the chunk to the default device and pay the
+    transfer twice."""
     a = a[lo:hi]
     pad = chunk - (hi - lo)
     if pad:
         widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+        if isinstance(a, np.ndarray):
+            return np.pad(a, widths, constant_values=fill)
         a = jnp.pad(a, widths, constant_values=fill)
     return a
 
 
 def fit_bucket_in_chunks(fit_one, chunk: int, batches, w0, local_mask,
-                         local_prior):
+                         local_prior, put=None, ahead: int = 0):
     """Solve one bucket in entity chunks of a blessed size and restack.
 
     ``fit_one(batches, w0, local_mask, local_prior) -> (model, result)`` is
@@ -712,22 +742,43 @@ def fit_bucket_in_chunks(fit_one, chunk: int, batches, w0, local_mask,
     rows, mask 1 (so the ridge keeps their Hessians PD), and precision-0
     priors; they converge at the zero model on the first iteration and are
     sliced away before the restack.
+
+    ``put`` (optional) places each chunk's argument pytree before dispatch
+    — under a mesh it is the entity-sharded ``device_put`` that fans every
+    chunk out across the devices (each device owns ``chunk/n_devices``
+    lanes of EVERY chunk, so all devices work on every dispatch). With
+    ``ahead > 0`` the placements run through ``pipelined_puts`` so chunk
+    N+1's per-shard H2D is issued before chunk N's solve dispatches —
+    the RE-side analogue of the out-of-core ``ell_feed`` double buffer.
     """
     e = w0.shape[0]
-    outs = []
-    for lo in range(0, e, chunk):
-        hi = min(lo + chunk, e)
+    spans = [(lo, min(lo + chunk, e)) for lo in range(0, e, chunk)]
+
+    def args_for(span):
+        lo, hi = span
         sl_prior = (
             jax.tree.map(lambda a: _slice_pad_lanes(a, lo, hi, chunk),
                          local_prior)
             if local_prior is not None else None
         )
-        model, result = fit_one(
+        args = (
             _slice_pad_batches(batches, lo, hi, chunk),
             _slice_pad_lanes(w0, lo, hi, chunk),
             _slice_pad_lanes(local_mask, lo, hi, chunk, fill=1),
             sl_prior,
         )
+        return args if put is None else put(args)
+
+    if put is not None and ahead > 0 and len(spans) > 1:
+        from photon_tpu.io.prefetch import pipelined_puts
+
+        feed = pipelined_puts(spans, args_for, ahead=ahead)
+    else:
+        feed = (args_for(s) for s in spans)
+
+    outs = []
+    for (lo, hi), args in zip(spans, feed):
+        model, result = fit_one(*args)
         n = hi - lo
         outs.append(jax.tree.map(lambda a: a[:n], (model, result)))
     if len(outs) == 1:

@@ -243,7 +243,9 @@ def _pad_bucket(
 ) -> EntityBucket:
     """Pad the entity axis to a multiple of the mesh axis size with inert
     lanes: weight-0 rows, ghost row_ids (so no score scatters anywhere),
-    ghost proj columns, and entity_id −1."""
+    ghost proj columns, and entity_id −1. Host numpy buckets stay host
+    numpy (np.pad) so a subsequent SHARDED device_put streams each shard
+    straight to its device instead of round-tripping through device 0."""
     e = bucket.n_entities
     r = (-e) % multiple
     if r == 0:
@@ -251,6 +253,8 @@ def _pad_bucket(
 
     def pad(a, fill):
         widths = [(0, r)] + [(0, 0)] * (a.ndim - 1)
+        if isinstance(a, np.ndarray):
+            return np.pad(a, widths, constant_values=fill)
         return jnp.pad(a, widths, constant_values=fill)
 
     return EntityBucket(
@@ -287,7 +291,7 @@ def _plan_desc(solver: str, chunk) -> str:
 
 
 def _oom_next_tier(solver: str, chunk, e: int,
-                   vmapped_chunkable: bool = True):
+                   vmapped_chunkable: bool = True, multiple_of: int = 1):
     """The next-cheaper (solver, chunk) plan below ``(solver, chunk)`` for
     an E-entity bucket, or None when the degradation ladder is exhausted.
     ``chunk`` None means the full-bucket solve (effective chunk = E).
@@ -299,16 +303,17 @@ def _oom_next_tier(solver: str, chunk, e: int,
     nothing: an OOM below the cheapest plan is a real capacity wall.
     ``vmapped_chunkable=False`` (a per-entity normalization context is in
     play — it is NOT sliced by ``fit_bucket_in_chunks``) restricts the
-    vmapped fallback to the full-bucket dispatch."""
+    vmapped fallback to the full-bucket dispatch. ``multiple_of`` (the
+    entity-axis mesh size) keeps every chunked tier mesh-divisible."""
     from photon_tpu.game.newton_re import chunk_ladder
 
-    ladder = chunk_ladder()
+    ladder = [c for c in chunk_ladder() if c % max(1, multiple_of) == 0]
     eff = e if chunk is None else chunk
     smaller = [c for c in ladder if c < eff]
     if solver != "vmapped_lbfgs":
         if smaller:
             return solver, max(smaller)
-        if vmapped_chunkable and e > ladder[0]:
+        if vmapped_chunkable and ladder and e > ladder[0]:
             return "vmapped_lbfgs", ladder[0]
         return "vmapped_lbfgs", None
     if smaller and vmapped_chunkable:
@@ -316,9 +321,15 @@ def _oom_next_tier(solver: str, chunk, e: int,
     return None
 
 
-def _apply_sticky_plan(plan, sticky, e: int, vmapped_chunkable: bool = True):
+def _apply_sticky_plan(plan, sticky, e: int, vmapped_chunkable: bool = True,
+                       multiple_of: int = 1):
     """Clamp a static plan to the run's sticky OOM downshift (the proven-
-    too-big tiers are skipped outright instead of re-OOMing per sweep)."""
+    too-big tiers are skipped outright instead of re-OOMing per sweep).
+    Under a mesh (``multiple_of`` > 1) the clamped chunk snaps DOWN to the
+    nearest mesh-divisible blessed size so the sharded dispatch stays
+    even; a cap below every divisible size keeps the cap verbatim only
+    when it divides (else the smallest divisible tier — still cheaper per
+    device than the plan that OOM'd)."""
     if not sticky:
         return plan
     solver, chunk = plan
@@ -329,14 +340,40 @@ def _apply_sticky_plan(plan, sticky, e: int, vmapped_chunkable: bool = True):
         eff = e if chunk is None else chunk
         if eff > cap:
             chunk = cap
+            if multiple_of > 1 and chunk % multiple_of:
+                from photon_tpu.game.newton_re import chunk_ladder
+
+                div = [c for c in chunk_ladder()
+                       if c % multiple_of == 0]
+                under = [c for c in div if c <= cap]
+                # No mesh-divisible blessed size at all (a device count
+                # that divides no ladder entry): honor the cap with an
+                # off-ladder multiple rather than degrading to None — a
+                # FULL-bucket dispatch above the cap that just OOM'd would
+                # invert the sticky clamp into an unbounded solve.
+                chunk = (max(under) if under
+                         else (min(div) if div
+                               else max(multiple_of,
+                                        cap - cap % multiple_of)))
     if solver == "vmapped_lbfgs" and not vmapped_chunkable:
         chunk = None
     return solver, chunk
 
 
 def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
-                  local_prior, normalization, mesh_active=False):
+                  local_prior, normalization, mesh=None,
+                  entity_axis="data"):
     """Pick and dispatch one bucket's solver; ``(models, result, info)``.
+
+    Under a mesh the bucket runs ENTITY-SHARDED: full-bucket dispatches
+    place every per-entity array row-sharded over ``entity_axis``, and the
+    chunked Newton tiers — no longer skipped under mesh — slice blessed
+    mesh-divisible chunks host-side and fan each chunk's ``device_put``
+    out per shard (each device owns chunk/n lanes of every chunk), with
+    chunk N+1's transfer double-buffered behind chunk N's solve. Budget
+    gates price the PER-DEVICE slice, so a mesh widens what Newton admits.
+    Measured routing and the OOM ladder run under the mesh too; the cost
+    table keys carry the device count (``solver_routing.shape_class``).
 
     Smooth solves take a history-free batched Newton fast path
     (game/newton_re.py): primal dense Newton for small local dims,
@@ -400,7 +437,7 @@ def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
             return out
         return run
 
-    if mesh_active:
+    if mesh is not None:
         rec_primal = rec_dual = rec_vmapped = None
     else:
         from photon_tpu.runtime.compile_store import record_if_active
@@ -465,13 +502,55 @@ def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
     fits = {"newton_primal": fit_primal, "newton_dual": fit_dual,
             "vmapped_lbfgs": fit_vmapped}
 
+    # Entity-axis sharding (tentpole: chunked tiers run UNDER the mesh).
+    # ``place`` device_puts a pytree row-sharded over the entity axis —
+    # full-bucket dispatches place once (memoized), chunked dispatches
+    # place per chunk with the transfer double-buffered behind the solve.
+    if mesh is not None:
+        n_shards = axes_size(mesh, entity_axis)
+        _sharding = batch_sharding(mesh, entity_axis)
+
+        def place(tree):
+            return jax.tree.map(
+                lambda leaf: jax.device_put(leaf, _sharding), tree)
+    else:
+        n_shards = 1
+        place = None
+
+    if place is not None and local_norm is not None:
+        # Only the full-bucket vmapped dispatch consumes the normalization
+        # context (the chunked gates exclude it) — place it sharded once.
+        local_norm = place(local_norm)
+
+    _full_placed = [None]
+
+    def full_args():
+        """(batches, w0, mask, prior) for a FULL-bucket dispatch — placed
+        entity-sharded once per bucket under a mesh (every ladder retry
+        and the calibration race reuse the same placed arrays)."""
+        if place is None:
+            return batches, w0, local_mask, local_prior
+        if _full_placed[0] is None:
+            _full_placed[0] = place(
+                (batches, w0, local_mask, local_prior))
+        return _full_placed[0]
+
     def dispatch(solver, chunk):
         """One (solver, chunk) plan; ``chunk`` None = full bucket."""
         fit = fits[solver]
+        if mesh is not None:
+            # Chaos hook: error="device_lost" here simulates losing ONE
+            # shard of the mesh mid-dispatch; train_random_effects
+            # redistributes the bucket's entities over the surviving
+            # devices instead of restarting the world.
+            fault_point("re.shard", solver=solver, shards=n_shards,
+                        chunk=0 if chunk is None else chunk)
         if chunk is None:
-            return fit(batches, w0, local_mask, local_prior)
+            b, w, m, pr = full_args()
+            return fit(b, w, m, pr)
         return fit_bucket_in_chunks(
-            fit, chunk, batches, w0, local_mask, local_prior)
+            fit, chunk, batches, w0, local_mask, local_prior,
+            put=place, ahead=1 if place is not None else 0)
 
     def run_ladder(solver, chunk, downshifted=False):
         """Dispatch with the OOM degradation ladder (docs/robustness.md
@@ -503,7 +582,8 @@ def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
                 if not _mg.is_oom(err):
                     raise
                 nxt = _oom_next_tier(solver, chunk, int(w0.shape[0]),
-                                     vmapped_chunkable=local_norm is None)
+                                     vmapped_chunkable=local_norm is None,
+                                     multiple_of=n_shards)
                 before = _plan_desc(solver, chunk)
                 if nxt is None:
                     _mg.journal_event(
@@ -524,11 +604,10 @@ def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
 
     from photon_tpu.obs import retrace as _retrace_mod
 
-    sticky = None if mesh_active else _mg.sticky_plan("re.solve")
+    sticky = _mg.sticky_plan("re.solve")
 
     measured_oom = None
-    if (solver_routing.routing_mode() == "measured" and not mesh_active
-            and sticky is None):
+    if (solver_routing.routing_mode() == "measured" and sticky is None):
         def sync(out):
             np.asarray(out[1].value[:1])  # tiny D2H (repo-standard sync)
 
@@ -539,6 +618,7 @@ def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
             models, result, info = solver_routing.solve_measured(
                 problem, bucket, batches, w0, local_mask, local_prior,
                 normalization, get_u_max(), fits.__getitem__, sync,
+                shards=n_shards, place=place,
             )
             return finish(models, result, **info)
         except Exception as err:  # noqa: BLE001 - classified below
@@ -552,39 +632,39 @@ def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
             measured_oom = err
 
     # Static preference ladder (now expressed as a plan): full primal ->
-    # full dual -> chunked primal -> chunked dual -> vmapped. Chunked
-    # tiers and the OOM ladder are skipped under a mesh — the bucket was
-    # padded to the entity-axis size and sharded over it, and chunk
-    # slicing would break that contract.
+    # full dual -> chunked primal -> chunked dual -> vmapped. Under a mesh
+    # the full tiers gate on the PER-DEVICE footprint and the chunked
+    # tiers pick mesh-divisible blessed sizes (each chunk itself sharded),
+    # so every tier runs under the mesh instead of being skipped.
     plan = ("vmapped_lbfgs", None)
-    if newton_eligible(problem, bucket, normalization):
+    if newton_eligible(problem, bucket, normalization, shards=n_shards):
         plan = ("newton_primal", None)
     else:
         u_max = get_u_max()
         if u_max >= 0 and dual_eligible(problem, bucket, normalization,
-                                        u_max):
+                                        u_max, shards=n_shards):
             plan = ("newton_dual", None)
-        elif not mesh_active:
-            chunk = newton_chunk_size(problem, bucket, normalization)
+        else:
+            chunk = newton_chunk_size(problem, bucket, normalization,
+                                      shards=n_shards)
             if chunk:
                 plan = ("newton_primal", chunk)
             else:
                 chunk = (dual_chunk_size(problem, bucket, normalization,
-                                         u_max) if u_max >= 0 else None)
+                                         u_max, shards=n_shards)
+                         if u_max >= 0 else None)
                 if chunk:
                     plan = ("newton_dual", chunk)
 
-    if mesh_active:
-        models, result = dispatch(*plan)
-        return finish(models, result, solver=plan[0], chunk=plan[1])
-
     clamped = _apply_sticky_plan(plan, sticky, int(w0.shape[0]),
-                                 vmapped_chunkable=local_norm is None)
+                                 vmapped_chunkable=local_norm is None,
+                                 multiple_of=n_shards)
     if measured_oom is not None:
         # Demote one tier below the static plan and make it sticky, so
         # later buckets skip the measured winner that cannot fit.
         nxt = _oom_next_tier(*clamped, int(w0.shape[0]),
-                             vmapped_chunkable=local_norm is None)
+                             vmapped_chunkable=local_norm is None,
+                             multiple_of=n_shards)
         before = f"measured({_plan_desc(*clamped)})"
         if nxt is None:
             _mg.journal_event(
@@ -603,6 +683,106 @@ def _solve_bucket(problem, bucket, batches, w0, local_mask, local_norm,
     models, result, solver, chunk = run_ladder(
         *clamped, downshifted=clamped != plan)
     return finish(models, result, solver=solver, chunk=chunk)
+
+
+# ------------------------------------------------------ shard-loss recovery
+
+_RE_SHARD_LOSSES = _OBS_REGISTRY.counter(
+    "re_shard_losses_total",
+    "Mesh shards lost mid-RE-solve and absorbed by entity redistribution "
+    "(docs/robustness.md §shard loss)",
+)
+
+
+def _alive_devices(devices, want: int):
+    """The first ``want`` devices that answer a trivial device_put probe —
+    after a real shard loss the dead device must not land in the degraded
+    mesh. Cheap (one tiny put + D2H fetch per device, stops at ``want``).
+    The fetch IS the sync: the repo-standard tiny D2H read, because
+    ``block_until_ready`` does not synchronize on the axon tunnel backend
+    and would let a dead device pass the probe."""
+    alive = []
+    for d in devices:
+        try:
+            np.asarray(jax.device_put(np.zeros((1,), np.float32), d))
+            alive.append(d)
+        except Exception:  # noqa: BLE001 - a dead device is the point
+            continue
+        if len(alive) >= want:
+            break
+    return alive
+
+
+def _degrade_mesh(mesh, entity_axis):
+    """The next-smaller entity mesh after a shard loss, or None when no
+    degradation exists (single device). The surviving size is the LARGEST
+    PROPER DIVISOR of the current axis size (8 → 4): the already-padded
+    entity axes and the blessed pow-2 chunk ladder stay evenly divisible,
+    so the redistributed re-solve reuses the same chunk contract. The
+    choice is STICKY for the run (``memory_guard`` sticky plan ``re.shard``)
+    — later buckets and sweeps start degraded instead of re-failing."""
+    from photon_tpu.parallel.mesh import axes_size as _axes_size
+    from photon_tpu.parallel.mesh import axis_tuple, make_mesh
+    from photon_tpu.runtime import memory_guard as _mg
+
+    n = _axes_size(mesh, entity_axis)
+    if n <= 1:
+        return None
+    m = next(n // k for k in range(2, n + 1) if n % k == 0)
+    devices = list(np.asarray(mesh.devices).flat)
+    alive = _alive_devices(devices, m)
+    if len(alive) < m:
+        return None  # not enough survivors for an even degraded mesh
+    axis = axis_tuple(entity_axis)[-1]
+    _mg.set_sticky_plan("re.shard", {"shards": m})
+    return make_mesh({axis: m}, devices=alive), axis
+
+
+def _effective_mesh(mesh, entity_axis):
+    """Apply the run's sticky shard degradation (a shard lost earlier in
+    this run) to a caller-supplied mesh before any solve dispatches."""
+    from photon_tpu.parallel.mesh import axes_size as _axes_size
+    from photon_tpu.parallel.mesh import axis_tuple, make_mesh
+    from photon_tpu.runtime import memory_guard as _mg
+
+    sticky = _mg.sticky_plan("re.shard")
+    if not sticky:
+        return mesh, entity_axis
+    m = int(sticky.get("shards") or 0)
+    n = _axes_size(mesh, entity_axis)
+    if m <= 0 or m >= n:
+        return mesh, entity_axis
+    devices = list(np.asarray(mesh.devices).flat)
+    alive = _alive_devices(devices, m)
+    if len(alive) < m:
+        return mesh, entity_axis
+    axis = axis_tuple(entity_axis)[-1]
+    return make_mesh({axis: m}, devices=alive), axis
+
+
+def _shard_lost_recover(err, **ctx) -> None:
+    """One absorbed shard loss: classified recovery-journal row (via the
+    supervisor-registered journal when one is active, else the trace
+    instant), metric bump, and the shared device-loss recovery step
+    (executable-cache purge + sweep-cache release + compile-store prewarm
+    — ``backend_guard.recover_from_device_loss``)."""
+    import logging
+
+    from photon_tpu.runtime import backend_guard as _bg
+    from photon_tpu.runtime import memory_guard as _mg
+
+    log = logging.getLogger("photon_tpu.game")
+    cause = _bg.classify_backend_error(err)
+    _RE_SHARD_LOSSES.inc()
+    _mg.journal_event(
+        "shard_lost", site="re.shard", cause=cause,
+        error=f"{type(err).__name__}: {str(err)[:200]}", **ctx)
+    log.warning(
+        "mesh shard lost mid-RE-solve (%s: %s) — redistributing bucket %s "
+        "entities over %s devices (recovery %s)", type(err).__name__, err,
+        ctx.get("bucket"), ctx.get("devices_after"), ctx.get("recovery"))
+    _bg.recover_from_device_loss(
+        f"re shard loss (bucket {ctx.get('bucket')})", logger=log)
 
 
 def train_random_effects(
@@ -637,6 +817,12 @@ def train_random_effects(
     want_var = problem.variance_type.name != "NONE"
     LAST_BUCKET_TIMINGS.clear()
     _want_timings = _os.environ.get("PHOTON_RE_TIMINGS") == "1"
+
+    # A shard lost earlier in this run degraded the mesh stickily; apply it
+    # before any placement so this call never re-discovers the dead device.
+    if mesh is not None:
+        mesh, entity_axis = _effective_mesh(mesh, entity_axis)
+    shard_recoveries = 0
 
     for b_i, bucket in enumerate(dataset.buckets):
         orig_e = bucket.n_entities
@@ -678,14 +864,9 @@ def train_random_effects(
                 lambda a: jnp.pad(a, ((0, pad), (0, 0))), local_prior
             )
 
-        if mesh is not None:
-            sharding = batch_sharding(mesh, entity_axis)
-            shard = lambda leaf: jax.device_put(leaf, sharding)
-            batches = jax.tree.map(shard, batches)
-            w0 = shard(w0)
-            local_mask = shard(local_mask)
-            local_norm = jax.tree.map(shard, local_norm)
-            local_prior = jax.tree.map(shard, local_prior)
+        # Placement now happens INSIDE _solve_bucket (full-bucket plans
+        # place once; chunked plans slice host-side and fan each chunk's
+        # device_put out per shard with the transfer double-buffered).
 
         # H2D boundary: with host_resident buckets the arrays above are
         # still host numpy; under PHOTON_RE_TIMINGS=1 force the transfer
@@ -693,7 +874,9 @@ def train_random_effects(
         # synchronize on the axon tunnel backend) to split per-bucket time
         # into transfer vs solve. NOT default: the two syncs per bucket
         # would serialize the async dispatcher's transfer/compute overlap.
-        if _want_timings:
+        # Mesh runs skip it: committing to the default device here would
+        # double-transfer everything the sharded placement re-puts.
+        if _want_timings and mesh is None:
             batches = jax.tree.map(jnp.asarray, batches)
             np.asarray(batches.features.val.ravel()[:1])
         _t_h2d = _time.perf_counter()
@@ -711,16 +894,64 @@ def train_random_effects(
         # finally+exc_info, which could pick up an unrelated exception a
         # caller is mid-handling) so a failing bucket lands in the
         # timeline error-tagged and a clean one never does.
-        try:
-            models, result, info = _solve_bucket(
-                problem, bucket, batches, w0, local_mask, local_norm,
-                local_prior, normalization, mesh_active=mesh is not None,
-            )
-        except BaseException:
-            import sys as _sys
+        while True:
+            try:
+                models, result, info = _solve_bucket(
+                    problem, bucket, batches, w0, local_mask, local_norm,
+                    local_prior, normalization, mesh=mesh,
+                    entity_axis=entity_axis,
+                )
+                break
+            except KeyboardInterrupt:
+                raise  # a user abort is never a shard loss
+            except BaseException as _err:
+                # Single-shard device loss under a mesh (docs/robustness.md
+                # §"Shard loss"): redistribute this bucket's entities over
+                # the surviving devices and re-solve — don't restart the
+                # world. Anything else (or an exhausted recovery budget)
+                # propagates with the span error-tagged.
+                from photon_tpu.runtime import backend_guard as _bg
 
-            re_span.set(solver=info["solver"]).__exit__(*_sys.exc_info())
-            raise
+                degraded = (
+                    _degrade_mesh(mesh, entity_axis)
+                    if (mesh is not None and _bg.is_device_lost(_err)
+                        and shard_recoveries < _bg.max_inrun_recoveries())
+                    else None
+                )
+                rehosted = None
+                if degraded is not None:
+                    # The retry must not read solve inputs sharded over
+                    # the OLD mesh (a cache-mirror bucket has a shard ON
+                    # the dead device): pull everything to host numpy
+                    # first. If the pull itself fails, the source data
+                    # died with the device — the bucket is unrecoverable
+                    # in-process, so escalate to the caller's checkpoint-
+                    # based recovery (descent re-enters from the host
+                    # originals) instead of burning the recovery budget
+                    # on re-reads that can never succeed.
+                    try:
+                        rehosted = jax.tree.map(
+                            np.asarray,
+                            (bucket, batches, w0, local_mask, local_prior),
+                        )
+                    except Exception:  # noqa: BLE001 - data lost with device
+                        degraded = None
+                if degraded is None:
+                    import sys as _sys
+
+                    re_span.set(solver=info["solver"]).__exit__(
+                        *_sys.exc_info())
+                    raise
+                bucket, batches, w0, local_mask, local_prior = rehosted
+                shard_recoveries += 1
+                old_n = axes_size(mesh, entity_axis)
+                mesh, entity_axis = degraded
+                _shard_lost_recover(
+                    _err, bucket=b_i, coordinate=dataset.re_type,
+                    entities=orig_e, devices_before=old_n,
+                    devices_after=axes_size(mesh, entity_axis),
+                    recovery=shard_recoveries,
+                )
         # Compile/solve split on the span (VERDICT r5 weak #6: decision-
         # grade artifacts need first-call XLA compile separated out).
         re_span.set(

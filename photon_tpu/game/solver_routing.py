@@ -78,12 +78,20 @@ def routing_mode() -> str:
     return mode
 
 
-def shape_class(bucket) -> str:
+def shape_class(bucket, shards: int = 1) -> str:
     """Bucket shape key for the cost table: rows-per-entity S, ELL width K,
     local dim P, dtype. Entity count E is EXCLUDED — per-entity solve cost
-    is what the table stores, and chunking makes it E-independent."""
+    is what the table stores, and chunking makes it E-independent.
+
+    ``shards`` (the entity-axis mesh size) lands in the key as a ``@devN``
+    suffix: a per-entity cost measured across an N-device mesh prices the
+    collective dispatch + per-device slice and is NOT comparable to a
+    single-device cost, so a table persisted by an 8-device run can never
+    steer a 1-device restart (and vice versa) — the same refusal contract
+    as the bench gate's cross-device-count comparisons."""
     _, s, k = bucket.idx.shape
-    return f"s{s}k{k}p{bucket.local_dim}:{np.dtype(bucket.val.dtype).name}"
+    key = f"s{s}k{k}p{bucket.local_dim}:{np.dtype(bucket.val.dtype).name}"
+    return key if shards <= 1 else f"{key}@dev{shards}"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -168,6 +176,24 @@ class SolverCostTable:
         with open(path) as f:
             self.load_json(json.load(f))
 
+    def merge(self, other: "SolverCostTable") -> None:
+        """Merge another table's measurements in (mean where both measured
+        a candidate, adopt where only one did). The multi-process mesh
+        story: each host races its local shard of the calibration probe
+        and the driver merges per-host tables into ONE persisted table —
+        keys carry the device count (``shape_class`` ``@devN`` suffix), so
+        merging never averages across different mesh sizes."""
+        with other._lock:
+            theirs = {k: dict(v) for k, v in other._entries.items()}
+        with self._lock:
+            for key, cands in theirs.items():
+                mine = self._entries.setdefault(key, {})
+                for ck, cost in cands.items():
+                    if ck in mine:
+                        mine[ck] = 0.5 * (mine[ck] + float(cost))
+                    else:
+                        mine[ck] = float(cost)
+
     def reset(self) -> None:
         with self._lock:
             self._entries.clear()
@@ -204,23 +230,28 @@ def reset_process_table() -> None:
         _loaded_paths.clear()
 
 
-def candidates_for(problem, bucket, normalization, u_max: int) -> list:
+def candidates_for(problem, bucket, normalization, u_max: int,
+                   shards: int = 1) -> list:
     """Feasible chunked candidates for this bucket, Newton variants first.
 
     The primal candidate is admitted up to ``NEWTON_CHUNK_MAX_P`` (wider
     than the static gate): in (64, 128] the dense Hessian may or may not
     beat L-BFGS depending on S — exactly the call the race exists to make.
     The vmapped baseline is always feasible and always raced, so "Newton
-    by default" is a measured claim, not an assumption.
+    by default" is a measured claim, not an assumption. ``shards`` > 1
+    restricts chunks to mesh-divisible blessed sizes and prices the
+    per-device slice (``newton_re.newton_chunk_size``).
     """
     out = []
     c = newton_re.newton_chunk_size(
-        problem, bucket, normalization, max_p=newton_re.NEWTON_CHUNK_MAX_P)
+        problem, bucket, normalization, max_p=newton_re.NEWTON_CHUNK_MAX_P,
+        shards=shards)
     if c:
         out.append(Candidate("newton_primal", c))
     # u_max < 0 means the caller's dual precheck already refused the bucket
     # (so the device-synced unpenalized-column count was never computed).
-    c = (newton_re.dual_chunk_size(problem, bucket, normalization, u_max)
+    c = (newton_re.dual_chunk_size(problem, bucket, normalization, u_max,
+                                   shards=shards)
          if u_max >= 0 else None)
     if c:
         out.append(Candidate("newton_dual", c))
@@ -228,10 +259,13 @@ def candidates_for(problem, bucket, normalization, u_max: int) -> list:
         # Baseline races (and, if it wins, executes) at a capped chunk:
         # probing full-history L-BFGS at a 16K-entity chunk would cost more
         # than the race saves, and its per-entity cost is nearly flat in
-        # chunk size. Probe shape == execution shape either way.
+        # chunk size. Probe shape == execution shape either way. Under a
+        # mesh the cap rounds down to a shard-divisible size.
+        cap = VMAPPED_CHUNK_CAP
+        if shards > 1:
+            cap = max(shards, cap - cap % shards)
         out.append(Candidate(
-            "vmapped_lbfgs",
-            min(max(cand.chunk for cand in out), VMAPPED_CHUNK_CAP)))
+            "vmapped_lbfgs", min(max(cand.chunk for cand in out), cap)))
     return out
 
 
@@ -247,6 +281,8 @@ def solve_measured(
     fit_for: Callable[[str], Callable],
     sync: Callable,
     table: Optional[SolverCostTable] = None,
+    shards: int = 1,
+    place: Optional[Callable] = None,
 ):
     """Route one bucket through the measured cost table.
 
@@ -256,13 +292,21 @@ def solve_measured(
     solve output to the host (the repo-standard tiny-D2H sync —
     ``block_until_ready`` does not synchronize on the axon tunnel backend).
 
+    Under a mesh (``shards`` > 1, ``place`` the entity-sharded device_put)
+    the calibration probes dispatch SHARDED — every device races its slice
+    of the probe chunk concurrently, so one timed probe IS the per-device
+    calibration, merged by construction — and costs land under the
+    ``@devN``-suffixed shape key (``shape_class``), persisted with the
+    device count so cross-mesh routing can never cross-read.
+
     Returns ``(models, result, info)`` with ``info`` carrying the routing
     decision and the calibration cost:
     ``{solver, chunk, routing, calibration_seconds, calibrated}``.
     """
     table = table if table is not None else process_table()
-    key = shape_class(bucket)
-    cands = candidates_for(problem, bucket, normalization, u_max)
+    key = shape_class(bucket, shards)
+    cands = candidates_for(problem, bucket, normalization, u_max,
+                           shards=shards)
     info = {"routing": "measured", "calibration_seconds": 0.0,
             "calibrated": False}
 
@@ -272,8 +316,10 @@ def solve_measured(
         # chunked-primal cap, or nothing fits the budget): nothing to race
         # — the general vmapped path solves the whole bucket unchunked,
         # exactly as static routing would.
-        models, result = fit_for("vmapped_lbfgs")(
-            batches, w0, local_mask, local_prior)
+        args = (batches, w0, local_mask, local_prior)
+        if place is not None:
+            args = place(args)
+        models, result = fit_for("vmapped_lbfgs")(*args)
         info.update(solver="vmapped_lbfgs", chunk=None)
         return models, result, info
 
@@ -300,6 +346,10 @@ def solve_measured(
                         a, 0, probe_e, cand.chunk), local_prior)
                  if local_prior is not None else None),
             )
+            if place is not None:
+                # Probe shape == execution shape INCLUDING the sharding:
+                # the race times the sharded dispatch the real solve uses.
+                probe_args = place(probe_args)
             # ONE sync-timed probe per candidate; the XLA compile it pays
             # (host-synchronous before dispatch returns) is measured by the
             # sentinel watch and subtracted, so the recorded cost is the
@@ -325,6 +375,7 @@ def solve_measured(
 
     fit_one = fit_for(win.solver)
     models, result = newton_re.fit_bucket_in_chunks(
-        fit_one, win.chunk, batches, w0, local_mask, local_prior)
+        fit_one, win.chunk, batches, w0, local_mask, local_prior,
+        put=place, ahead=1 if place is not None else 0)
     info.update(solver=win.solver, chunk=win.chunk)
     return models, result, info

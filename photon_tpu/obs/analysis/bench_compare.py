@@ -172,6 +172,17 @@ def compare_pair(
                 f"({name}): [{fo.get('cause', 'unknown')}] → "
                 f"{fo.get('to', 'cpu')} — this round ran on the failover "
                 "backend; accelerator comparisons are withheld")
+    # Cross-device-count refusal (same contract as the PR 6 cross-backend
+    # refusal): an 8-device mesh round and a 1-device round measure
+    # different programs (collectives, sharded kernels, per-shard feeds),
+    # so every delta between them is a topology change, not a regression.
+    ndo, ndn = po.get("n_devices"), pn.get("n_devices")
+    devices_differ = bool(ndo and ndn and int(ndo) != int(ndn))
+    if devices_differ:
+        notes.append(
+            f"device counts differ: {ndo} (old) vs {ndn} (new) — "
+            "cross-device-count comparisons are incomparable; re-run on "
+            "the same mesh for a scored verdict")
     for key, label in (("jax_version", "jax version"),
                        ("hostname", "host")):
         vo, vn = po.get(key), pn.get(key)
@@ -202,9 +213,11 @@ def compare_pair(
             deltas.append(MetricDelta(
                 metric, vo, vn, bo, bn, "missing"))
             continue
-        if bo == "unknown" or bn == "unknown" or bo != bn:
-            # A cross-backend (or unplaceable) delta is not a regression
-            # and not an improvement — it is a hardware change.
+        if (bo == "unknown" or bn == "unknown" or bo != bn
+                or devices_differ):
+            # A cross-backend (or unplaceable, or cross-device-count)
+            # delta is not a regression and not an improvement — it is a
+            # hardware/topology change.
             deltas.append(MetricDelta(metric, vo, vn, bo, bn, "incomparable"))
             continue
         # delta_pct is None when old == 0 (no relative change exists, and

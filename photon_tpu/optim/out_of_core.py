@@ -447,11 +447,66 @@ def _kernels_for(loss, dim: int):
     return _matvec_for(dim), k_probe, k_probe_at_t, k_grad
 
 
-def _mesh_puts(mesh, data_axis: str, chunk_rows: int):
+@functools.lru_cache(maxsize=None)
+def _kernels_for_spmd(loss, dim: int, mesh, axes: tuple):
+    """Explicit-collective variants of :func:`_kernels_for`: every kernel is
+    a ``shard_map`` body over the row axis with ONE ``lax.psum`` where the
+    dense path has a row reduction — the out-of-core consumption of the
+    ``parallel/spmd_objective`` pattern (treeAggregate ≙ psum, SURVEY.md
+    §2.2). Same signatures, same results to fp noise; selected by
+    ``OutOfCoreLBFGS(collectives="shard_map")``. Cached per
+    (loss, dim, mesh, axes) so a λ-sweep never recompiles."""
+    from functools import partial as _partial
+
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from photon_tpu.parallel.mesh import shard_map
+
+    row, ell = P(axes), P(axes, None)
+    smap = _partial(shard_map, mesh=mesh)
+
+    @jax.jit
+    @_partial(smap, in_specs=(P(), ell, ell, row), out_specs=row)
+    def k_matvec(w, idx, val, offsets):
+        sf = SparseFeatures(idx=idx, val=val, dim=dim)
+        return sf.matvec(w) + offsets
+
+    @jax.jit
+    @_partial(smap, in_specs=(row, row, row), out_specs=P())
+    def k_probe(z, labels, weights):
+        return lax.psum(jnp.sum(weights * loss.loss(z, labels)), axes)
+
+    @jax.jit
+    @_partial(smap, in_specs=(row, row, P(), row, row), out_specs=P())
+    def k_probe_at_t(z, zd, t, labels, weights):
+        return lax.psum(
+            jnp.sum(weights * loss.loss(z + t * zd, labels)), axes)
+
+    @jax.jit
+    @_partial(smap, in_specs=(row, row, row, ell, ell),
+              out_specs=(P(), P()))
+    def k_grad(z, labels, weights, idx, val):
+        lv, d1 = loss.loss_and_d1(z, labels)
+        sf = SparseFeatures(idx=idx, val=val, dim=dim)
+        return (lax.psum(jnp.sum(weights * lv), axes),
+                lax.psum(sf.rmatvec(weights * d1), axes))
+
+    return k_matvec, k_probe, k_probe_at_t, k_grad
+
+
+def _mesh_puts(mesh, data_axis, chunk_rows: int):
     """``(put_row, put_ell, put_rep)`` placement helpers shared by every
     streamed solver: row-sharded resident vectors, row-sharded ELL chunk
     streams, replicated coefficient-space state (SURVEY.md §2.6 P1 × OOC).
-    With no mesh all three are the identity."""
+    ``data_axis`` may be one mesh axis or a tuple (``("dcn", "data")`` on a
+    2-level multi-slice mesh). With no mesh all three are the identity.
+
+    The row/ELL puts are the "fan out per shard" half of the streamed data
+    path: ``jax.device_put`` with a NamedSharding splits the host chunk
+    into per-device shards and issues each shard's H2D directly to its
+    device — wrapped in ``pipelined_puts`` by ``ell_feed`` so shard
+    transfers for chunk N+1 overlap chunk N's compute."""
     if mesh is None:
         def ident(a):
             return a
@@ -459,15 +514,18 @@ def _mesh_puts(mesh, data_axis: str, chunk_rows: int):
         return ident, ident, ident
     from jax.sharding import NamedSharding, PartitionSpec
 
-    nsh = mesh.shape[data_axis]
+    from photon_tpu.parallel.mesh import axes_size, axis_tuple
+
+    axes = axis_tuple(data_axis)
+    nsh = axes_size(mesh, axes)
     if chunk_rows % nsh != 0:
         raise ValueError(
             f"chunk_rows={chunk_rows} must divide evenly over "
             f"mesh axis {data_axis!r} ({nsh} devices) for "
             "row-sharded streaming"
         )
-    _row = NamedSharding(mesh, PartitionSpec(data_axis))
-    _ell = NamedSharding(mesh, PartitionSpec(data_axis, None))
+    _row = NamedSharding(mesh, PartitionSpec(axes))
+    _ell = NamedSharding(mesh, PartitionSpec(axes, None))
     _rep = NamedSharding(mesh, PartitionSpec())
 
     def put_row(a):
@@ -515,6 +573,11 @@ class OutOfCoreLBFGS:
     # re-cast as GSPMD (SURVEY.md §2.2 "Distributed objective").
     mesh: Optional[object] = None
     data_axis: str = "data"
+    # Collective lowering under a mesh: "gspmd" (default — sharded inputs,
+    # XLA inserts the all-reduces) or "shard_map" (explicit psum kernels
+    # from _kernels_for_spmd — hand-placed collectives for multi-slice
+    # meshes / auditability; same results to fp noise, tested).
+    collectives: str = "gspmd"
     # Device-resident sweep cache (photon_tpu/data/device_cache.py): streamed
     # ELL chunks pin on device after the first pass that touches them, so a
     # multi-iteration solve (and a multi-sweep GAME fit re-entering it) stops
@@ -537,7 +600,18 @@ class OutOfCoreLBFGS:
         closures ``(put_rep, stream_scores, data_value, data_value_at_t,
         stream_grad)``
         every out-of-core solver loop is built from."""
-        k_matvec, k_probe, k_probe_at_t, k_grad = self._kernels(data.dim)
+        if self.mesh is not None and self.collectives == "shard_map":
+            from photon_tpu.parallel.mesh import axis_tuple
+
+            k_matvec, k_probe, k_probe_at_t, k_grad = _kernels_for_spmd(
+                self.loss, data.dim, self.mesh,
+                tuple(axis_tuple(self.data_axis)))
+        elif self.collectives not in ("gspmd", "shard_map"):
+            raise ValueError(
+                f"collectives must be 'gspmd' or 'shard_map', "
+                f"got {self.collectives!r}")
+        else:
+            k_matvec, k_probe, k_probe_at_t, k_grad = self._kernels(data.dim)
         put_row, put_ell, put_rep = _mesh_puts(
             self.mesh, self.data_axis, data.chunk_rows
         )
@@ -1168,7 +1242,8 @@ def scores_out_of_core(data: ChunkedGLMData, w) -> np.ndarray:
 
 def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
                     progress=None, checkpoint_path=None, mesh=None,
-                    data_axis="data", device_cache=None, primed=None):
+                    data_axis="data", device_cache=None, primed=None,
+                    collectives="gspmd"):
     """Problem-level entry mirroring ``GLMOptimizationProblem.run`` for the
     out-of-core path: same task→loss mapping, regularization/reg-mask
     semantics, and ``(GLMModel, OptimizerResult)`` return. LBFGS handles
@@ -1192,6 +1267,7 @@ def run_out_of_core(problem, data: ChunkedGLMData, w0=None, reg_mask=None,
         checkpoint_path=checkpoint_path,
         mesh=mesh,
         data_axis=data_axis,
+        collectives=collectives,
         device_cache=device_cache,
     )
     if problem.optimizer_type == OptimizerType.OWLQN:

@@ -12,6 +12,10 @@ from photon_tpu.parallel.data_parallel import (  # noqa: F401
     fit_data_parallel,
     spmd_value_and_grad,
 )
+from photon_tpu.parallel.spmd_objective import (  # noqa: F401
+    SpmdGLMObjective,
+    fit_spmd,
+)
 from photon_tpu.parallel.distributed import (  # noqa: F401
     global_batch_from_local,
     initialize_distributed,

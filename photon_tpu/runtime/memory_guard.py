@@ -428,7 +428,9 @@ def sweep_budget_scale() -> float:
 
 
 def effective_sweep_budget(requested_bytes: int) -> int:
-    """The budget a ``DeviceSweepCache`` actually gets:
+    """The PER-DEVICE budget a ``DeviceSweepCache`` actually gets (the
+    cache multiplies by its mesh's entity-axis device count — its pins are
+    sharded, so each device carries 1/n of the total):
 
     * scaled by the run's degradation multiplier (an OOM-pre-degraded
       restart must not re-pin the budget that just killed the attempt);
