@@ -943,11 +943,24 @@ def bench_serve():
         # Tracing-overhead sub-measurement: two identical sequential
         # volleys over one connection, tracing off vs on; the p50 delta IS
         # the per-request instrumentation cost (span objects + event
-        # appends on the request/queue/kernel path).
+        # appends on the request/queue/kernel path). Re-measured as-is
+        # with the fleet changes in place — the anchor is stamped once at
+        # collector install and the size-bound check is one estimate +
+        # compare per event, so the per-request cost must not move.
+        # The "on" volley's collector is written as a fleet trace SHARD
+        # (anchor + role) — the input to the report-generation figure
+        # below.
+        from photon_tpu.obs import set_process_role
+
+        set_process_role("serving")
+        telemetry_dir = os.path.join(td, "telemetry")
+        trace_shard = os.path.join(
+            telemetry_dir, f"trace.serving.{os.getpid()}.json")
         n_ovh = 64 if SMOKE else 256
         ovh = {}
         for mode in ("off", "on"):
-            ctx = tracing() if mode == "on" else suspend_tracing()
+            ctx = tracing(trace_shard) if mode == "on" \
+                else suspend_tracing()
             with ctx:
                 conn = http.client.HTTPConnection(host, port, timeout=30)
                 mine = [fire(conn, payloads[i % len(payloads)])
@@ -999,6 +1012,28 @@ def bench_serve():
                     r.name for r in slo_report.violations],
             }
         server.shutdown()
+        # Fleet run-report generation figure (docs/observability.md
+        # §"Fleet view"): finish the telemetry shard layout for this
+        # stage's artifacts (traced volley's trace shard + a metrics
+        # JSONL history + this process's registry shard), then time the
+        # full merge + report build — the operator-facing cost of the
+        # report CLI, SLO-gateable like any flat key.
+        from photon_tpu.obs.analysis.report import build_report
+        from photon_tpu.obs.fleet import write_registry_shard
+        from photon_tpu.utils import write_metrics_jsonl
+
+        write_metrics_jsonl(
+            os.path.join(telemetry_dir,
+                         f"metrics.serving.{os.getpid()}.jsonl"),
+            [snap, deg_snap])
+        write_registry_shard(
+            os.path.join(telemetry_dir,
+                         f"registry.serving.{os.getpid()}.json"),
+            registries=[server.metrics])
+        t_rep = time.perf_counter()
+        fleet_report = build_report(telemetry_dir)
+        fleet_report_s = time.perf_counter() - t_rep
+        mt = fleet_report.get("merged_trace") or {}
     if worker_errors:
         # A dead worker's rows never reach `lat`; reporting the surviving
         # throughput would bank a silently-skewed number.
@@ -1037,6 +1072,12 @@ def bench_serve():
         "serve_trace_overhead_p50_ms": round(
             (ovh["on"][len(ovh["on"]) // 2]
              - ovh["off"][len(ovh["off"]) // 2]) * 1e3, 3),
+        # Fleet report generation over this stage's telemetry shards:
+        # wall time + merged span count (flat, SLO-gateable).
+        "serve_fleet_report_seconds": round(fleet_report_s, 3),
+        "serve_fleet_merged_trace_spans": int(mt.get("spans") or 0),
+        "serve_fleet_anomalies": int(
+            (fleet_report.get("anomalies") or {}).get("n_anomalies", 0)),
         **slo_metrics,
     }
 

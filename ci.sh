@@ -97,6 +97,17 @@ echo "== online smoke (streaming delta trainer -> live server; docs/online.md) =
 # publication.
 python scripts/online_smoke.py
 
+echo "== fleet smoke (3-process telemetry aggregation + run report; docs/observability.md §Fleet view) =="
+# The fleet-observability layer against REAL process boundaries: training
+# driver, serving server, and online trainer run as three separate
+# processes sharing one --telemetry-dir; the report CLI must then merge
+# their trace shards into one timeline carrying all three roles with >= 1
+# cross-process trace-id join (online publish -> serving patch apply),
+# fold the registry shards, produce a schema-valid run report, report
+# ZERO anomalies on the clean run, and flag an injected latency level
+# shift in the serving metrics JSONL.
+python scripts/fleet_smoke.py
+
 echo "== bench analysis (advisory compare of newest artifacts + doc sync) =="
 # Backend-aware regression gate over the two newest checked-in bench
 # artifacts (docs/observability.md §gate). ADVISORY: verdicts print on

@@ -30,20 +30,42 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="shard spec (repeatable); default 'global:features'")
     p.add_argument("--num-partitions", type=int, default=1,
                    help="hash partitions per store (reference PalDB partitions)")
-    from photon_tpu.cli.params import add_backend_policy_flag
+    from photon_tpu.cli.params import (
+        add_backend_policy_flag,
+        add_telemetry_flag,
+        add_trace_flag,
+    )
 
     add_backend_policy_flag(p)
+    add_telemetry_flag(p)
+    add_trace_flag(p)
     return p
 
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
-    from photon_tpu.cli.params import enable_backend_guard
+    from photon_tpu.cli.params import (
+        enable_backend_guard,
+        enable_telemetry,
+        enable_trace,
+        finish_telemetry,
+        finish_trace,
+    )
 
     # Indexing is host-side work, but the native block decoder's jax
     # imports can still initialize a backend; the same fail-fast gate (and
     # --backend-policy cpu-only for pure-host runs) applies.
     enable_backend_guard(args)
+    enable_telemetry(args, role="indexing")
+    enable_trace(args.trace_out)
+    try:
+        return _run(args)
+    finally:
+        finish_trace(args.trace_out)
+        finish_telemetry(args)
+
+
+def _run(args) -> dict:
     os.makedirs(args.output_dir, exist_ok=True)
     with PhotonLogger(args.output_dir) as logger:
         sizes = {}

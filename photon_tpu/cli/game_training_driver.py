@@ -172,6 +172,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
         add_compile_store_flag,
         add_fault_plan_flag,
         add_re_routing_flags,
+        add_telemetry_flag,
         add_trace_flag,
     )
 
@@ -180,6 +181,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     add_compile_store_flag(p)
     add_fault_plan_flag(p)
     add_re_routing_flags(p)
+    add_telemetry_flag(p)
     add_trace_flag(p)
     return p
 
@@ -241,6 +243,7 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         enable_compile_store,
         enable_fault_plan,
         enable_re_routing,
+        enable_telemetry,
         enable_trace,
     )
 
@@ -260,6 +263,10 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
         enable_compile_store(args, output_dir=args.output_dir)
     enable_fault_plan(args.fault_plan)
     enable_re_routing(args, output_dir=args.output_dir)
+    # Fleet role + trace-shard placement BEFORE the collector installs:
+    # the anchor event is stamped at install (docs/observability.md
+    # §"Fleet view").
+    enable_telemetry(args, role="training")
     enable_trace(args.trace_out)
     # Join the multi-host runtime first (no-op single-process) so
     # jax.devices() below sees the whole pod slice (SURVEY.md §5.8).
@@ -411,9 +418,10 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
             import jax.profiler
 
             jax.profiler.stop_trace()
-        from photon_tpu.cli.params import finish_trace
+        from photon_tpu.cli.params import finish_telemetry, finish_trace
 
         finish_trace(args.trace_out)
+        finish_telemetry(args)
 
 
 class RestartsUselessError(Exception):

@@ -122,11 +122,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
     from photon_tpu.cli.params import (
         add_backend_policy_flag,
         add_compilation_cache_flag,
+        add_telemetry_flag,
         add_trace_flag,
     )
 
     add_backend_policy_flag(p)
     add_compilation_cache_flag(p)
+    add_telemetry_flag(p)
     add_trace_flag(p)
     return p
 
@@ -396,7 +398,9 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     from photon_tpu.cli.params import (
         enable_backend_guard,
         enable_compilation_cache,
+        enable_telemetry,
         enable_trace,
+        finish_telemetry,
         finish_trace,
     )
 
@@ -404,11 +408,13 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     # (PHOTON_BACKEND_INIT_TIMEOUT_S hard deadline; docs/robustness.md).
     enable_backend_guard(args)
     enable_compilation_cache(args.compilation_cache_dir)
+    enable_telemetry(args, role="glm-training")
     enable_trace(args.trace_out)
     try:
         return _run(args)
     finally:
         finish_trace(args.trace_out)
+        finish_telemetry(args)
 
 
 def _run(args) -> dict:

@@ -84,6 +84,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
         add_compilation_cache_flag,
         add_compile_store_flag,
         add_fault_plan_flag,
+        add_telemetry_flag,
         add_trace_flag,
     )
 
@@ -91,6 +92,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     add_compilation_cache_flag(p)
     add_compile_store_flag(p)
     add_fault_plan_flag(p)
+    add_telemetry_flag(p)
     add_trace_flag(p)
     return p
 
@@ -146,12 +148,13 @@ def _load_base(args, logger):
 
 def run(argv: Optional[Sequence[str]] = None) -> dict:
     args = build_arg_parser().parse_args(argv)
-    from photon_tpu.cli.params import finish_trace
+    from photon_tpu.cli.params import finish_telemetry, finish_trace
 
     try:
         return _run(args)
     finally:
         finish_trace(args.trace_out)
+        finish_telemetry(args)
 
 
 def _run(args) -> dict:
@@ -160,6 +163,7 @@ def _run(args) -> dict:
         enable_compilation_cache,
         enable_compile_store,
         enable_fault_plan,
+        enable_telemetry,
         enable_trace,
     )
     from photon_tpu.io.prefetch import prefetch
@@ -181,6 +185,7 @@ def _run(args) -> dict:
     if getattr(args, "compile_store", None):
         enable_compile_store(args, output_dir=args.output_dir)
     enable_fault_plan(args.fault_plan)
+    enable_telemetry(args, role="online")
     enable_trace(args.trace_out)
     plogger = PhotonLogger(args.output_dir)
     logger = plogger.logger

@@ -402,6 +402,72 @@ def finish_trace(path) -> None:
         )
 
 
+def add_telemetry_flag(parser) -> None:
+    """Shared --telemetry-dir flag (default: $PHOTON_TELEMETRY_DIR): the
+    fleet-observability convention (docs/observability.md §"Fleet view").
+    Every cooperating process of one run points here; each writes its
+    trace shard (``trace.<role>.<pid>.json``) and metrics-registry shard
+    (``registry.<role>.<pid>.json``) into the shared directory, and
+    ``python -m photon_tpu.obs.analysis report <dir>`` fuses them into
+    one merged timeline + run report."""
+    import os
+
+    parser.add_argument(
+        "--telemetry-dir",
+        default=os.environ.get("PHOTON_TELEMETRY_DIR") or None,
+        help="shared fleet-telemetry directory: this process writes its "
+             "trace shard and metrics-registry shard here under the "
+             "fleet naming convention, mergeable across processes by "
+             "`python -m photon_tpu.obs.analysis report` "
+             "(docs/observability.md §'Fleet view'; default: "
+             "$PHOTON_TELEMETRY_DIR)")
+
+
+def enable_telemetry(args, role: str):
+    """Install the fleet-telemetry convention for this process: stamp its
+    ROLE (carried by every trace anchor, whether or not a telemetry dir
+    is set), and under ``--telemetry-dir`` default ``--trace-out`` into
+    the shard layout so the trace lands where the aggregator looks.
+    Returns the telemetry dir (or None). Call BEFORE enable_trace — the
+    anchor is stamped at collector install."""
+    import os
+
+    from photon_tpu.obs import trace
+
+    trace.set_process_role(role)
+    d = getattr(args, "telemetry_dir", None)
+    if not d:
+        return None
+    os.makedirs(d, exist_ok=True)
+    if getattr(args, "trace_out", None) is None:
+        args.trace_out = os.path.join(
+            d, f"trace.{role}.{os.getpid()}.json")
+    return d
+
+
+def finish_telemetry(args, registries=()) -> None:
+    """Export this process's metrics-registry shard into the telemetry
+    dir (no-op without ``--telemetry-dir``). Runs in the driver's
+    ``finally`` — a failed run's counters are exactly the ones the run
+    report needs. Best-effort by contract: telemetry is evidence, never
+    a new failure mode."""
+    d = getattr(args, "telemetry_dir", None)
+    if not d:
+        return
+    import logging
+    import os
+
+    from photon_tpu.obs import fleet, trace
+
+    path = os.path.join(
+        d, f"registry.{trace.process_role()}.{os.getpid()}.json")
+    try:
+        fleet.write_registry_shard(path, registries=list(registries))
+    except Exception as e:  # noqa: BLE001 - evidence, never a failure mode
+        logging.getLogger("photon_tpu.obs").warning(
+            "registry shard export failed (%s): %s", path, e)
+
+
 def add_re_routing_flags(parser) -> None:
     """Shared random-effect solver-routing flags (docs/scaling.md §"Solver
     routing"): ``--re-routing`` picks between the deterministic static gate

@@ -77,12 +77,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
         add_backend_policy_flag,
         add_compilation_cache_flag,
         add_fault_plan_flag,
+        add_telemetry_flag,
         add_trace_flag,
     )
 
     add_backend_policy_flag(p)
     add_compilation_cache_flag(p)
     add_fault_plan_flag(p)
+    add_telemetry_flag(p)
     add_trace_flag(p)
     from photon_tpu.cli.params import add_compile_store_flag
 
@@ -97,6 +99,7 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
         enable_compilation_cache,
         enable_compile_store,
         enable_fault_plan,
+        enable_telemetry,
         enable_trace,
     )
 
@@ -115,6 +118,7 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
     if getattr(args, "compile_store", None):
         enable_compile_store(args)
     enable_fault_plan(args.fault_plan)
+    telemetry_dir = enable_telemetry(args, role="serving")
     enable_trace(args.trace_out)
     plogger = PhotonLogger(args.output_dir)
     logger = plogger.logger
@@ -139,11 +143,17 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
         max_wait_ms=config.max_wait_ms,
         max_queue=config.max_queue,
     )
-    metrics_path = (
-        os.path.join(args.output_dir, "serving-metrics.jsonl")
-        if args.output_dir
-        else None
-    )
+    # JSONL metrics history lands in the output dir as before; without
+    # one, a --telemetry-dir still captures it under the fleet shard
+    # naming so the run report's anomaly scan has a series to read.
+    if args.output_dir:
+        metrics_path = os.path.join(args.output_dir,
+                                    "serving-metrics.jsonl")
+    elif telemetry_dir:
+        metrics_path = os.path.join(
+            telemetry_dir, f"metrics.serving.{os.getpid()}.jsonl")
+    else:
+        metrics_path = None
     server = ScoringServer(
         registry,
         batcher,
@@ -191,8 +201,11 @@ def _run(args, serve_forever: bool) -> dict:
         "model_dir": v.model_dir,
         "coordinates": sorted(v.coordinates),
     }
+    from photon_tpu.cli.params import finish_telemetry
+
     if not serve_forever:
         server.shutdown()
+        finish_telemetry(args, registries=(server.metrics,))
         plogger.close()
         return summary
     def _graceful(signum, frame):
@@ -214,6 +227,9 @@ def _run(args, serve_forever: bool) -> dict:
         pass
     finally:
         server.shutdown()
+        # Registry shard AFTER shutdown: the final flush's counters are
+        # exactly what the fleet report should aggregate.
+        finish_telemetry(args, registries=(server.metrics,))
         plogger.close()
     return summary
 

@@ -35,10 +35,13 @@ from photon_tpu.obs.metrics import (
     get_registry,
 )
 from photon_tpu.obs.trace import (
+    ANCHOR_EVENT,
     TraceCollector,
     current_trace_id,
     instant,
     new_trace_id,
+    process_role,
+    set_process_role,
     start_tracing,
     stop_tracing,
     suspend_tracing,
@@ -50,6 +53,7 @@ from photon_tpu.obs.trace import (
 from photon_tpu.obs import retrace
 
 __all__ = [
+    "ANCHOR_EVENT",
     "Counter",
     "Gauge",
     "HistogramMetric",
@@ -60,7 +64,9 @@ __all__ = [
     "current_trace_id",
     "instant",
     "new_trace_id",
+    "process_role",
     "retrace",
+    "set_process_role",
     "start_tracing",
     "stop_tracing",
     "suspend_tracing",

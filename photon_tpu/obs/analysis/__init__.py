@@ -42,6 +42,13 @@ from photon_tpu.obs.analysis.slo import (
     SloRule,
     SloWatchdog,
 )
+from photon_tpu.obs.analysis.report import (
+    REPORT_SCHEMA,
+    anomaly_scan,
+    build_report,
+    detect_level_shifts,
+    format_markdown,
+)
 from photon_tpu.obs.analysis.timeline import (
     Span,
     TimelineReport,
@@ -53,6 +60,11 @@ from photon_tpu.obs.analysis.timeline import (
 )
 
 __all__ = [
+    "REPORT_SCHEMA",
+    "anomaly_scan",
+    "build_report",
+    "detect_level_shifts",
+    "format_markdown",
     "ArtifactError",
     "BenchArtifact",
     "Span",

@@ -1,15 +1,19 @@
-"""Timeline-analyzer CLI (docs/observability.md §"Reading the telemetry").
+"""Timeline-analyzer + fleet-report CLI (docs/observability.md).
 
     python -m photon_tpu.obs.analysis run-trace.json
     python -m photon_tpu.obs.analysis bench-trace.json \\
         --bench BENCH_DETAILS.json --json report.json
+    python -m photon_tpu.obs.analysis report <run-dir> --json report.json
 
-Prints the critical-path table, per-layer wall shares, the queue-wait
-breakdown, and the ingest/compute overlap fraction (the measured answer
-to "is ingest still serializing with compute"); ``--bench`` joins the
-bench roofline numbers to name the bottleneck stage. Exit 2 on a
-malformed trace, 0 otherwise (the analyzer reports, it does not gate —
-gating lives in scripts/bench_compare.py and the SLO configs).
+The bare form prints one trace's critical-path table, per-layer wall
+shares, the queue-wait breakdown, and the ingest/compute overlap
+fraction; ``--bench`` joins the bench roofline numbers to name the
+bottleneck stage. The ``report`` subcommand fuses a MULTI-process run's
+telemetry — merged trace shards, registry shards, metrics JSONL,
+recovery journals, bench artifacts, anomaly scan — into one fleet report
+(``obs/analysis/report.py``; docs/observability.md §"Fleet view").
+Exit 2 on a malformed trace, 0 otherwise (the analyzer reports, it does
+not gate — gating lives in scripts/bench_compare.py and the SLO configs).
 """
 from __future__ import annotations
 
@@ -29,6 +33,12 @@ from photon_tpu.obs.analysis.timeline import (
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "report":
+        from photon_tpu.obs.analysis.report import main as report_main
+
+        return report_main(argv[1:])
     ap = argparse.ArgumentParser(
         prog="python -m photon_tpu.obs.analysis",
         description="Analyze a --trace-out Chrome-trace artifact.",

@@ -1,0 +1,544 @@
+"""Unified fleet run report: one artifact for a multi-process run.
+
+``python -m photon_tpu.obs.analysis report <run-dir>`` fuses everything a
+run scattered across processes — per-process trace shards (merged onto
+one wall-clock timeline via ``obs.fleet``), metrics-registry shards
+(folded into one fleet registry), metrics JSONL histories, recovery /
+patch journals, the newest bench artifact, and SLO results — into a
+single JSON (schema :data:`REPORT_SCHEMA`) + human-readable markdown
+report: topology table, per-process critical paths (``timeline.py``),
+the restart/downshift/failover ledger, freshness watermarks, and a
+**metrics-stream anomaly scan**.
+
+Anomaly detector (the longitudinal complement to the pairwise bench
+gate): for each watched series in the metrics JSONL history, a rolling
+median/MAD robust z-score over a trailing window flags LEVEL SHIFTS —
+``min_run`` consecutive points with ``|x - median| / (1.4826 * MAD)``
+over the threshold. Median/MAD (not mean/stddev) so the detector's own
+baseline shrugs off the spikes it is hunting; the consecutive-run
+requirement keeps one-off warmup/GC spikes out of the anomaly count
+(tuning knobs in docs/observability.md §"Fleet view").
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Iterable, Mapping, Optional, Sequence
+
+REPORT_SCHEMA = "photon-fleet-report/1"
+
+#: Series watched by default: the serving latency quantiles (lifetime
+#: histograms — smooth on a healthy run, shifted by a real regression).
+#: Throughput series are opt-in (--metric): interval rates legitimately
+#: swing with offered load, which is variance, not anomaly.
+DEFAULT_ANOMALY_METRICS = ("latency.p50_ms", "latency.p95_ms",
+                           "latency.p99_ms")
+
+DEFAULT_WINDOW = 16
+DEFAULT_Z = 6.0
+DEFAULT_MIN_HISTORY = 8
+DEFAULT_MIN_RUN = 2
+
+_MAD_SCALE = 1.4826  # MAD -> stddev under normality
+
+
+# ------------------------------------------------------ anomaly detector
+
+
+def _median(xs: Sequence[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def robust_scores(
+    values: Sequence[float],
+    window: int = DEFAULT_WINDOW,
+    min_history: int = DEFAULT_MIN_HISTORY,
+) -> list:
+    """Per-point robust z-scores against the TRAILING window (the point
+    itself excluded — a level shift must not drag its own baseline).
+    Points with fewer than ``min_history`` predecessors score None. A
+    zero MAD (constant history) falls back to a 5%-of-median scale so
+    constant-plus-epsilon series stay quiet instead of dividing by ~0."""
+    out: list = []
+    for i, x in enumerate(values):
+        hist = values[max(0, i - window):i]
+        if len(hist) < min_history:
+            out.append(None)
+            continue
+        med = _median(hist)
+        mad = _median([abs(h - med) for h in hist])
+        scale = _MAD_SCALE * mad
+        if scale <= 0:
+            scale = max(abs(med) * 0.05, 1e-9)
+        out.append(abs(x - med) / scale)
+    return out
+
+
+def detect_level_shifts(
+    values: Sequence[float],
+    window: int = DEFAULT_WINDOW,
+    z_threshold: float = DEFAULT_Z,
+    min_history: int = DEFAULT_MIN_HISTORY,
+    min_run: int = DEFAULT_MIN_RUN,
+) -> list[dict]:
+    """Flag sustained level shifts in one series.
+
+    A point is anomalous when its robust z-score crosses ``z_threshold``
+    AND it belongs to a run of at least ``min_run`` consecutive
+    over-threshold points (a lone spike is noise; a sustained shift is a
+    regression). Returns one row per anomalous point:
+    ``{"index", "value", "median", "z"}``.
+    """
+    vals = [float(v) for v in values]
+    scores = robust_scores(vals, window=window, min_history=min_history)
+    over = [s is not None and s >= z_threshold for s in scores]
+    flagged: list[dict] = []
+    i = 0
+    while i < len(over):
+        if not over[i]:
+            i += 1
+            continue
+        j = i
+        while j < len(over) and over[j]:
+            j += 1
+        if j - i >= max(1, int(min_run)):
+            for k in range(i, j):
+                hist = vals[max(0, k - window):k]
+                flagged.append({
+                    "index": k,
+                    "value": round(vals[k], 6),
+                    "median": round(_median(hist), 6),
+                    "z": round(scores[k], 3),
+                })
+        i = j
+    return flagged
+
+
+def _iter_jsonl(path: str) -> Iterable[dict]:
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue  # torn tail from a live writer
+                if isinstance(row, dict):
+                    yield row
+    except OSError:
+        return
+
+
+def _series_from_jsonl(path: str, metrics: Sequence[str]) -> dict:
+    """Watched dotted-path series from one metrics JSONL history."""
+    from photon_tpu.obs.analysis.artifacts import flatten_metrics
+
+    series: dict[str, list] = {m: [] for m in metrics}
+    for row in _iter_jsonl(path):
+        flat = flatten_metrics(row)
+        for m in metrics:
+            v = flat.get(m)
+            if v is not None:
+                series[m].append(v)
+    return {m: vals for m, vals in series.items() if vals}
+
+
+def anomaly_scan(
+    jsonl_paths: Sequence[str],
+    metrics: Optional[Sequence[str]] = None,
+    window: int = DEFAULT_WINDOW,
+    z_threshold: float = DEFAULT_Z,
+    min_run: int = DEFAULT_MIN_RUN,
+) -> dict:
+    """Run the level-shift detector over every watched series in every
+    metrics JSONL file. Returns ``{"series": [...], "n_anomalies": N}``
+    — one series row per (file, metric) with its point count and flagged
+    anomalies."""
+    metrics = tuple(metrics or DEFAULT_ANOMALY_METRICS)
+    rows = []
+    total = 0
+    for path in jsonl_paths:
+        for name, values in sorted(_series_from_jsonl(path,
+                                                      metrics).items()):
+            flags = detect_level_shifts(values, window=window,
+                                        z_threshold=z_threshold,
+                                        min_run=min_run)
+            total += len(flags)
+            rows.append({
+                "file": os.path.abspath(path),
+                "metric": name,
+                "points": len(values),
+                "anomalies": flags,
+            })
+    return {
+        "metrics_watched": list(metrics),
+        "window": window,
+        "z_threshold": z_threshold,
+        "min_run": min_run,
+        "series": rows,
+        "n_anomalies": total,
+    }
+
+
+# ----------------------------------------------------------- run report
+
+
+def _ledger_counts(rows: Sequence[Mapping]) -> dict:
+    """Event/cause counts over the merged journal stream — the
+    restart/downshift/failover ledger header."""
+    by_event: dict[str, int] = {}
+    by_cause: dict[str, int] = {}
+    for r in rows:
+        ev = str(r.get("event", "?"))
+        by_event[ev] = by_event.get(ev, 0) + 1
+        cause = r.get("cause")
+        if cause:
+            by_cause[str(cause)] = by_cause.get(str(cause), 0) + 1
+    return {"rows": len(rows), "by_event": by_event, "by_cause": by_cause}
+
+
+def _freshness_watermarks(metrics_jsonl: Sequence[str]) -> dict:
+    """Latest non-empty ``freshness`` block per metrics history file."""
+    out = {}
+    for path in metrics_jsonl:
+        last = None
+        for row in _iter_jsonl(path):
+            fr = row.get("freshness")
+            if isinstance(fr, dict) and fr:
+                last = fr
+        if last is not None:
+            out[os.path.abspath(path)] = last
+    return out
+
+
+def _last_slo(metrics_jsonl: Sequence[str]) -> Optional[dict]:
+    last = None
+    for path in metrics_jsonl:
+        for row in _iter_jsonl(path):
+            slo = row.get("slo")
+            if isinstance(slo, dict):
+                last = {"file": os.path.abspath(path), **slo}
+    return last
+
+
+def _newest_bench(paths: Sequence[str]) -> Optional[dict]:
+    """Summarize the newest parseable bench artifact found in the run
+    dir (recency from artifact content, per artifacts.newest_artifacts'
+    contract — mtime lies after a fresh clone)."""
+    from photon_tpu.obs.analysis.artifacts import (
+        ArtifactError,
+        load_bench_artifact,
+    )
+
+    best = None
+    for p in paths:
+        try:
+            art = load_bench_artifact(p)
+        except ArtifactError:
+            continue
+        key = (art.details.get("written_at") or "", art.name)
+        if best is None or key > best[0]:
+            best = (key, art)
+    if best is None:
+        return None
+    art = best[1]
+    prov = art.details.get("provenance") or {}
+    return {
+        "artifact": os.path.abspath(art.path),
+        "written_at": art.details.get("written_at"),
+        "backend": (prov.get("backend_summary") or {}).get("backend"),
+        "metrics": art.details.get("metrics") or {},
+    }
+
+
+def build_report(
+    run_dir: str,
+    metrics: Optional[Sequence[str]] = None,
+    window: int = DEFAULT_WINDOW,
+    z_threshold: float = DEFAULT_Z,
+    min_run: int = DEFAULT_MIN_RUN,
+    merged_trace_out: Optional[str] = None,
+    top: int = 5,
+) -> dict:
+    """Fuse one run directory's telemetry into the fleet report dict."""
+    from photon_tpu.obs import fleet
+    from photon_tpu.obs.analysis.timeline import (
+        TraceParseError,
+        analyze_trace,
+    )
+
+    files = fleet.discover(run_dir)
+    warnings: list[str] = []
+
+    # -- per-process timelines + merged trace -----------------------------
+    topology = []
+    per_process = {}
+    mergeable = []
+    for path in files.traces:
+        try:
+            _, anchor = fleet.load_trace_shard(path)
+            mergeable.append(path)
+        except fleet.FleetMergeError as e:
+            if e.merged_doc:
+                # A prior report's --merged-trace output living in the
+                # run dir: not a shard, not a process — skip it entirely
+                # (re-ingesting it would double-count every span).
+                continue
+            warnings.append(str(e))
+            anchor = None
+        try:
+            rep = analyze_trace(path)
+        except TraceParseError as e:
+            warnings.append(f"{path}: {e}")
+            continue
+        role = (anchor or {}).get("role", "unknown")
+        pid = (anchor or {}).get("pid")
+        key = f"{role}.{pid}" if pid is not None else os.path.basename(path)
+        topology.append({
+            "role": role,
+            "pid": pid,
+            "hostname": (anchor or {}).get("hostname"),
+            "trace": os.path.abspath(path),
+            "anchored": anchor is not None,
+            "wall_time": (anchor or {}).get("wall_time"),
+            "spans": rep.n_spans,
+            "wall_seconds": round(rep.wall_seconds, 6),
+        })
+        per_process[key] = {
+            "trace": os.path.abspath(path),
+            "wall_seconds": round(rep.wall_seconds, 6),
+            "critical_path": rep.critical_path(top=top),
+            "bottleneck": rep.bottleneck(),
+            "queue_wait": rep.queue_wait,
+            "unclosed_spans": rep.unclosed_spans,
+        }
+    merged_trace: Optional[dict] = None
+    if mergeable:
+        doc = fleet.merge_traces(mergeable, out_path=merged_trace_out)
+        joins = fleet.cross_process_joins(doc)
+        from photon_tpu.obs.analysis.timeline import analyze_events
+
+        mrep = analyze_events(doc["traceEvents"])
+        merged_trace = {
+            "path": (os.path.abspath(merged_trace_out)
+                     if merged_trace_out else None),
+            "shards": doc["photon.fleet"]["shards"],
+            "origin_wall_time": doc["photon.fleet"]["origin_wall_time"],
+            "spans": mrep.n_spans,
+            "wall_seconds": round(mrep.wall_seconds, 6),
+            "roles": sorted({s["role"]
+                             for s in doc["photon.fleet"]["shards"]}),
+            "cross_process_joins": joins[:50],
+            "n_cross_process_joins": len(joins),
+        }
+
+    # -- fleet metrics -----------------------------------------------------
+    agg, shard_meta = fleet.collect_shards(files.registry_shards)
+
+    # -- merged recovery ledger -------------------------------------------
+    ledger = fleet.merge_journals(files.journals)
+    patch_rows = fleet.merge_journals(files.patch_journals)
+
+    report = {
+        "schema": REPORT_SCHEMA,
+        "run_dir": os.path.abspath(run_dir),
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "topology": sorted(topology,
+                           key=lambda t: (t["role"], t["pid"] or 0)),
+        "merged_trace": merged_trace,
+        "per_process": per_process,
+        "metrics": {
+            "shards": shard_meta,
+            "snapshot": agg.snapshot(),
+        },
+        "recovery_ledger": {
+            **_ledger_counts(ledger),
+            "events": ledger[-200:],
+        },
+        "patch_ledger": {"rows": len(patch_rows)},
+        "freshness": _freshness_watermarks(files.metrics_jsonl),
+        "slo": _last_slo(files.metrics_jsonl),
+        "bench": _newest_bench(files.bench_artifacts),
+        "anomalies": anomaly_scan(files.metrics_jsonl, metrics=metrics,
+                                  window=window, z_threshold=z_threshold,
+                                  min_run=min_run),
+        "warnings": warnings,
+    }
+    return report
+
+
+def format_markdown(report: Mapping, top: int = 5) -> str:
+    """Human-readable render of :func:`build_report`'s dict."""
+    lines = [f"# Fleet run report — {report['run_dir']}",
+             f"generated {report['generated_at']}  ·  schema "
+             f"`{report['schema']}`", ""]
+
+    lines.append("## Topology")
+    topo = report.get("topology") or []
+    if topo:
+        lines += ["", "| role | pid | host | spans | wall (s) | anchored |",
+                  "|---|---|---|---|---|---|"]
+        for t in topo:
+            lines.append(
+                f"| {t['role']} | {t['pid']} | {t.get('hostname')} | "
+                f"{t['spans']} | {t['wall_seconds']} | "
+                f"{'yes' if t['anchored'] else 'NO (unmergeable)'} |")
+    else:
+        lines.append("_no trace shards found_")
+
+    mt = report.get("merged_trace")
+    lines += ["", "## Merged timeline"]
+    if mt:
+        lines.append(
+            f"{mt['spans']} spans over {mt['wall_seconds']}s across roles "
+            f"{', '.join(mt['roles'])}; {mt['n_cross_process_joins']} "
+            "cross-process trace-id join(s).")
+        for j in mt["cross_process_joins"][:top]:
+            lines.append(
+                f"- `{j['trace_id']}` spans {len(j['pids'])} processes "
+                f"({', '.join(j['roles'])}; {j['events']} events)")
+    else:
+        lines.append("_no mergeable (anchored) trace shards_")
+
+    lines += ["", "## Per-process critical paths"]
+    for key, pp in sorted((report.get("per_process") or {}).items()):
+        bn = pp.get("bottleneck")
+        lines.append(f"### {key} — "
+                     + (f"bottleneck `{bn['cat']}:{bn['name']}` "
+                        f"({bn['share']:.0%})" if bn else "empty"))
+        for row in (pp.get("critical_path") or [])[:top]:
+            lines.append(f"- {row['share'] * 100:5.1f}%  "
+                         f"{row['cat']}:{row['name']} "
+                         f"({row['owned_s'] * 1e3:.2f} ms)")
+
+    led = report.get("recovery_ledger") or {}
+    lines += ["", "## Restart / downshift / failover ledger",
+              f"{led.get('rows', 0)} journal row(s)."]
+    for ev, n in sorted((led.get("by_event") or {}).items()):
+        lines.append(f"- {ev}: {n}")
+    if led.get("by_cause"):
+        lines.append("by classified cause: "
+                     + ", ".join(f"{c}={n}" for c, n
+                                 in sorted(led["by_cause"].items())))
+
+    fresh = report.get("freshness") or {}
+    lines += ["", "## Freshness watermarks"]
+    if fresh:
+        for path, fr in sorted(fresh.items()):
+            lines.append(f"- `{os.path.basename(path)}`: "
+                         + ", ".join(f"{k}={v}" for k, v
+                                     in sorted(fr.items())))
+    else:
+        lines.append("_none recorded_")
+
+    an = report.get("anomalies") or {}
+    lines += ["", "## Metrics-stream anomalies",
+              f"{an.get('n_anomalies', 0)} anomalous point(s) across "
+              f"{len(an.get('series') or [])} watched series "
+              f"(window={an.get('window')}, z>={an.get('z_threshold')}, "
+              f"min_run={an.get('min_run')})."]
+    for s in an.get("series") or []:
+        if s["anomalies"]:
+            first = s["anomalies"][0]
+            lines.append(
+                f"- **{s['metric']}** in `{os.path.basename(s['file'])}`: "
+                f"{len(s['anomalies'])} point(s), first at index "
+                f"{first['index']} (value {first['value']} vs median "
+                f"{first['median']}, z={first['z']})")
+
+    if report.get("slo"):
+        lines += ["", "## SLO (last judged)",
+                  f"`{json.dumps(report['slo'])[:500]}`"]
+    if report.get("bench"):
+        b = report["bench"]
+        lines += ["", "## Newest bench artifact",
+                  f"`{os.path.basename(b['artifact'])}` "
+                  f"(written {b.get('written_at')}, backend "
+                  f"{b.get('backend')}; {len(b.get('metrics') or {})} flat "
+                  "metrics)"]
+    if report.get("warnings"):
+        lines += ["", "## Warnings"]
+        lines += [f"- {w}" for w in report["warnings"][:20]]
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------------------------- CLI
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m photon_tpu.obs.analysis report",
+        description="Fuse a multi-process run's telemetry (trace shards, "
+                    "registry shards, metrics JSONL, recovery journals, "
+                    "bench artifacts) into one fleet report.",
+    )
+    ap.add_argument("run_dir", help="run/telemetry directory "
+                                    "(--telemetry-dir convention)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the full report JSON here ('-' for stdout)")
+    ap.add_argument("--md", dest="md_out", default=None,
+                    help="write the markdown render here")
+    ap.add_argument("--merged-trace", default=None,
+                    help="also write the merged Perfetto-loadable "
+                         "timeline here")
+    ap.add_argument("--metric", action="append", default=None,
+                    help="watched anomaly series (dotted path into the "
+                         "metrics JSONL rows; repeatable; default: "
+                         + ", ".join(DEFAULT_ANOMALY_METRICS) + ")")
+    ap.add_argument("--window", type=int, default=DEFAULT_WINDOW,
+                    help="trailing window for the rolling median/MAD")
+    ap.add_argument("--z-threshold", type=float, default=DEFAULT_Z,
+                    help="robust z-score a point must cross")
+    ap.add_argument("--min-run", type=int, default=DEFAULT_MIN_RUN,
+                    help="consecutive over-threshold points required "
+                         "(>=2 suppresses lone spikes)")
+    ap.add_argument("--top", type=int, default=5,
+                    help="rows per critical-path table in the markdown")
+    args = ap.parse_args(argv)
+
+    if not os.path.isdir(args.run_dir):
+        print(f"report: {args.run_dir}: not a directory", file=sys.stderr)
+        return 2
+    report = build_report(
+        args.run_dir, metrics=args.metric, window=args.window,
+        z_threshold=args.z_threshold, min_run=args.min_run,
+        merged_trace_out=args.merged_trace, top=args.top,
+    )
+    # File artifacts FIRST: `report ... --json out.json | head` must still
+    # produce out.json — a consumer closing stdout early (BrokenPipeError
+    # on the markdown print below) must never cost the JSON artifact.
+    if args.json_out and args.json_out != "-":
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"report JSON written to {args.json_out}", file=sys.stderr)
+    if args.md_out:
+        with open(args.md_out, "w") as f:
+            f.write(format_markdown(report, top=args.top))
+    try:
+        if args.json_out == "-":
+            # Stdout-JSON mode: stdout must be PURE JSON (pipeable into
+            # jq); the human render goes to stderr instead.
+            print(format_markdown(report, top=args.top), file=sys.stderr)
+            print(json.dumps(report, indent=2))
+        else:
+            print(format_markdown(report, top=args.top))
+    except BrokenPipeError:
+        # Downstream pager/head closed the pipe; the artifacts are on
+        # disk, which is the contract. Exit clean, not with a traceback.
+        try:
+            sys.stdout.close()
+        except OSError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

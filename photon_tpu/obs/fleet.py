@@ -1,0 +1,453 @@
+"""Fleet observability: cross-process telemetry aggregation.
+
+The instrumentation layer (trace spans, metrics registry, recovery
+journal) is strictly per-process; the reproduction's topology is not —
+a supervised training driver, N serving workers, an online trainer
+publishing over HTTP, mesh hosts with per-host cost tables. Upstream
+photon-ml gets cluster-wide visibility for free from the Spark driver UI;
+this module is the rebuild's equivalent substrate
+(docs/observability.md §"Fleet view"):
+
+* **Trace-shard merging** — :func:`merge_traces` combines N per-process
+  ``--trace-out`` files into ONE Perfetto-loadable timeline. Each shard's
+  :data:`obs.trace.ANCHOR_EVENT` (stamped at collector install) carries
+  the wall-clock ↔ ``perf_counter`` correspondence, so the merger aligns
+  clocks by wall time (per-process ``perf_counter`` origins are arbitrary
+  and wildly skewed — the anchor is what makes shards comparable),
+  assigns stable process lanes (colliding pids across hosts are
+  remapped), and preserves cross-process trace-id joins — the online
+  event→refresh→publish→served-score chain becomes one visible flow.
+  Anchor-less shards (traces written before the anchor contract) are
+  REFUSED with a clear error; single-trace analysis of them still works.
+
+* **Metrics shard export/collect** — :func:`write_registry_shard` dumps a
+  process's registry state (full histogram bins, not just quantiles) as
+  one JSON file; :func:`collect_shards` folds any number of them through
+  ``MetricsRegistry.merge`` (counters sum, gauges latest-by-anchor,
+  histograms merge bins; per-``shard_id`` idempotence, so a
+  double-collected shard changes nothing) into one fleet registry with
+  JSON *and* Prometheus exposition.
+
+* **Journal merging** — :func:`merge_journals` interleaves recovery /
+  patch journals from all attempts and processes into one causally
+  ordered stream (sub-second ``t`` stamps when present, ISO ``time``
+  fallback for rows written before the stamp existed).
+
+* **Run-dir discovery** — :func:`discover` maps the ``--telemetry-dir``
+  shard layout (plus driver output dirs nested under a run root) to the
+  artifact families the run-report CLI (``obs/analysis/report.py``)
+  fuses.
+"""
+from __future__ import annotations
+
+import calendar
+import dataclasses
+import glob
+import json
+import os
+import time
+from typing import Iterable, Mapping, Optional, Sequence
+
+from photon_tpu.obs import trace as trace_mod
+from photon_tpu.obs.metrics import REGISTRY, MetricsRegistry
+
+__all__ = [
+    "FLEET_TRACE_SCHEMA",
+    "SHARD_SCHEMA",
+    "FleetMergeError",
+    "FleetRunFiles",
+    "collect_shards",
+    "cross_process_joins",
+    "discover",
+    "find_anchor",
+    "load_registry_shard",
+    "load_trace_shard",
+    "merge_journals",
+    "merge_traces",
+    "write_registry_shard",
+]
+
+FLEET_TRACE_SCHEMA = "photon-fleet-trace/1"
+SHARD_SCHEMA = "photon-registry-shard/1"
+
+
+class FleetMergeError(ValueError):
+    """A shard cannot participate in a fleet merge (missing anchor,
+    unreadable file, wrong schema). ``merged_doc`` is True when the file
+    is itself a merge OUTPUT (a ``photon.fleet`` document) — merging it
+    again would double-count every shard it already contains."""
+
+    def __init__(self, msg: str, merged_doc: bool = False):
+        super().__init__(msg)
+        self.merged_doc = merged_doc
+
+
+# ------------------------------------------------------------ trace merge
+
+
+def find_anchor(events: Iterable[Mapping]) -> Optional[dict]:
+    """The shard's anchor event (``{"ts": ..., **args}``), or None.
+
+    The anchor maps any event timestamp in the shard to wall time:
+    ``wall(ts) = anchor["wall_time"] + (ts - anchor["ts"]) / 1e6``.
+    """
+    for e in events:
+        if (isinstance(e, Mapping) and e.get("name") == trace_mod.ANCHOR_EVENT
+                and e.get("ph") in ("i", "I")):
+            args = dict(e.get("args") or {})
+            if "wall_time" not in args:
+                continue
+            try:
+                return {"ts": float(e.get("ts", 0.0)), **args}
+            except (TypeError, ValueError):
+                continue
+    return None
+
+
+def load_trace_shard(path: str) -> tuple[list, dict]:
+    """(events, anchor) for one shard; FleetMergeError names the file on
+    a missing anchor or unreadable document."""
+    from photon_tpu.obs.analysis.timeline import TraceParseError, load_trace
+
+    try:
+        doc = load_trace(path)
+    except TraceParseError as e:
+        raise FleetMergeError(str(e)) from e
+    if isinstance(doc, Mapping) and "photon.fleet" in doc:
+        # A previously-written merge OUTPUT (e.g. a --merged-trace file
+        # left in the run dir): it carries its shards' anchors, so
+        # re-merging it would silently double-count every span and
+        # invent phantom processes in the topology.
+        raise FleetMergeError(
+            f"{path}: already a merged photon.fleet document — refusing "
+            "to re-merge it as a shard", merged_doc=True)
+    events = doc["traceEvents"]
+    anchor = find_anchor(events)
+    if anchor is None:
+        raise FleetMergeError(
+            f"{path}: no {trace_mod.ANCHOR_EVENT!r} metadata event — this "
+            "trace predates the fleet-anchor contract (its process-local "
+            "clock origin is unrecoverable), so it cannot be merged. "
+            "Single-trace analysis still works: "
+            f"python -m photon_tpu.obs.analysis {path}"
+        )
+    return events, anchor
+
+
+def merge_traces(paths: Sequence[str],
+                 out_path: Optional[str] = None) -> dict:
+    """Merge N per-process trace shards into one wall-clock-aligned
+    Chrome trace document.
+
+    Every shard MUST carry an anchor (:class:`FleetMergeError` names the
+    offending file otherwise). Timestamps are re-based so ``ts`` 0 is the
+    earliest wall instant any shard's clock can express; events keep
+    their original relative order per shard and interleave by wall time
+    across shards (host wall-clock skew is not corrected — anchors are
+    honest about what they stamp, and docs cover NTP expectations).
+    Colliding pids (two hosts, same pid) get remapped lanes so Perfetto
+    never folds two processes into one track.
+    """
+    if not paths:
+        raise FleetMergeError("no trace shards to merge")
+    shards = []
+    for p in paths:
+        events, anchor = load_trace_shard(p)
+        # Wall time at this shard's ts=0 — the per-shard clock offset.
+        wall0 = float(anchor["wall_time"]) - float(anchor["ts"]) / 1e6
+        shards.append({"path": p, "events": events, "anchor": anchor,
+                       "wall0": wall0})
+    origin = min(s["wall0"] for s in shards)
+
+    used_pids: set = set()
+    merged: list[dict] = []
+    shard_meta = []
+    for i, s in enumerate(shards):
+        pid = int(s["anchor"].get("pid", 0))
+        lane = pid
+        while lane in used_pids:
+            # Stable, readable remap: keep the low digits recognizable.
+            lane += 1_000_000
+        used_pids.add(lane)
+        shift_us = (s["wall0"] - origin) * 1e6
+        n = 0
+        for e in s["events"]:
+            if not isinstance(e, Mapping) or "ts" not in e:
+                continue
+            try:
+                ts = float(e["ts"]) + shift_us
+            except (TypeError, ValueError):
+                continue
+            e2 = dict(e)
+            e2["ts"] = round(ts, 1)
+            e2["pid"] = lane
+            merged.append(e2)
+            n += 1
+        shard_meta.append({
+            "path": os.path.abspath(s["path"]),
+            "role": s["anchor"].get("role", "unknown"),
+            "hostname": s["anchor"].get("hostname", "unknown"),
+            "pid": pid,
+            "lane_pid": lane,
+            "wall0": round(s["wall0"], 6),
+            "events": n,
+        })
+    # Deterministic, Perfetto-friendly ordering (stable sort keeps each
+    # shard's same-ts ties in emit order).
+    merged.sort(key=lambda e: e["ts"])
+    doc = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "photon.fleet": {
+            "schema": FLEET_TRACE_SCHEMA,
+            "origin_wall_time": origin,
+            "shards": shard_meta,
+        },
+    }
+    if out_path:
+        d = os.path.dirname(out_path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(doc, f)
+    return doc
+
+
+def cross_process_joins(doc: Mapping, min_pids: int = 2) -> list[dict]:
+    """Trace ids whose events span >= ``min_pids`` distinct process lanes
+    in a merged document — the cross-process flows (e.g. the online
+    trainer's publish trace id re-entering the serving process's
+    /admin/patch handler). Sorted most-processes-first."""
+    roles = {s["lane_pid"]: s["role"]
+             for s in (doc.get("photon.fleet") or {}).get("shards", [])}
+    by_id: dict[str, dict] = {}
+    for e in doc.get("traceEvents", []):
+        args = e.get("args") or {}
+        tid = args.get("trace_id")
+        if tid is None:
+            continue
+        d = by_id.setdefault(str(tid), {"pids": set(), "events": 0})
+        d["pids"].add(int(e.get("pid", 0)))
+        d["events"] += 1
+    out = []
+    for tid, d in by_id.items():
+        if len(d["pids"]) >= min_pids:
+            pids = sorted(d["pids"])
+            out.append({
+                "trace_id": tid,
+                "pids": pids,
+                "roles": sorted({roles.get(p, "unknown") for p in pids}),
+                "events": d["events"],
+            })
+    out.sort(key=lambda j: (-len(j["pids"]), j["trace_id"]))
+    return out
+
+
+# ------------------------------------------------------- registry shards
+
+
+def _shard_id(role: str, pid: int, hostname: str) -> str:
+    return f"{hostname}:{pid}:{role}"
+
+
+def write_registry_shard(
+    path: str,
+    registries: Optional[Sequence[MetricsRegistry]] = None,
+    role: Optional[str] = None,
+    extra: Optional[Mapping] = None,
+) -> str:
+    """Export this process's metrics state as one mergeable shard file.
+
+    ``registries`` defaults to the process-global registry; pass extras
+    (e.g. a ``ScoringServer.metrics``) to fold per-component registries
+    into the same shard. Written atomically (tmp + replace) so a
+    concurrent :func:`collect_shards` never reads a torn file.
+    """
+    import socket
+
+    regs = list(registries) if registries else [REGISTRY]
+    if not any(r is REGISTRY for r in regs):
+        regs.append(REGISTRY)
+    scratch = MetricsRegistry()
+    anchor = time.time()
+    for r in regs:
+        scratch.merge(r, anchor=anchor)
+    try:
+        host = socket.gethostname()
+    except OSError:
+        host = "unknown"
+    role = role or trace_mod.process_role()
+    pid = os.getpid()
+    shard = {
+        "schema": SHARD_SCHEMA,
+        "shard_id": _shard_id(role, pid, host),
+        "anchor": anchor,
+        "role": role,
+        "pid": pid,
+        "hostname": host,
+        "metrics": scratch.dump_state(),
+        **dict(extra or {}),
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp{pid}"
+    with open(tmp, "w") as f:
+        json.dump(shard, f)
+    os.replace(tmp, path)
+    return path
+
+
+def load_registry_shard(path: str) -> dict:
+    try:
+        with open(path) as f:
+            shard = json.load(f)
+    except (OSError, ValueError) as e:
+        raise FleetMergeError(f"{path}: unreadable registry shard ({e})") \
+            from e
+    if not isinstance(shard, dict) or shard.get("schema") != SHARD_SCHEMA:
+        raise FleetMergeError(
+            f"{path}: not a {SHARD_SCHEMA} registry shard "
+            f"(schema={shard.get('schema') if isinstance(shard, dict) else None!r})"
+        )
+    return shard
+
+
+def collect_shards(
+    source,
+    registry: Optional[MetricsRegistry] = None,
+) -> tuple[MetricsRegistry, list[dict]]:
+    """Fold registry shards into one fleet-level registry.
+
+    ``source`` is a directory (scanned for ``registry.*.json``) or an
+    explicit path list. Shards dedup by ``shard_id`` with
+    latest-anchor-wins — collecting the same shard twice (or a stale copy
+    next to a fresh one) changes nothing. Returns (registry, shard-meta
+    rows); ``registry.to_prometheus()`` is the fleet exposition.
+    """
+    if isinstance(source, (str, os.PathLike)):
+        paths = sorted(glob.glob(os.path.join(str(source),
+                                              "registry.*.json")))
+    else:
+        paths = list(source)
+    agg = registry if registry is not None else MetricsRegistry()
+    metas = []
+    for p in paths:
+        shard = load_registry_shard(p)
+        agg.merge(shard.get("metrics", {}), anchor=shard.get("anchor"),
+                  shard_id=shard.get("shard_id") or p)
+        metas.append({k: shard.get(k) for k in
+                      ("shard_id", "anchor", "role", "pid", "hostname")}
+                     | {"path": os.path.abspath(p)})
+    metas.sort(key=lambda m: (m.get("role") or "", m.get("pid") or 0))
+    return agg, metas
+
+
+# --------------------------------------------------------- journal merge
+
+
+def _row_time(row: Mapping) -> float:
+    """Best-effort wall time of one journal row: the sub-second ``t``
+    float when present (stamped since the fleet work), else the ISO
+    ``time`` string parsed at second resolution, else 0."""
+    t = row.get("t")
+    if isinstance(t, (int, float)):
+        return float(t)
+    iso = row.get("time")
+    if isinstance(iso, str):
+        for fmt in ("%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%M:%S"):
+            try:
+                return float(calendar.timegm(time.strptime(iso, fmt)))
+            except ValueError:
+                continue
+    return 0.0
+
+
+def merge_journals(paths: Sequence[str]) -> list[dict]:
+    """Interleave recovery/patch journals from all processes/attempts into
+    one causally ordered stream. Rows sort by wall time, then source file
+    order (same-second rows from ONE process never reorder — the
+    append-only file order IS their causal order); each row gains
+    ``_journal`` naming its source. Unparseable lines are skipped (a torn
+    tail from a crashed writer must not kill the report)."""
+    rows = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict):
+                rows.append((_row_time(row), os.path.abspath(p), i, row))
+    rows.sort(key=lambda r: (r[0], r[1], r[2]))
+    return [{**row, "_journal": os.path.basename(path)}
+            for _, path, _, row in rows]
+
+
+# ------------------------------------------------------------- discovery
+
+
+@dataclasses.dataclass
+class FleetRunFiles:
+    """Artifact families found under one run directory."""
+
+    run_dir: str
+    traces: list
+    registry_shards: list
+    metrics_jsonl: list
+    journals: list
+    patch_journals: list
+    bench_artifacts: list
+
+    @property
+    def empty(self) -> bool:
+        return not (self.traces or self.registry_shards
+                    or self.metrics_jsonl or self.journals)
+
+
+def discover(run_dir: str, max_depth: int = 4) -> FleetRunFiles:
+    """Scan a run directory for the telemetry convention's artifacts.
+
+    Layout (docs/observability.md §"Fleet view"): ``--telemetry-dir``
+    writes ``trace.<role>.<pid>.json`` and ``registry.<role>.<pid>.json``
+    per process; driver output dirs nested under the run root contribute
+    ``*metrics*.jsonl`` histories, ``recovery*.jsonl`` journals, and
+    ``patch-journal.jsonl``. Bench artifacts (``BENCH_DETAILS*.json`` /
+    ``BENCH_r*.json``) join the report when present.
+    """
+    run_dir = os.path.abspath(run_dir)
+    out = FleetRunFiles(run_dir=run_dir, traces=[], registry_shards=[],
+                        metrics_jsonl=[], journals=[], patch_journals=[],
+                        bench_artifacts=[])
+    base_depth = run_dir.rstrip(os.sep).count(os.sep)
+    for root, dirs, files in os.walk(run_dir):
+        if root.count(os.sep) - base_depth >= max_depth:
+            dirs[:] = []
+        dirs.sort()
+        for name in sorted(files):
+            path = os.path.join(root, name)
+            if name.startswith("trace.") and name.endswith(".json"):
+                out.traces.append(path)
+            elif (name.endswith("-trace.json")
+                  or name.endswith("_trace.json")):
+                out.traces.append(path)
+            elif name.startswith("registry.") and name.endswith(".json"):
+                out.registry_shards.append(path)
+            elif name.startswith("recovery") and name.endswith(".jsonl"):
+                out.journals.append(path)
+            elif name == "patch-journal.jsonl":
+                out.patch_journals.append(path)
+            elif name.endswith(".jsonl") and "metrics" in name:
+                out.metrics_jsonl.append(path)
+            elif name.startswith(("BENCH_DETAILS", "BENCH_r")) \
+                    and name.endswith(".json"):
+                out.bench_artifacts.append(path)
+    return out

@@ -135,6 +135,17 @@ class Counter:
                 } | ({"": self._value} if self._value else {})
             return self._value
 
+    def fold_series(self, labels: Mapping[str, str], value: float) -> None:
+        """Merge primitive (obs/fleet.py): add one (labels, value) series
+        from another process's shard. Counters SUM — bypasses ``inc``'s
+        identifier-keyed kwargs so arbitrary label keys round-trip."""
+        with self._lock:
+            if labels:
+                k = self._key(labels)
+                self._children[k] = self._children.get(k, 0.0) + float(value)
+            else:
+                self._value += float(value)
+
 
 class Gauge(Counter):
     """Settable instantaneous value; ``fn`` makes it a callback gauge read
@@ -188,6 +199,16 @@ class Gauge(Counter):
             }
         return super().snapshot_value()
 
+    def fold_series(self, labels: Mapping[str, str], value: float) -> None:
+        """Merge primitive: gauges are instantaneous, so a fold REPLACES
+        the series value — latest-by-anchor ordering is the registry's job
+        (``MetricsRegistry.merge`` folds shards in anchor order)."""
+        with self._lock:
+            if labels:
+                self._children[self._key(labels)] = float(value)
+            else:
+                self._value = float(value)
+
 
 class HistogramMetric:
     """A named ``LatencyHistogram`` exported as a Prometheus summary."""
@@ -237,6 +258,13 @@ class MetricsRegistry:
         self.prefix = prefix
         self._lock = threading.Lock()
         self._metrics: dict[str, object] = {}
+        # Fleet-merge bookkeeping (docs/observability.md §"Fleet view"):
+        # per-shard retained states (shard_id -> (anchor, state)) so
+        # re-merging a shard REPLACES its contribution instead of
+        # double-counting, and per-gauge-series anchors so gauges resolve
+        # latest-by-anchor whatever order shards arrive in.
+        self._shard_states: dict[str, tuple] = {}
+        self._fold_anchors: dict[tuple, float] = {}
 
     def _get(self, name: str, factory, kind) -> object:
         with self._lock:
@@ -283,6 +311,149 @@ class MetricsRegistry:
             reset = getattr(m, "reset", None)
             if reset is not None:
                 reset()
+
+    # ----------------------------------------------- fleet merge protocol
+    #
+    # The aggregation substrate the multi-process topology needs
+    # (obs/fleet.py; docs/observability.md §"Fleet view"). Semantics:
+    # counters SUM, gauges keep the value from the LATEST anchor (wall
+    # clock at shard export), histograms merge bin counts exactly. The
+    # pairwise fold is associative and commutative; idempotence ("a
+    # double-collected shard changes nothing") comes from the shard
+    # protocol — merge with a shard_id retains per-shard state and a
+    # re-merge REPLACES that shard's contribution instead of adding it
+    # again (the SolverCostTable.merge precedent from the mesh work).
+
+    def dump_state(self) -> dict:
+        """Full mergeable state: counter/gauge series with label dicts,
+        histograms as raw bin counts (JSON-serializable — the registry-
+        shard wire format)."""
+        with self._lock:
+            metrics = dict(self._metrics)
+        out = {}
+        for name, m in sorted(metrics.items()):
+            if isinstance(m, HistogramMetric):
+                out[name] = {"kind": "summary", "help": m.help,
+                             "state": m.histogram.state()}
+            else:
+                out[name] = {
+                    "kind": m.kind, "help": m.help,
+                    "series": [[labels, value] for labels, value
+                               in m.collect()],
+                }
+        return out
+
+    def _fold(self, state: Mapping, anchor: float) -> None:
+        import logging
+
+        for name, spec in state.items():
+            kind = spec.get("kind")
+            help_ = spec.get("help", "")
+            if kind == "summary":
+                hstate = spec["state"]
+                with self._lock:
+                    absent = name not in self._metrics
+                if absent:
+                    # Create with the SHARD's bin layout, not the default:
+                    # a component exporting a non-default LatencyHistogram
+                    # must fold, not mismatch.
+                    self.histogram(
+                        name, help_,
+                        histogram=LatencyHistogram.from_state(hstate))
+                    continue
+                try:
+                    self.histogram(name, help_).histogram.merge_state(
+                        hstate)
+                except (ValueError, TypeError, KeyError) as e:
+                    # One incompatible shard histogram must not kill the
+                    # whole aggregation (the run report's contract) —
+                    # skip the metric, loudly.
+                    logging.getLogger("photon_tpu.obs").warning(
+                        "fleet merge: skipping histogram %r (%s)", name, e)
+            elif kind == "gauge":
+                g = self.gauge(name, help_)
+                for labels, value in spec.get("series", ()):
+                    key = (name, tuple(sorted(
+                        (str(k), str(v)) for k, v in labels.items())))
+                    if anchor >= self._fold_anchors.get(key, float("-inf")):
+                        self._fold_anchors[key] = anchor
+                        g.fold_series(labels, value)
+            elif kind == "counter":
+                c = self.counter(name, help_)
+                for labels, value in spec.get("series", ()):
+                    if value:
+                        c.fold_series(labels, value)
+            # unknown kinds are skipped: a newer shard schema must not
+            # kill an older aggregator
+
+    @staticmethod
+    def _state_delta(new: Mapping, old: Mapping) -> dict:
+        """``new - old`` as a foldable state: the replacement delta for a
+        re-exported shard. Counters/histogram bins subtract elementwise
+        (a restarted shard's lower counts fold as a negative correction);
+        gauges pass through as-is (the fold's latest-anchor rule decides);
+        a histogram max watermark is monotone (max of the two)."""
+        out: dict = {}
+        for name, spec in new.items():
+            prev = old.get(name)
+            if prev is None or prev.get("kind") != spec.get("kind"):
+                out[name] = spec
+                continue
+            kind = spec.get("kind")
+            if kind == "counter":
+                old_by = {tuple(sorted((str(k), str(v))
+                                       for k, v in labels.items())): value
+                          for labels, value in prev.get("series", ())}
+                series = []
+                for labels, value in spec.get("series", ()):
+                    key = tuple(sorted((str(k), str(v))
+                                       for k, v in labels.items()))
+                    series.append([labels, value - old_by.pop(key, 0.0)])
+                for key, value in old_by.items():  # vanished series
+                    series.append([dict(key), -value])
+                out[name] = {**spec, "series": series}
+            elif kind == "summary":
+                ns, os_ = spec["state"], prev["state"]
+                if (len(ns.get("counts", ())) != len(os_.get("counts", ()))
+                        or ns.get("lo_ms") != os_.get("lo_ms")):
+                    out[name] = spec  # layout changed: fold whole (skipped
+                    continue          # by merge_state's mismatch guard)
+                out[name] = {**spec, "state": {
+                    **ns,
+                    "counts": [int(a) - int(b) for a, b
+                               in zip(ns["counts"], os_["counts"])],
+                    "sum": float(ns["sum"]) - float(os_["sum"]),
+                    "n": int(ns["n"]) - int(os_["n"]),
+                    "max": max(float(ns["max"]), float(os_["max"])),
+                }}
+            else:
+                out[name] = spec
+        return out
+
+    def merge(self, other, anchor: Optional[float] = None,
+              shard_id: Optional[str] = None) -> "MetricsRegistry":
+        """Fold another registry (or a :meth:`dump_state` dict) into this
+        one. ``anchor`` is the state's export wall time (defaults to now)
+        — it decides which gauge value is "latest". With ``shard_id`` the
+        merge is idempotent per shard: a re-merge with the same or an
+        older anchor is a no-op; a newer anchor REPLACES that shard's
+        previous contribution by folding the DELTA between the retained
+        and new states — live instruments are updated in place, so the
+        registry's own (non-shard) counters and any held instrument
+        references stay attached and keep counting between merges."""
+        state = other.dump_state() if isinstance(
+            other, MetricsRegistry) else dict(other)
+        anchor = time.time() if anchor is None else float(anchor)
+        if shard_id is None:
+            self._fold(state, anchor)
+            return self
+        prev = self._shard_states.get(shard_id)
+        if prev is not None and prev[0] >= anchor:
+            return self  # idempotent: double-collected shard changes nothing
+        delta = state if prev is None else self._state_delta(state, prev[1])
+        self._shard_states[shard_id] = (anchor, state)
+        self._fold(delta, anchor)
+        return self
 
     # ------------------------------------------------------------ exports
 

@@ -24,6 +24,11 @@ Design constraints (docs/observability.md):
 * **One artifact.** Events buffer in memory (bounded) and
   :func:`stop_tracing` writes a single ``{"traceEvents": [...]}`` JSON
   object; ``scripts/obs_smoke.py`` validates the format in CI.
+* **Mergeable across processes.** Every collector stamps a
+  :data:`ANCHOR_EVENT` metadata instant at install — the wall-clock ↔
+  ``perf_counter`` correspondence plus pid/hostname/role — so
+  ``obs.fleet.merge_traces`` can align N per-process shards onto one
+  wall-clock timeline (docs/observability.md §"Fleet view").
 
 Span taxonomy (``cat`` → ``name``) is documented in docs/observability.md.
 """
@@ -34,12 +39,17 @@ import json
 import os
 import threading
 import time
+import zlib
 from typing import Optional
 
 __all__ = [
+    "ANCHOR_EVENT",
+    "ANCHOR_SCHEMA",
     "TraceCollector",
     "trace_span",
     "instant",
+    "process_role",
+    "set_process_role",
     "start_tracing",
     "stop_tracing",
     "suspend_tracing",
@@ -63,6 +73,72 @@ _tls = threading.local()
 # host memory without bound. Dropped events are counted and reported in the
 # written artifact ("photon.trace.dropped" metadata event).
 _DEFAULT_MAX_EVENTS = 1_000_000
+
+# Default cap on the (approximate) serialized artifact size: a multi-day
+# serve run with tracing on must bound disk, like PHOTON_METRICS_MAX_BYTES
+# bounds the metrics JSONL. Crossing it drops further events LOUDLY — one
+# "photon.trace.truncated" instant plus a log warning — never silently.
+_DEFAULT_MAX_BYTES = 256 << 20
+
+#: Per-process anchor metadata event: the wall-clock ↔ perf_counter
+#: correspondence every trace shard carries so the fleet merger can align
+#: clocks across processes/hosts. Stamped once at collector install.
+ANCHOR_EVENT = "photon.anchor"
+ANCHOR_SCHEMA = "photon-anchor/1"
+
+# Process role stamped into the anchor (and the Perfetto process_name
+# lane): "training" / "serving" / "online" / ... — set by the drivers via
+# set_process_role BEFORE the collector installs.
+_ROLE = os.environ.get("PHOTON_PROCESS_ROLE") or "unknown"
+
+
+def set_process_role(role: str) -> None:
+    """Declare this process's fleet role ("training", "serving", "online",
+    ...). Call before :func:`start_tracing` — the role is stamped into the
+    collector's anchor event at install and cannot retroactively rename an
+    already-written shard."""
+    global _ROLE
+    _ROLE = str(role)
+
+
+def process_role() -> str:
+    return _ROLE
+
+
+def _env_max_bytes() -> int:
+    try:
+        return int(os.environ.get("PHOTON_TRACE_MAX_BYTES",
+                                  _DEFAULT_MAX_BYTES))
+    except (TypeError, ValueError):
+        return _DEFAULT_MAX_BYTES
+
+
+def _env_sample() -> float:
+    """PHOTON_TRACE_SAMPLE in (0, 1]: opt-in span sampling for long serve
+    runs (1.0 = keep everything). Malformed values degrade to 1.0 — a
+    typo'd knob must never kill tracing."""
+    raw = os.environ.get("PHOTON_TRACE_SAMPLE")
+    if not raw:
+        return 1.0
+    try:
+        rate = float(raw)
+    except (TypeError, ValueError):
+        return 1.0
+    if not 0.0 < rate <= 1.0:
+        return 1.0
+    return rate
+
+
+def _approx_event_bytes(event: dict) -> int:
+    """Cheap serialized-size estimate (no json.dumps on the hot path):
+    fixed framing + name/cat + per-arg key and string-value lengths
+    (numbers priced at a flat 12 bytes)."""
+    n = 90 + len(event.get("name", "")) + len(event.get("cat", ""))
+    args = event.get("args")
+    if args:
+        for k, v in args.items():
+            n += len(k) + (len(v) if isinstance(v, str) else 12) + 6
+    return n
 
 
 def new_trace_id() -> str:
@@ -98,20 +174,135 @@ class trace_context:
 
 
 class TraceCollector:
-    """Thread-safe in-memory buffer of Chrome trace events."""
+    """Thread-safe in-memory buffer of Chrome trace events.
 
-    def __init__(self, max_events: int = _DEFAULT_MAX_EVENTS):
+    Bounds (all loud, never silent): ``max_events`` caps the buffer,
+    ``max_bytes`` (env ``PHOTON_TRACE_MAX_BYTES``, default 256 MB, 0
+    disables) caps the approximate serialized size — the first event over
+    the cap lands one ``photon.trace.truncated`` instant plus a log
+    warning, then further events drop. ``sample`` (env
+    ``PHOTON_TRACE_SAMPLE``, default 1.0) keeps that fraction of SPANS —
+    whole trace-id chains kept or dropped together so cross-thread /
+    cross-process joins survive sampling; instants (faults, SLO verdicts,
+    anchors) are never sampled out.
+
+    The anchor metadata (``ANCHOR_EVENT`` + a Perfetto ``process_name``
+    lane label) lives in :attr:`meta`, merged into the artifact at
+    :meth:`to_dict` — so ``events`` stays exactly the span/instant stream.
+    """
+
+    def __init__(self, max_events: int = _DEFAULT_MAX_EVENTS,
+                 max_bytes: Optional[int] = None,
+                 sample: Optional[float] = None):
         self.max_events = int(max_events)
+        self.max_bytes = _env_max_bytes() if max_bytes is None else int(
+            max_bytes)
+        self.sample = _env_sample() if sample is None else float(sample)
         self.events: list[dict] = []
+        self.meta: list[dict] = []
         self.dropped = 0
+        self.sampled_out = 0
+        self.truncated = False
+        self._approx_bytes = 0
+        self._span_seen = 0
         self._lock = threading.Lock()
         self._pid = os.getpid()
+        self._stamp_anchor()
+
+    def _stamp_anchor(self) -> None:
+        """The fleet-merge contract (docs/observability.md §"Fleet view"):
+        wall clock and perf_counter read back-to-back at install, so a
+        merger can map any event's ``ts`` to wall time via
+        ``anchor.wall_time + (ts - anchor.ts) / 1e6``."""
+        import socket
+
+        pc = time.perf_counter()
+        wall = time.time()
+        try:
+            host = socket.gethostname()
+        except OSError:
+            host = "unknown"
+        role = process_role()
+        tid = threading.get_ident() & 0xFFFFFFFF
+        self.meta.append({
+            "name": "process_name", "ph": "M", "ts": 0,
+            "pid": self._pid, "tid": 0,
+            "args": {"name": f"{role}@{host} pid={self._pid}"},
+        })
+        anchor = {
+            "name": ANCHOR_EVENT,
+            "cat": "meta",
+            "ph": "i",
+            "s": "p",
+            "ts": round((pc - _EPOCH) * 1e6, 1),
+            "pid": self._pid,
+            "tid": tid,
+            "args": {
+                "schema": ANCHOR_SCHEMA,
+                "wall_time": wall,
+                "perf_counter": pc,
+                "pid": self._pid,
+                "hostname": host,
+                "role": role,
+                **({"sample": self.sample} if self.sample < 1.0 else {}),
+            },
+        }
+        self.meta.append(anchor)
+
+    def _note_truncation(self) -> None:
+        """One loud event + warning at the size cap, then silence-by-count
+        (the drop counter still lands in the artifact)."""
+        import logging
+
+        self.events.append({
+            "name": "photon.trace.truncated", "cat": "meta", "ph": "i",
+            "s": "p",
+            "ts": round((time.perf_counter() - _EPOCH) * 1e6, 1),
+            "pid": self._pid,
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+            "args": {"max_bytes": self.max_bytes,
+                     "events_kept": len(self.events)},
+        })
+        logging.getLogger("photon_tpu.obs").warning(
+            "trace buffer hit PHOTON_TRACE_MAX_BYTES=%d after %d events — "
+            "further events are DROPPED (counted in the artifact). Raise "
+            "the cap, or set PHOTON_TRACE_SAMPLE<1 for long serve runs.",
+            self.max_bytes, len(self.events),
+        )
+
+    def _keep_span(self, args: Optional[dict]) -> bool:
+        """Sampling decision for one span: hash the trace id when present
+        (whole request chains stay intact across threads AND processes —
+        the id, not the process's counter, decides); fall back to a
+        deterministic 1-in-N counter for context-free spans."""
+        if self.sample >= 1.0:
+            return True
+        tid = (args or {}).get("trace_id")
+        with self._lock:
+            if tid is not None:
+                keep = (zlib.crc32(str(tid).encode()) & 0xFFFF) / 65536.0 \
+                    < self.sample
+            else:
+                self._span_seen += 1
+                keep = int(self._span_seen * self.sample) != int(
+                    (self._span_seen - 1) * self.sample)
+            if not keep:
+                self.sampled_out += 1
+        return keep
 
     def add(self, event: dict) -> None:
         with self._lock:
-            if len(self.events) >= self.max_events:
+            if self.truncated or len(self.events) >= self.max_events:
                 self.dropped += 1
                 return
+            if self.max_bytes > 0:
+                est = _approx_event_bytes(event)
+                if self._approx_bytes + est > self.max_bytes:
+                    self.truncated = True
+                    self.dropped += 1
+                    self._note_truncation()
+                    return
+                self._approx_bytes += est
             self.events.append(event)
 
     def complete(
@@ -123,6 +314,8 @@ class TraceCollector:
         args: Optional[dict] = None,
     ) -> None:
         """One 'X' (complete) event; ``t0`` is a perf_counter value."""
+        if self.sample < 1.0 and not self._keep_span(args):
+            return
         self.add({
             "name": name,
             "cat": cat,
@@ -156,11 +349,18 @@ class TraceCollector:
 
     def to_dict(self) -> dict:
         with self._lock:
-            events = list(self.events)
+            events = self.meta + self.events
             dropped = self.dropped
+            sampled_out = self.sampled_out
+            truncated = self.truncated
         out = {"traceEvents": events, "displayTimeUnit": "ms"}
         if dropped:
             out["photon.trace.dropped"] = dropped
+        if sampled_out:
+            out["photon.trace.sampled_out"] = sampled_out
+            out["photon.trace.sample"] = self.sample
+        if truncated:
+            out["photon.trace.truncated_at_bytes"] = self.max_bytes
         return out
 
     def write(self, path: str) -> str:

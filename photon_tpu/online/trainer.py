@@ -155,10 +155,20 @@ class HttpPublisher:
         import urllib.error
         import urllib.request
 
+        from photon_tpu.obs import current_trace_id
+
+        headers = {"Content-Type": "application/json"}
+        # Cross-process trace join (docs/observability.md §"Fleet view"):
+        # the publish span's trace id rides the request so the serving
+        # process's /admin/patch spans land on the SAME id — the fleet
+        # merger then shows event→refresh→publish→apply as one flow.
+        tid = current_trace_id()
+        if tid is not None:
+            headers["X-Photon-Trace-Id"] = tid
         req = urllib.request.Request(
             self.base_url + "/admin/patch",
             data=json.dumps(delta.to_wire()).encode("utf-8"),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="POST",
         )
         try:
@@ -775,8 +785,15 @@ class OnlineTrainer:
         solved_keys = {cid: [k for k, _, _ in dirty]
                        for cid, dirty in plan.items()}
         publish_result = None
-        with trace_span("online.publish", cat="online", seq=delta.seq,
-                        entities=delta.n_entities) as sp:
+        from photon_tpu.obs import current_trace_id, new_trace_id, \
+            trace_context
+
+        # One trace id per publish, attached to this thread so the span
+        # below AND the HttpPublisher's X-Photon-Trace-Id header carry it
+        # — the serving side joins on the same id (fleet merge contract).
+        with trace_context(current_trace_id() or new_trace_id()), \
+                trace_span("online.publish", cat="online", seq=delta.seq,
+                           entities=delta.n_entities) as sp:
             fault_point("online.publish", seq=delta.seq)
             if self.publisher is not None:
                 publish_result = self.publisher.publish(delta)

@@ -256,7 +256,12 @@ class ScoringServer:
                 # Trace root: one trace id per request, attached to this
                 # thread for the admission spans and carried across the
                 # batcher boundary on the queue item (docs/observability.md).
-                with trace_context(new_trace_id()), \
+                # A client-supplied X-Photon-Trace-Id joins this server's
+                # spans to the CALLER's trace shard — the fleet merger
+                # renders the cross-process flow as one timeline
+                # (docs/observability.md §"Fleet view").
+                tid = self.headers.get("X-Photon-Trace-Id") or new_trace_id()
+                with trace_context(tid), \
                         trace_span("serve.request", cat="serving") as req_span:
                     self._score_traced(req_span)
 
@@ -383,7 +388,17 @@ class ScoringServer:
                 """Online model delta (docs/online.md §"Delta protocol"):
                 changed-entity coefficient patches applied atomically to
                 the current version's coefficient stores, device hot-set
-                invalidated only for the patched entities."""
+                invalidated only for the patched entities. The publisher's
+                X-Photon-Trace-Id (HttpPublisher attaches its publish
+                span's id) carries through this handler's span and the
+                serving.delta_applied instant, so the merged fleet
+                timeline shows event→refresh→publish→apply as ONE flow."""
+                tid = self.headers.get("X-Photon-Trace-Id")
+                with trace_context(tid or new_trace_id()), \
+                        trace_span("serve.patch", cat="serving"):
+                    self._patch_traced()
+
+            def _patch_traced(self):
                 try:
                     payload = self._read_json()
                     from photon_tpu.online.delta import ModelDelta

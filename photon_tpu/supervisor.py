@@ -358,6 +358,11 @@ class RecoveryJournal:
 
         row = {
             "time": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            # Sub-second wall stamp: the fleet journal merger
+            # (obs/fleet.merge_journals) interleaves rows from concurrent
+            # processes/attempts causally — the ISO second alone cannot
+            # order a restart racing its predecessor's final record.
+            "t": round(time.time(), 6),
             "event": event,
             "pid": os.getpid(),
             **fields,

@@ -204,6 +204,7 @@ class LatencyHistogram:
         bins_per_decade: int = 20,
     ):
         self._lo = lo_ms / 1e3
+        self._bins_per_decade = int(bins_per_decade)
         self._ratio = 10.0 ** (1.0 / bins_per_decade)
         self._log_ratio = math.log(self._ratio)
         n = int(math.ceil(math.log(hi_ms / lo_ms) / self._log_ratio)) + 1
@@ -253,3 +254,57 @@ class LatencyHistogram:
             "p99_ms": round(self.quantile_ms(0.99), 3),
             "max_ms": round(mx * 1e3, 3),
         }
+
+    # -------------------------------------------------- fleet aggregation
+    #
+    # Full mergeable state (not just the quantile snapshot): per-process
+    # registry shards dump it, the fleet aggregator adds bin counts
+    # elementwise — exact, associative, commutative (obs/fleet.py).
+
+    def state(self) -> dict:
+        with self._lock:
+            return {
+                "lo_ms": self._lo * 1e3,
+                "bins_per_decade": self._bins_per_decade,
+                "counts": list(self._counts),
+                "sum": self._sum,
+                "max": self._max,
+                "n": self._n,
+            }
+
+    @classmethod
+    def from_state(cls, state: Mapping[str, Any]) -> "LatencyHistogram":
+        """Reconstruct a histogram with EXACTLY the state's bin layout —
+        the aggregator's entry point for a shard whose exporter used a
+        non-default layout (bin count is restored verbatim, not re-derived
+        from a hi_ms round-trip)."""
+        h = cls(lo_ms=float(state["lo_ms"]),
+                bins_per_decade=int(state.get("bins_per_decade", 20)))
+        with h._lock:
+            h._counts = [int(c) for c in state["counts"]]
+            h._sum = float(state["sum"])
+            h._max = float(state["max"])
+            h._n = int(state["n"])
+        return h
+
+    def merge_state(self, state: Mapping[str, Any]) -> None:
+        """Fold another histogram's :meth:`state` into this one. Refuses a
+        mismatched bin layout — summing misaligned bins would silently
+        corrupt every quantile downstream."""
+        counts = state["counts"]
+        if (len(counts) != len(self._counts)
+                or abs(float(state["lo_ms"]) - self._lo * 1e3) > 1e-9
+                or int(state.get("bins_per_decade",
+                                 self._bins_per_decade))
+                != self._bins_per_decade):
+            raise ValueError(
+                "histogram bin layout mismatch: cannot merge "
+                f"{len(counts)} bins @ lo={state['lo_ms']}ms into "
+                f"{len(self._counts)} bins @ lo={self._lo * 1e3}ms"
+            )
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += int(c)
+            self._sum += float(state["sum"])
+            self._max = max(self._max, float(state["max"]))
+            self._n += int(state["n"])

@@ -1,0 +1,545 @@
+"""Fleet observability: aggregation semantics (docs/observability.md
+§"Fleet view").
+
+Covers the PR's contracts: MetricsRegistry.merge algebra (associative /
+commutative pairwise fold; idempotence through the shard protocol — a
+double-collected shard changes nothing), trace-shard merging under
+deliberately skewed clock anchors (spans stay wall-ordered, cross-process
+trace-id joins survive, anchor-less shards refuse loudly while
+single-trace analysis still works), journal merging across interleaved
+attempts, the trace size-bound/sampling knobs, and the metrics-stream
+anomaly detector (flags an injected level shift, stays quiet on
+stationary + constant synthetic series).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import pytest
+
+from photon_tpu.obs import fleet
+from photon_tpu.obs import trace as trace_mod
+from photon_tpu.obs.analysis.report import (
+    REPORT_SCHEMA,
+    anomaly_scan,
+    build_report,
+    detect_level_shifts,
+    format_markdown,
+)
+from photon_tpu.obs.metrics import MetricsRegistry
+
+
+# ---------------------------------------------------- registry merge algebra
+
+
+def _reg(counter=0.0, labeled=(), gauge=None, hist=()):
+    r = MetricsRegistry()
+    if counter:
+        r.counter("reqs").inc(counter)
+    for labels, v in labeled:
+        r.counter("by_cause").fold_series(labels, v)
+    if gauge is not None:
+        r.gauge("depth").set(gauge)
+    for v in hist:
+        r.histogram("lat").observe(v)
+    return r
+
+
+def test_merge_counters_sum_and_histograms_merge():
+    a = _reg(counter=3, labeled=[({"cause": "oom"}, 2)], hist=[0.01, 0.02])
+    b = _reg(counter=4, labeled=[({"cause": "oom"}, 1),
+                                 ({"cause": "io"}, 5)], hist=[0.04])
+    agg = MetricsRegistry()
+    agg.merge(a, anchor=1.0)
+    agg.merge(b, anchor=2.0)
+    snap = agg.snapshot()
+    assert snap["reqs"] == 7.0
+    assert snap["by_cause"] == {"io": 5.0, "oom": 3.0}
+    assert snap["lat"]["count"] == 3
+
+
+def test_merge_gauges_latest_anchor_wins_any_order():
+    a = _reg(gauge=10)
+    b = _reg(gauge=99)
+    fwd = MetricsRegistry()
+    fwd.merge(a, anchor=1.0)
+    fwd.merge(b, anchor=2.0)
+    rev = MetricsRegistry()
+    rev.merge(b, anchor=2.0)
+    rev.merge(a, anchor=1.0)  # older anchor must NOT clobber
+    assert fwd.snapshot()["depth"] == 99.0
+    assert rev.snapshot()["depth"] == 99.0  # commutative for gauges too
+
+
+def test_merge_associative_and_commutative():
+    regs = [
+        _reg(counter=1, hist=[0.01]),
+        _reg(counter=2, hist=[0.1, 0.2]),
+        _reg(counter=4, hist=[1.0]),
+    ]
+
+    def fold(order):
+        agg = MetricsRegistry()
+        for i in order:
+            agg.merge(regs[i], anchor=float(i))
+        return agg.snapshot()
+
+    left = fold([0, 1, 2])
+    right = fold([2, 1, 0])
+    mid = fold([1, 0, 2])
+    assert left == right == mid
+    assert left["reqs"] == 7.0 and left["lat"]["count"] == 4
+
+
+def test_shard_merge_idempotent():
+    src = _reg(counter=5, gauge=3, hist=[0.02])
+    state = src.dump_state()
+    agg = MetricsRegistry()
+    agg.merge(state, anchor=10.0, shard_id="hostA:1:serving")
+    once = agg.snapshot()
+    # Re-merging the identical shard (same or older anchor): NO change.
+    agg.merge(state, anchor=10.0, shard_id="hostA:1:serving")
+    agg.merge(state, anchor=5.0, shard_id="hostA:1:serving")
+    assert agg.snapshot() == once
+    # A NEWER state for the same shard REPLACES its contribution (the
+    # counter does not double).
+    src.counter("reqs").inc(1)
+    agg.merge(src.dump_state(), anchor=11.0, shard_id="hostA:1:serving")
+    assert agg.snapshot()["reqs"] == 6.0
+
+
+def test_histogram_merge_refuses_mismatched_bins():
+    from photon_tpu.utils.logging import LatencyHistogram
+
+    a = LatencyHistogram()
+    b = LatencyHistogram(bins_per_decade=10)
+    with pytest.raises(ValueError, match="bin layout"):
+        a.merge_state(b.state())
+
+
+def test_registry_fold_skips_mismatched_histogram_instead_of_raising():
+    """One incompatible shard histogram must not kill the whole fleet
+    aggregation (the run report's never-a-failure-mode contract)."""
+    from photon_tpu.utils.logging import LatencyHistogram
+
+    coarse = MetricsRegistry()
+    coarse.histogram("lat", histogram=LatencyHistogram(
+        bins_per_decade=10)).observe(0.01)
+    agg = MetricsRegistry()
+    agg.counter("ok").inc(1)
+    agg.histogram("lat").observe(0.02)  # default layout already present
+    agg.merge(coarse, anchor=1.0)  # mismatched layout: skipped, not fatal
+    snap = agg.snapshot()
+    assert snap["ok"] == 1.0 and snap["lat"]["count"] == 1
+
+
+def test_registry_fold_adopts_foreign_histogram_layout():
+    """A shard exporting a non-default LatencyHistogram layout folds into
+    a fresh aggregator exactly (bin layout adopted from the state)."""
+    from photon_tpu.utils.logging import LatencyHistogram
+
+    src = MetricsRegistry()
+    src.histogram("lat", histogram=LatencyHistogram(
+        bins_per_decade=10)).observe(0.05)
+    agg = MetricsRegistry()
+    agg.merge(src, anchor=1.0)
+    agg.merge(src, anchor=2.0)  # second shard-style fold: bins must match
+    assert agg.snapshot()["lat"]["count"] == 2
+
+
+def test_shard_merge_preserves_live_instruments_and_local_updates():
+    """Shard replacement folds DELTAS in place: the aggregator's own
+    counters keep counting between merges, and held instrument
+    references never orphan (the collect-into-live-registry path)."""
+    agg = MetricsRegistry()
+    held = agg.counter("local")
+    held.inc(5)
+    src = _reg(counter=3, hist=[0.01])
+    agg.merge(src.dump_state(), anchor=1.0, shard_id="A")
+    held.inc(1)  # local mutation BETWEEN shard merges
+    src.counter("reqs").inc(2)  # shard re-exports with more counts
+    agg.merge(src.dump_state(), anchor=2.0, shard_id="A")
+    snap = agg.snapshot()
+    assert snap["local"] == 6.0          # local increments survived
+    assert snap["reqs"] == 5.0           # replaced, not doubled
+    held.inc(1)
+    assert agg.snapshot()["local"] == 7.0  # reference still attached
+
+
+def test_write_and_collect_shards_double_collection_noop(tmp_path):
+    r1 = _reg(counter=3, hist=[0.01])
+    r2 = _reg(counter=4, gauge=7)
+    p1 = str(tmp_path / "registry.serving.1.json")
+    p2 = str(tmp_path / "registry.online.2.json")
+    fleet.write_registry_shard(p1, [r1], role="serving")
+    fleet.write_registry_shard(p2, [r2], role="online")
+    agg, metas = fleet.collect_shards(str(tmp_path))
+    assert agg.snapshot()["reqs"] == 7.0
+    assert {m["role"] for m in metas} == {"online", "serving"}
+    # Double-collection: same shards again, including a stale duplicate.
+    agg2, _ = fleet.collect_shards([p1, p2, p1, p2, p1])
+    assert agg2.snapshot()["reqs"] == 7.0
+    # Prometheus exposition over the fleet registry stays well-formed.
+    assert "photon_reqs 7" in agg.to_prometheus()
+
+
+def test_collect_shards_refuses_wrong_schema(tmp_path):
+    p = tmp_path / "registry.bogus.9.json"
+    p.write_text(json.dumps({"schema": "something-else/1"}))
+    with pytest.raises(fleet.FleetMergeError, match="registry shard"):
+        fleet.collect_shards([str(p)])
+
+
+# --------------------------------------------------------------- trace merge
+
+
+def _write_shard(path, role, pid, wall_time, anchor_ts_us, events):
+    """A synthetic trace shard with a hand-built anchor: ``anchor_ts_us``
+    is the shard's process-local clock at ``wall_time`` — skew the two
+    across shards to prove alignment uses the anchor, not raw ts."""
+    doc = {"traceEvents": [
+        {"name": trace_mod.ANCHOR_EVENT, "cat": "meta", "ph": "i", "s": "p",
+         "ts": anchor_ts_us, "pid": pid, "tid": 1,
+         "args": {"schema": trace_mod.ANCHOR_SCHEMA, "wall_time": wall_time,
+                  "perf_counter": 0.0, "pid": pid, "hostname": "host",
+                  "role": role}},
+        *events,
+    ]}
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return str(path)
+
+
+def _span(name, cat, ts, dur, pid, trace_id=None, tid=1):
+    args = {"trace_id": trace_id} if trace_id else {}
+    return {"name": name, "cat": cat, "ph": "X", "ts": ts, "dur": dur,
+            "pid": pid, "tid": tid, "args": args}
+
+
+def test_merge_traces_aligns_skewed_anchors(tmp_path):
+    # Shard A: clock origin ~0; its span starts at wall 1000.0005.
+    pa = _write_shard(
+        tmp_path / "trace.training.11.json", "training", 11,
+        wall_time=1000.0, anchor_ts_us=0.0,
+        events=[_span("train.step", "descent", 500.0, 100.0, 11)])
+    # Shard B: WILDLY skewed process clock (ts in the billions), but its
+    # anchor says ts=2e9 is wall 1000.001 — its span at ts 2e9+200 starts
+    # at wall 1000.0012, i.e. INSIDE shard A's span.
+    pb = _write_shard(
+        tmp_path / "trace.serving.22.json", "serving", 22,
+        wall_time=1000.001, anchor_ts_us=2_000_000_000.0,
+        events=[_span("serve.request", "serving",
+                      2_000_000_200.0, 50.0, 22)])
+    doc = fleet.merge_traces([pa, pb])
+    spans = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    a, b = spans["train.step"], spans["serve.request"]
+    # Wall order preserved: B starts 700us after A (1000.0012 - 1000.0005)
+    assert b["ts"] - a["ts"] == pytest.approx(700.0, abs=1.0)
+    assert a["ts"] >= 0 and b["ts"] >= 0
+    roles = {s["role"] for s in doc["photon.fleet"]["shards"]}
+    assert roles == {"training", "serving"}
+
+
+def test_merge_traces_preserves_cross_process_join(tmp_path):
+    pa = _write_shard(
+        tmp_path / "trace.online.1.json", "online", 1,
+        wall_time=100.0, anchor_ts_us=0.0,
+        events=[_span("online.publish", "online", 10.0, 5.0, 1,
+                      trace_id="tJOIN")])
+    pb = _write_shard(
+        tmp_path / "trace.serving.2.json", "serving", 2,
+        wall_time=100.0, anchor_ts_us=0.0,
+        events=[_span("serve.patch", "serving", 12.0, 2.0, 2,
+                      trace_id="tJOIN"),
+                _span("serve.request", "serving", 30.0, 2.0, 2,
+                      trace_id="tLOCAL")])
+    doc = fleet.merge_traces([pa, pb])
+    joins = fleet.cross_process_joins(doc)
+    assert len(joins) == 1
+    assert joins[0]["trace_id"] == "tJOIN"
+    assert joins[0]["roles"] == ["online", "serving"]
+
+
+def test_merge_traces_remaps_colliding_pids(tmp_path):
+    pa = _write_shard(tmp_path / "trace.a.7.json", "a", 7,
+                      wall_time=1.0, anchor_ts_us=0.0,
+                      events=[_span("x", "c", 1.0, 1.0, 7)])
+    pb = _write_shard(tmp_path / "trace.b.7.json", "b", 7,
+                      wall_time=1.0, anchor_ts_us=0.0,
+                      events=[_span("y", "c", 1.0, 1.0, 7)])
+    doc = fleet.merge_traces([pa, pb])
+    lanes = {s["lane_pid"] for s in doc["photon.fleet"]["shards"]}
+    assert len(lanes) == 2  # two hosts, same pid -> distinct lanes
+    span_pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert span_pids == lanes
+
+
+def test_merge_refuses_anchorless_but_single_analysis_works(tmp_path):
+    legacy = tmp_path / "trace.legacy.9.json"
+    legacy.write_text(json.dumps({"traceEvents": [
+        _span("old.span", "descent", 0.0, 10.0, 9)]}))
+    with pytest.raises(fleet.FleetMergeError, match="photon.anchor"):
+        fleet.merge_traces([str(legacy)])
+    # The analyzer contract is unaffected: anchor-less traces analyze.
+    from photon_tpu.obs.analysis import analyze_trace
+
+    rep = analyze_trace(str(legacy))
+    assert rep.n_spans == 1 and rep.critical_path()
+
+
+def test_real_collectors_roundtrip_to_joined_fleet_trace(tmp_path):
+    """Two live collectors (the real anchor-stamping path) merge into a
+    joined timeline — the in-process version of the CI 3-process drill."""
+    trace_mod.set_process_role("online")
+    c1 = trace_mod.TraceCollector()
+    c1.complete("online.publish", "online", time.perf_counter() - 0.01,
+                0.01, {"trace_id": "tX"})
+    p1 = str(tmp_path / "trace.online.100.json")
+    c1.write(p1)
+    trace_mod.set_process_role("serving")
+    c2 = trace_mod.TraceCollector()
+    c2.complete("serve.patch", "serving", time.perf_counter() - 0.005,
+                0.005, {"trace_id": "tX"})
+    p2 = str(tmp_path / "trace.serving.200.json")
+    c2.write(p2)
+    trace_mod.set_process_role("unknown")
+    doc = fleet.merge_traces([p1, p2])
+    joins = fleet.cross_process_joins(doc)
+    assert joins and joins[0]["trace_id"] == "tX"
+    assert set(joins[0]["roles"]) == {"online", "serving"}
+
+
+# ------------------------------------------------------------ journal merge
+
+
+def test_merge_journals_orders_interleaved_attempts(tmp_path):
+    j1 = tmp_path / "recovery.jsonl"
+    j2 = tmp_path / "recovery.worker.jsonl"
+    rows1 = [
+        {"t": 10.0, "event": "attempt_start", "attempt": 0, "pid": 1},
+        {"t": 12.5, "event": "attempt_failed", "attempt": 0, "pid": 1,
+         "cause": "device_lost"},
+        {"t": 13.0, "event": "restart", "attempt": 1, "pid": 1},
+    ]
+    rows2 = [
+        {"t": 11.0, "event": "oom_downshift", "pid": 2, "cause": "oom"},
+        {"t": 12.9, "event": "backend_failover", "pid": 2},
+    ]
+    j1.write_text("".join(json.dumps(r) + "\n" for r in rows1))
+    j2.write_text("".join(json.dumps(r) + "\n" for r in rows2) + "{torn")
+    merged = fleet.merge_journals([str(j1), str(j2)])
+    assert [r["event"] for r in merged] == [
+        "attempt_start", "oom_downshift", "attempt_failed",
+        "backend_failover", "restart"]
+    assert all("_journal" in r for r in merged)
+
+
+def test_merge_journals_iso_fallback_keeps_file_order(tmp_path):
+    # Rows WITHOUT the sub-second stamp (pre-fleet journals): same ISO
+    # second must keep append order within one file.
+    j = tmp_path / "recovery.jsonl"
+    j.write_text("".join(json.dumps(r) + "\n" for r in [
+        {"time": "2026-08-04T12:00:00Z", "event": "a"},
+        {"time": "2026-08-04T12:00:00Z", "event": "b"},
+        {"time": "2026-08-04T11:59:59Z", "event": "c"},
+    ]))
+    merged = fleet.merge_journals([str(j)])
+    assert [r["event"] for r in merged] == ["c", "a", "b"]
+
+
+def test_supervisor_journal_rows_carry_subsecond_stamp(tmp_path):
+    from photon_tpu.supervisor import RecoveryJournal
+
+    path = str(tmp_path / "recovery.jsonl")
+    RecoveryJournal(path).record("attempt_start", attempt=0)
+    row = json.loads(open(path).read().strip())
+    assert isinstance(row["t"], float) and abs(row["t"] - time.time()) < 60
+
+
+# ------------------------------------------------- trace size bound/sampling
+
+
+def test_trace_size_bound_truncates_loudly(monkeypatch):
+    monkeypatch.setenv("PHOTON_TRACE_MAX_BYTES", "2000")
+    col = trace_mod.TraceCollector()
+    for i in range(200):
+        col.instant(f"e{i}", "t")
+    assert col.truncated and col.dropped > 0
+    names = [e["name"] for e in col.events]
+    assert names.count("photon.trace.truncated") == 1  # loud, ONCE
+    doc = col.to_dict()
+    assert doc["photon.trace.dropped"] == col.dropped
+    assert doc["photon.trace.truncated_at_bytes"] == 2000
+    # The anchor survives truncation (it lives in the meta section).
+    assert any(e["name"] == trace_mod.ANCHOR_EVENT
+               for e in doc["traceEvents"])
+
+
+def test_trace_size_bound_disabled_by_zero(monkeypatch):
+    monkeypatch.setenv("PHOTON_TRACE_MAX_BYTES", "0")
+    col = trace_mod.TraceCollector()
+    for i in range(500):
+        col.instant(f"e{i}", "t")
+    assert not col.truncated and col.dropped == 0
+
+
+def test_trace_sampling_keeps_trace_id_chains_whole(monkeypatch):
+    monkeypatch.setenv("PHOTON_TRACE_SAMPLE", "0.5")
+    col = trace_mod.TraceCollector()
+    t0 = time.perf_counter()
+    for i in range(200):
+        tid = f"req{i}"
+        # Two spans per chain: sampling must keep or drop BOTH.
+        col.complete("a", "t", t0, 0.001, {"trace_id": tid})
+        col.complete("b", "t", t0, 0.001, {"trace_id": tid})
+    kept: dict = {}
+    for e in col.events:
+        kept.setdefault(e["args"]["trace_id"], []).append(e["name"])
+    assert all(sorted(v) == ["a", "b"] for v in kept.values())
+    assert 0 < len(kept) < 200  # actually sampled, not all-or-nothing
+    assert col.sampled_out == 2 * (200 - len(kept))
+    assert col.to_dict()["photon.trace.sample"] == 0.5
+
+
+def test_trace_sampling_never_drops_instants(monkeypatch):
+    monkeypatch.setenv("PHOTON_TRACE_SAMPLE", "0.01")
+    col = trace_mod.TraceCollector()
+    for i in range(50):
+        col.instant("fault", "fault")
+    assert sum(1 for e in col.events if e["name"] == "fault") == 50
+
+
+# ----------------------------------------------------------- anomaly scan
+
+
+def test_detector_flags_injected_level_shift():
+    clean = [20.0 + 0.2 * ((i * 7) % 5) for i in range(24)]
+    shifted = clean + [60.0 + 0.2 * (i % 3) for i in range(6)]
+    flags = detect_level_shifts(shifted)
+    assert flags and flags[0]["index"] == 24
+    assert all(f["z"] > 6.0 for f in flags)
+
+
+def test_detector_quiet_on_stationary_and_constant_series():
+    stationary = [20.0 + 0.3 * ((i * 13) % 7) for i in range(64)]
+    assert detect_level_shifts(stationary) == []
+    assert detect_level_shifts([5.0] * 40) == []
+    assert detect_level_shifts([5.0] * 20 + [5.001] + [5.0] * 19) == []
+
+
+def test_detector_lone_spike_suppressed_by_min_run():
+    vals = [10.0 + 0.1 * (i % 4) for i in range(30)]
+    vals[20] = 100.0  # one-off spike (GC pause), not a level shift
+    assert detect_level_shifts(vals, min_run=2) == []
+    assert detect_level_shifts(vals, min_run=1)  # knob still exposes it
+
+
+def test_anomaly_scan_over_jsonl(tmp_path):
+    path = tmp_path / "metrics.serving.1.jsonl"
+    rows = [{"latency": {"p50_ms": 20.0 + 0.1 * (i % 3), "p99_ms": 40.0},
+             "requests": i} for i in range(20)]
+    rows += [{"latency": {"p50_ms": 90.0, "p99_ms": 40.0}, "requests": 99}
+             for _ in range(4)]
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    scan = anomaly_scan([str(path)])
+    assert scan["n_anomalies"] >= 4
+    flagged = {s["metric"] for s in scan["series"] if s["anomalies"]}
+    assert flagged == {"latency.p50_ms"}  # p99 stayed flat -> quiet
+
+
+# ------------------------------------------------------------- run report
+
+
+def test_build_report_end_to_end(tmp_path):
+    run = tmp_path
+    # Trace shards: one joined flow across two roles.
+    _write_shard(run / "trace.online.1.json", "online", 1,
+                 wall_time=100.0, anchor_ts_us=0.0,
+                 events=[_span("online.publish", "online", 10.0, 5.0, 1,
+                               trace_id="tJ")])
+    _write_shard(run / "trace.serving.2.json", "serving", 2,
+                 wall_time=100.0, anchor_ts_us=0.0,
+                 events=[_span("serve.patch", "serving", 12.0, 2.0, 2,
+                               trace_id="tJ")])
+    # Registry shards.
+    fleet.write_registry_shard(str(run / "registry.serving.2.json"),
+                               [_reg(counter=6)], role="serving")
+    # Journal + metrics history with an injected regression.
+    (run / "recovery.jsonl").write_text(json.dumps(
+        {"t": 1.0, "event": "restart", "cause": "device_lost"}) + "\n")
+    rows = [{"latency": {"p50_ms": 20.0 + 0.1 * (i % 3)},
+             "freshness": {"patch_seq": i}} for i in range(20)]
+    rows += [{"latency": {"p50_ms": 95.0}} for _ in range(4)]
+    (run / "serving-metrics.jsonl").write_text(
+        "".join(json.dumps(r) + "\n" for r in rows))
+
+    merged_out = str(run / "merged-trace.json")
+    report = build_report(str(run), merged_trace_out=merged_out)
+    assert report["schema"] == REPORT_SCHEMA
+    assert {t["role"] for t in report["topology"]} == {"online", "serving"}
+    mt = report["merged_trace"]
+    assert mt["n_cross_process_joins"] == 1
+    assert sorted(mt["roles"]) == ["online", "serving"]
+    assert os.path.exists(merged_out)
+    assert report["metrics"]["snapshot"]["reqs"] == 6.0
+    assert report["recovery_ledger"]["by_event"] == {"restart": 1}
+    assert report["recovery_ledger"]["by_cause"] == {"device_lost": 1}
+    assert report["anomalies"]["n_anomalies"] >= 4
+    assert report["freshness"]  # watermark picked up from the history
+    for key, pp in report["per_process"].items():
+        assert pp["critical_path"]
+    md = format_markdown(report)
+    assert "cross-process trace-id join" in md and "latency.p50_ms" in md
+
+
+def test_report_rerun_skips_its_own_merged_output(tmp_path):
+    """A --merged-trace file left in the run dir must NOT be re-ingested
+    as a shard on the next report run (it would double-count every span
+    and invent a phantom process)."""
+    _write_shard(tmp_path / "trace.online.1.json", "online", 1,
+                 wall_time=100.0, anchor_ts_us=0.0,
+                 events=[_span("online.publish", "online", 10.0, 5.0, 1)])
+    merged_out = str(tmp_path / "merged-trace.json")
+    first = build_report(str(tmp_path), merged_trace_out=merged_out)
+    second = build_report(str(tmp_path), merged_trace_out=merged_out)
+    assert second["merged_trace"]["spans"] == \
+        first["merged_trace"]["spans"] == 1
+    assert len(second["topology"]) == len(first["topology"]) == 1
+    with pytest.raises(fleet.FleetMergeError, match="already a merged"):
+        fleet.load_trace_shard(merged_out)
+
+
+def test_report_cli_stdout_json_is_pure_json(tmp_path, capsys):
+    from photon_tpu.obs.analysis.report import main as report_main
+
+    _write_shard(tmp_path / "trace.training.3.json", "training", 3,
+                 wall_time=50.0, anchor_ts_us=0.0,
+                 events=[_span("descent.step", "descent", 5.0, 2.0, 3)])
+    assert report_main([str(tmp_path), "--json", "-"]) == 0
+    captured = capsys.readouterr()
+    doc = json.loads(captured.out)  # stdout parses as ONE JSON document
+    assert doc["schema"] == REPORT_SCHEMA
+    assert captured.err.startswith("# Fleet run report")
+
+
+def test_report_cli_json_out(tmp_path, capsys):
+    from photon_tpu.obs.analysis.__main__ import main as cli_main
+
+    _write_shard(tmp_path / "trace.training.3.json", "training", 3,
+                 wall_time=50.0, anchor_ts_us=0.0,
+                 events=[_span("descent.step", "descent", 5.0, 2.0, 3)])
+    out = str(tmp_path / "report.json")
+    rc = cli_main(["report", str(tmp_path), "--json", out])
+    assert rc == 0
+    doc = json.load(open(out))
+    assert doc["schema"] == REPORT_SCHEMA
+    assert doc["topology"][0]["role"] == "training"
+    assert capsys.readouterr().out.startswith("# Fleet run report")
+
+
+def test_report_cli_rejects_missing_dir(tmp_path):
+    from photon_tpu.obs.analysis.report import main as report_main
+
+    assert report_main([str(tmp_path / "nope")]) == 2

@@ -191,9 +191,22 @@ def test_trace_artifact_is_chrome_trace_json(tmp_path):
             with trace_span("b", cat="t"):
                 pass
     doc = json.loads(path.read_text())
-    assert isinstance(doc["traceEvents"], list) and len(doc["traceEvents"]) == 2
-    for e in doc["traceEvents"]:
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert isinstance(doc["traceEvents"], list) and len(spans) == 2
+    for e in spans:
         assert {"name", "cat", "ph", "ts", "dur", "pid", "tid"} <= set(e)
+    # Fleet-merge contract (docs/observability.md §"Fleet view"): every
+    # shard carries exactly one anchor metadata instant stamped at
+    # collector install, plus a Perfetto process_name lane label.
+    from photon_tpu.obs import ANCHOR_EVENT
+
+    anchors = [e for e in doc["traceEvents"] if e["name"] == ANCHOR_EVENT]
+    assert len(anchors) == 1
+    a = anchors[0]["args"]
+    assert {"wall_time", "perf_counter", "pid", "hostname",
+            "role"} <= set(a)
+    assert any(e.get("ph") == "M" and e["name"] == "process_name"
+               for e in doc["traceEvents"])
 
 
 def test_trace_error_recorded():
