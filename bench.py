@@ -1082,6 +1082,194 @@ def bench_serve():
     }
 
 
+def bench_serve_replicated():
+    """Replicated serving tier (docs/serving.md §Replication): one small
+    GAME model served by 1 vs 3 replicas behind the routing front door,
+    both legs driven with the identical concurrent volley through the
+    router's ``/score``. Reports aggregate routed rows/sec per leg, the
+    3-vs-1 scaling ratio, and per-replica p50/p95/p99 (the router's
+    weighted balancing makes the per-replica spread itself a figure).
+    All replicas share THIS host's cores: on a box with fewer cores than
+    replicas (the CI rig is 1-core) the ratio reads ~1x by construction,
+    so ``serve_replicated_host_cpu_count`` is stamped and the scaling
+    figure can be filtered honestly (the game_scale_mesh convention)."""
+    import http.client
+    import tempfile
+    import threading
+
+    from photon_tpu.estimators.config import (
+        FixedEffectDataConfig,
+        GLMOptimizationConfiguration,
+        RandomEffectDataConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.index.index_map import (
+        DefaultIndexMap,
+        build_mmap_index,
+        feature_key,
+    )
+    from photon_tpu.io.data_reader import FeatureShardConfig
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.obs import suspend_tracing
+    from photon_tpu.optim import RegularizationContext, RegularizationType
+    from photon_tpu.replication import RouterServer
+    from photon_tpu.serving import (
+        MicroBatcher,
+        ModelRegistry,
+        ScoringServer,
+        ServingConfig,
+    )
+    from photon_tpu.types import TaskType
+
+    n_users, rows_per_user, d_global, d_user = (
+        (48, 8, 128, 4) if SMOKE else (128, 8, 256, 4))
+    n_req = 192 if SMOKE else 1024
+    conc = 4 if SMOKE else 8
+    bundle = _game_bundle(n_users, rows_per_user, d_global, d_user)
+    estimator = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={
+            "fixed": FixedEffectDataConfig("global"),
+            "perUser": RandomEffectDataConfig(re_type="userId",
+                                              feature_shard="global"),
+        },
+        n_sweeps=1,
+    )
+    gcfg = {
+        "fixed": GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=10),
+        "perUser": GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=10),
+    }
+    model = estimator.fit(bundle, None, [gcfg])[0].model
+
+    feats = bundle.features["global"]
+    dim = feats.dim
+    fidx, fval = np.asarray(feats.idx), np.asarray(feats.val)
+    users = bundle.id_tags["userId"]
+    payloads = [
+        json.dumps({
+            "features": [
+                {"name": "c", "term": str(int(c)), "value": float(v)}
+                for c, v in zip(fidx[r], fval[r]) if c < dim
+            ],
+            "entities": {"userId": str(users[r])},
+        }).encode()
+        for r in range(min(256, bundle.n_rows))
+    ]
+
+    out: dict = {"serve_replicated_host_cpu_count": os.cpu_count()}
+
+    with tempfile.TemporaryDirectory() as td:
+        mdir = os.path.join(td, "best")
+        imap = DefaultIndexMap(
+            [feature_key("c", str(j)) for j in range(dim)])
+        save_game_model(
+            mdir, model, {"global": imap},
+            shard_by_coordinate={"perUser": "global"},
+            shard_configs={"global": FeatureShardConfig(
+                ("features",), add_intercept=False)},
+        )
+        build_mmap_index(imap, os.path.join(td, "index", "global"))
+        cfg = ServingConfig(max_batch=32, max_wait_ms=1.0,
+                            cache_entities=max(64, n_users),
+                            max_row_nnz=32)
+
+        def volley(n_replicas: int) -> tuple:
+            """One leg: n replicas behind a fresh router, full volley
+            through the router; returns (rows/sec, per-replica stats)."""
+            servers = []
+            for _ in range(n_replicas):
+                registry = ModelRegistry(mdir, cfg)
+                batcher = MicroBatcher(max_batch=cfg.max_batch,
+                                       max_wait_ms=cfg.max_wait_ms)
+                s = ScoringServer(registry, batcher, port=0)
+                s.start()
+                servers.append(s)
+            urls = [f"http://{h}:{p}" for h, p in
+                    (s.address for s in servers)]
+            router = RouterServer(urls, port=0, health_interval_s=3600,
+                                  seed=11, retries=1)
+            router.check_replicas()
+            router.start()
+            host, port = router.address
+            try:
+                worker_errors: list = []
+
+                def fire(conn, body) -> None:
+                    conn.request(
+                        "POST", "/score", body=body,
+                        headers={"Content-Type": "application/json"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"router returned {resp.status}")
+
+                def worker(wid: int) -> None:
+                    try:
+                        conn = http.client.HTTPConnection(
+                            host, port, timeout=30)
+                        for i in range(wid, n_req, conc):
+                            fire(conn, payloads[i % len(payloads)])
+                        conn.close()
+                    except Exception as e:  # noqa: BLE001 - after join
+                        worker_errors.append(e)
+
+                # Warm every replica's HTTP + batcher path so the timed
+                # volley measures routing, not first-touch compilation.
+                for s in servers:
+                    h, p = s.address
+                    wconn = http.client.HTTPConnection(h, p, timeout=30)
+                    for i in range(4):
+                        fire(wconn, payloads[i % len(payloads)])
+                    wconn.close()
+                with suspend_tracing():
+                    t0 = time.perf_counter()
+                    threads = [threading.Thread(target=worker, args=(w,))
+                               for w in range(conc)]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    wall = time.perf_counter() - t0
+                if worker_errors:
+                    raise worker_errors[0]
+                per_replica = []
+                for i, s in enumerate(servers):
+                    lat = s.latency.snapshot()
+                    per_replica.append({
+                        "requests": int(
+                            s.metrics_snapshot().get("requests", 0)),
+                        "p50_ms": lat.get("p50_ms"),
+                        "p95_ms": lat.get("p95_ms"),
+                        "p99_ms": lat.get("p99_ms"),
+                    })
+                return n_req / wall, per_replica
+            finally:
+                router.shutdown()
+                for s in servers:
+                    s.shutdown()
+
+        for n in (1, 3):
+            rps, per_replica = volley(n)
+            out[f"serve_replicated_rows_per_sec_{n}"] = round(rps, 1)
+            for i, st in enumerate(per_replica):
+                for q in ("p50_ms", "p95_ms", "p99_ms"):
+                    v = st[q]
+                    out[f"serve_replicated_{n}r_r{i}_{q}"] = (
+                        round(v, 3) if v is not None else None)
+                out[f"serve_replicated_{n}r_r{i}_requests"] = (
+                    st["requests"])
+
+    out["serve_replica_scaling"] = round(
+        out["serve_replicated_rows_per_sec_3"]
+        / out["serve_replicated_rows_per_sec_1"], 3)
+    return out
+
+
 def bench_online():
     """Online incremental learning round-trip (docs/online.md): train a
     small GAME model, serve it, then stream labeled events through the
@@ -2880,6 +3068,7 @@ def main():
         ("owlqn_tron", bench_owlqn_tron),
         ("game", bench_game),
         ("serve", bench_serve),
+        ("serve_replicated", bench_serve_replicated),
         ("online", bench_online),
         ("recovery", bench_recovery),
         ("ingest", bench_ingest),
@@ -2892,6 +3081,7 @@ def main():
             "owlqn_tron": "owlqn_linear_l1_samples_per_sec",
             "game": "game_samples_per_sec",
             "serve": "serve_rows_per_sec",
+            "serve_replicated": "serve_replica_scaling",
             "online": "online_freshness_p50_ms",
             "recovery": "recovery_restart_to_first_step_seconds",
             "ingest": "ingest_rows_per_sec",

@@ -108,6 +108,19 @@ echo "== fleet smoke (3-process telemetry aggregation + run report; docs/observa
 # shift in the serving metrics JSONL.
 python scripts/fleet_smoke.py
 
+echo "== replica smoke (delta-log fan-out, router kill window, rejoin-and-converge; docs/serving.md §Replication) =="
+# The replicated serving tier against REAL process boundaries and a REAL
+# kill: one trainer, one online trainer publishing into the durable delta
+# log, THREE replica serving drivers tailing it behind the router driver.
+# Replica r2 is SIGKILLed mid-stream — the router must serve the kill
+# window with ZERO client-visible errors, a second delta wave lands while
+# r2 is down, and the restarted r2 (same replica id -> same cursor) must
+# rejoin and converge to the fleet watermark. Then the books: every
+# replica's journal shows each delta applied EXACTLY once (r2 across two
+# incarnations), and the fleet report renders the router->replica->trainer
+# topology with >= 1 publish->apply cross-process trace join.
+python scripts/replica_smoke.py
+
 echo "== bench analysis (advisory compare of newest artifacts + doc sync) =="
 # Backend-aware regression gate over the two newest checked-in bench
 # artifacts (docs/observability.md §gate). ADVISORY: verdicts print on

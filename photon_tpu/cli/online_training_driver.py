@@ -43,6 +43,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p.add_argument("--serve-url", default=None,
                    help="live scoring server base URL; deltas publish via "
                         "POST /admin/patch (omit to run open-loop)")
+    p.add_argument("--delta-log", default=None,
+                   help="durable delta log (JSONL) to append every "
+                        "published delta to — the write-once fan-out N "
+                        "serving replicas tail (docs/serving.md "
+                        "§'Replication'); combinable with --serve-url "
+                        "(both must succeed per publish)")
+    p.add_argument("--publish-retries", type=int, default=3,
+                   help="bounded retries (decorrelated-jitter backoff) "
+                        "for --serve-url publishes hitting transient "
+                        "connection errors or 503 sheds")
     p.add_argument("--output-dir", default=None,
                    help="photon.log + patch-journal.jsonl + "
                         "online-cursor.json land here")
@@ -202,7 +212,24 @@ def _run(args) -> dict:
         max_iterations=args.max_iter,
         tolerance=args.tol,
     )
-    publisher = HttpPublisher(args.serve_url) if args.serve_url else None
+    # Publisher fan-out: the point-to-point HTTP push (legacy single
+    # server) and the durable delta log (the replicated tier's write-once
+    # path) compose — a delta is "published" only when every sink took it.
+    sinks = []
+    if args.serve_url:
+        sinks.append(HttpPublisher(args.serve_url,
+                                   retries=args.publish_retries))
+    if getattr(args, "delta_log", None):
+        from photon_tpu.replication import DeltaLogPublisher
+
+        sinks.append(DeltaLogPublisher(
+            args.delta_log, snapshot_model_dir=args.model_dir))
+    if len(sinks) > 1:
+        from photon_tpu.replication import FanoutPublisher
+
+        publisher = FanoutPublisher(*sinks)
+    else:
+        publisher = sinks[0] if sinks else None
     journal = PatchJournal(args.output_dir) if args.output_dir else None
     cursor = EventCursor(args.output_dir) if args.output_dir else None
     trainer = OnlineTrainer.from_game_model(
@@ -235,6 +262,7 @@ def _run(args) -> dict:
         "model_dir": args.model_dir,
         "events_path": args.events,
         "serve_url": args.serve_url,
+        "delta_log": getattr(args, "delta_log", None),
         "start_seq": start_seq,
         **{k: v for k, v in summary.items() if k != "refreshes"},
     }

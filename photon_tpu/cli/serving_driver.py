@@ -66,6 +66,27 @@ def build_arg_parser() -> argparse.ArgumentParser:
                    help="seconds the breaker stays open before a probe")
     p.add_argument("--output-dir", default=None,
                    help="photon.log + serving-metrics.jsonl land here")
+    # Replica mode (docs/serving.md §"Replication"): tail the durable
+    # delta log instead of waiting for point-to-point /admin/patch pushes.
+    p.add_argument("--delta-log", default=None,
+                   help="durable delta log (JSONL) to tail as a serving "
+                        "REPLICA: every logged delta applies exactly once "
+                        "through the registry, the seq watermark + lag "
+                        "ride /healthz, and a kill/rejoin resumes from "
+                        "the per-replica cursor")
+    p.add_argument("--replica-id", default=None,
+                   help="stable replica identity for the cursor file, "
+                        "journal rows, and metrics labels (default: "
+                        "r<pid> — NOT restart-stable; set it explicitly "
+                        "for rejoin-and-converge)")
+    p.add_argument("--cursor-dir", default=None,
+                   help="directory for the per-replica cursor (default: "
+                        "--output-dir, else the delta log's directory)")
+    p.add_argument("--catchup-lag", type=int, default=0,
+                   help="replay backlog beyond which a rejoining replica "
+                        "jumps to the log's latest full-snapshot marker "
+                        "via prepare_standby/swap instead of replaying "
+                        "(0 disables snapshot catch-up)")
     p.add_argument("--metrics-interval", type=float, default=60.0,
                    help="seconds between JSONL metrics snapshots")
     p.add_argument("--slo-config",
@@ -118,7 +139,11 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
     if getattr(args, "compile_store", None):
         enable_compile_store(args)
     enable_fault_plan(args.fault_plan)
-    telemetry_dir = enable_telemetry(args, role="serving")
+    # A delta-log tailer makes this process a REPLICA in the fleet
+    # topology (docs/serving.md §"Replication") — the role rides every
+    # trace anchor and telemetry shard name.
+    role = "replica" if getattr(args, "delta_log", None) else "serving"
+    telemetry_dir = enable_telemetry(args, role=role)
     enable_trace(args.trace_out)
     plogger = PhotonLogger(args.output_dir)
     logger = plogger.logger
@@ -151,7 +176,7 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
                                     "serving-metrics.jsonl")
     elif telemetry_dir:
         metrics_path = os.path.join(
-            telemetry_dir, f"metrics.serving.{os.getpid()}.jsonl")
+            telemetry_dir, f"metrics.{role}.{os.getpid()}.jsonl")
     else:
         metrics_path = None
     server = ScoringServer(
@@ -165,6 +190,42 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
         request_timeout_s=config.request_timeout_s,
         slo_config=args.slo_config,
     )
+    if getattr(args, "delta_log", None):
+        from photon_tpu.replication import ReplicaTailer
+        from photon_tpu.supervisor import RecoveryJournal
+
+        journal = (
+            RecoveryJournal(os.path.join(args.output_dir,
+                                         "recovery.jsonl"))
+            if args.output_dir else None
+        )
+        tailer = ReplicaTailer(
+            registry,
+            args.delta_log,
+            replica_id=args.replica_id,
+            cursor_dir=args.cursor_dir or args.output_dir or None,
+            catchup_lag=args.catchup_lag,
+            journal=journal,
+            logger=logger,
+            metrics=server.metrics,
+        )
+        # Converge to the log head BEFORE the first health check can read
+        # a watermark: a rejoining replica that advertised itself while
+        # still replaying its backlog would soak up traffic at stale
+        # coefficients. (The follow thread starts with serving, in _run.)
+        applied = tailer.run_once()
+        server.attach_replication(tailer)
+        snap = tailer.snapshot()
+        if journal is not None:
+            journal.record("replica_joined", replica=tailer.replica_id,
+                           seq_watermark=snap["seq_watermark"],
+                           applied_at_join=applied)
+        logger.info(
+            "replica %s joined: delta log %s, watermark %d "
+            "(%d record(s) applied at boot, %d catch-up jump(s))",
+            tailer.replica_id, args.delta_log, snap["seq_watermark"],
+            applied, snap["catchups"],
+        )
     v = registry.current
     logger.info(
         "serving model version %d (%s) on http://%s:%d  "
@@ -203,6 +264,8 @@ def _run(args, serve_forever: bool) -> dict:
     }
     from photon_tpu.cli.params import finish_telemetry
 
+    if server.replication is not None:
+        summary["replica_id"] = server.replication.replica_id
     if not serve_forever:
         server.shutdown()
         finish_telemetry(args, registries=(server.metrics,))
@@ -222,10 +285,16 @@ def _run(args, serve_forever: bool) -> dict:
     except ValueError:
         pass
     try:
+        if server.replication is not None:
+            server.replication.start()  # follow the log while serving
         server.serve_forever()
     except KeyboardInterrupt:
         pass
     finally:
+        # Stop mutating the registry before the drain: a delta landing
+        # mid-teardown has no one left to serve it.
+        if server.replication is not None:
+            server.replication.stop()
         server.shutdown()
         # Registry shard AFTER shutdown: the final flush's counters are
         # exactly what the fleet report should aggregate.

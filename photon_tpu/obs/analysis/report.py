@@ -229,6 +229,58 @@ def _last_slo(metrics_jsonl: Sequence[str]) -> Optional[dict]:
     return last
 
 
+def _replication_section(snapshot: Mapping) -> Optional[dict]:
+    """Replication posture from the folded fleet metrics snapshot
+    (docs/serving.md §"Replication"): per-replica delta-log counters and
+    watermarks (series labeled ``replica=<id>`` fold to ``{id: value}``)
+    plus router traffic totals. ``None`` when the run had no replicated
+    tier — the section renders only where it means something."""
+
+    def series(name: str) -> dict:
+        # Only labeled series name a replica; a scalar here is a
+        # never-incremented counter's unlabeled zero, not a replica.
+        v = snapshot.get(name)
+        if isinstance(v, dict):
+            return {k: val for k, val in v.items() if k}
+        return {}
+
+    replicas: dict[str, dict] = {}
+    for field, metric in (
+        ("applied", "replica_deltas_applied_total"),
+        ("duplicates_skipped", "replica_duplicate_seqs_total"),
+        ("catchups", "replica_catchups_total"),
+        ("apply_errors", "replica_apply_errors_total"),
+        ("seq_watermark", "replica_seq_watermark"),
+        ("lag", "replica_lag"),
+    ):
+        for rid, val in series(metric).items():
+            replicas.setdefault(rid, {})[field] = val
+    router = {}
+    for field, metric in (
+        ("requests", "router_requests_total"),
+        ("upstream_requests", "router_upstream_requests_total"),
+        ("retries", "router_retries_total"),
+        ("upstream_errors", "router_upstream_errors_total"),
+        ("healthy_replicas", "router_healthy_replicas"),
+        ("known_replicas", "router_known_replicas"),
+    ):
+        v = snapshot.get(metric)
+        if v is not None:
+            router[field] = v
+    if not replicas and not router:
+        return None
+    marks = sorted({v.get("seq_watermark") for v in replicas.values()
+                    if v.get("seq_watermark") is not None})
+    return {
+        "replicas": replicas,
+        "router": router,
+        # Same watermark on every replica = the fleet converged; a spread
+        # names exactly which replica is behind.
+        "converged": len(marks) <= 1,
+        "seq_watermarks": marks,
+    }
+
+
 def _newest_bench(paths: Sequence[str]) -> Optional[dict]:
     """Summarize the newest parseable bench artifact found in the run
     dir (recency from artifact content, per artifacts.newest_artifacts'
@@ -342,6 +394,7 @@ def build_report(
 
     # -- fleet metrics -----------------------------------------------------
     agg, shard_meta = fleet.collect_shards(files.registry_shards)
+    metrics_snapshot = agg.snapshot()
 
     # -- merged recovery ledger -------------------------------------------
     ledger = fleet.merge_journals(files.journals)
@@ -357,8 +410,9 @@ def build_report(
         "per_process": per_process,
         "metrics": {
             "shards": shard_meta,
-            "snapshot": agg.snapshot(),
+            "snapshot": metrics_snapshot,
         },
+        "replication": _replication_section(metrics_snapshot),
         "recovery_ledger": {
             **_ledger_counts(ledger),
             "events": ledger[-200:],
@@ -428,6 +482,30 @@ def format_markdown(report: Mapping, top: int = 5) -> str:
         lines.append("by classified cause: "
                      + ", ".join(f"{c}={n}" for c, n
                                  in sorted(led["by_cause"].items())))
+
+    rep = report.get("replication")
+    if rep:
+        lines += ["", "## Replication"]
+        reps = rep.get("replicas") or {}
+        if reps:
+            lines += ["| replica | watermark | lag | applied | dups "
+                      "skipped | catch-ups | apply errors |",
+                      "|---|---|---|---|---|---|---|"]
+            for rid, row in sorted(reps.items()):
+                lines.append(
+                    f"| {rid} | {row.get('seq_watermark')} | "
+                    f"{row.get('lag')} | {row.get('applied')} | "
+                    f"{row.get('duplicates_skipped', 0)} | "
+                    f"{row.get('catchups', 0)} | "
+                    f"{row.get('apply_errors', 0)} |")
+            lines.append("converged" if rep.get("converged")
+                         else "**NOT CONVERGED**: watermarks "
+                              f"{rep.get('seq_watermarks')}")
+        rt = rep.get("router") or {}
+        if rt:
+            lines.append(
+                "router: " + ", ".join(
+                    f"{k}={json.dumps(v)}" for k, v in sorted(rt.items())))
 
     fresh = report.get("freshness") or {}
     lines += ["", "## Freshness watermarks"]

@@ -142,20 +142,50 @@ class RegistryPublisher:
         )
 
 
+_PUBLISH_RETRIES = REGISTRY.counter(
+    "online_publish_retries_total",
+    "delta publish attempts retried on a transient connection error",
+)
+
+
 class HttpPublisher:
     """Cross-process delta publisher: ``POST /admin/patch`` against a live
-    scoring server (docs/online.md §"Delta protocol")."""
+    scoring server (docs/online.md §"Delta protocol").
 
-    def __init__(self, base_url: str, timeout_s: float = 30.0):
+    Transient connection failures (refused/reset/timeout — a serving
+    replica restarting mid-publish) retry with bounded backoff using the
+    supervisor's decorrelated-jitter :class:`RestartPolicy` math (``seed``
+    pins the delay stream for tests); each retry bumps
+    ``online_publish_retries_total``. An HTTP *response* never retries:
+    the server got the delta, and a validation 4xx would fail identically
+    forever — except a 503 shed, which is a "not now" the backoff exists
+    for. For durable write-once fan-out use the delta log instead
+    (``photon_tpu.replication`` — docs/serving.md §"Replication")."""
+
+    def __init__(self, base_url: str, timeout_s: float = 30.0,
+                 retries: int = 3, backoff_s: float = 0.2,
+                 max_backoff_s: float = 2.0,
+                 seed: Optional[int] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout_s = float(timeout_s)
+        self.retries = int(retries)
+        # Lazy import at call time keeps this module import-light; the
+        # policy itself is a frozen dataclass, safe to build per publisher.
+        from photon_tpu.supervisor import RestartPolicy
+
+        self._policy = RestartPolicy(
+            max_restarts=self.retries,
+            backoff_seconds=float(backoff_s),
+            max_backoff_seconds=float(max_backoff_s),
+            seed=seed,
+        )
 
     def publish(self, delta: ModelDelta) -> dict:
         import json
         import urllib.error
         import urllib.request
 
-        from photon_tpu.obs import current_trace_id
+        from photon_tpu.obs import current_trace_id, instant
 
         headers = {"Content-Type": "application/json"}
         # Cross-process trace join (docs/observability.md §"Fleet view"):
@@ -165,29 +195,59 @@ class HttpPublisher:
         tid = current_trace_id()
         if tid is not None:
             headers["X-Photon-Trace-Id"] = tid
-        req = urllib.request.Request(
-            self.base_url + "/admin/patch",
-            data=json.dumps(delta.to_wire()).encode("utf-8"),
-            headers=headers,
-            method="POST",
-        )
-        try:
-            with urllib.request.urlopen(req,
-                                        timeout=self.timeout_s) as resp:
-                body = json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            # Surface the server's actionable validation message (e.g. the
-            # over-wide-patch guidance), not just "HTTP Error 400".
-            detail = ""
+        data = json.dumps(delta.to_wire()).encode("utf-8")
+        delays = self._policy.delays()
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            req = urllib.request.Request(
+                self.base_url + "/admin/patch", data=data,
+                headers=headers, method="POST",
+            )
             try:
-                detail = e.read().decode("utf-8", "replace")[:500]
-            except Exception:  # noqa: BLE001 - detail is best-effort
-                pass
-            raise RuntimeError(
-                f"delta publish rejected by {self.base_url} "
-                f"(HTTP {e.code}): {detail or e.reason}"
-            ) from e
-        return body
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 503 and attempt < self.retries:
+                    # A shed/draining replica: transient by contract
+                    # (503 + Retry-After), worth the backoff.
+                    last = e
+                else:
+                    # Surface the server's actionable validation message
+                    # (e.g. the over-wide-patch guidance), not just
+                    # "HTTP Error 400".
+                    detail = ""
+                    try:
+                        detail = e.read().decode("utf-8", "replace")[:500]
+                    except Exception:  # noqa: BLE001 - best-effort
+                        pass
+                    raise RuntimeError(
+                        f"delta publish rejected by {self.base_url} "
+                        f"(HTTP {e.code}): {detail or e.reason}"
+                    ) from e
+            except (urllib.error.URLError, ConnectionError,
+                    TimeoutError, OSError) as e:
+                # Connection-level failure: the server never saw the
+                # delta — the retryable case.
+                last = e
+            if attempt >= self.retries:
+                break
+            delay = next(delays)
+            _PUBLISH_RETRIES.inc()
+            instant("online.publish_retry", cat="online",
+                    attempt=attempt + 1, delay_s=round(delay, 3),
+                    error=f"{type(last).__name__}: {str(last)[:200]}")
+            logger.warning(
+                "delta publish to %s failed (%s: %s); retry %d/%d in "
+                "%.2fs", self.base_url, type(last).__name__, last,
+                attempt + 1, self.retries, delay,
+            )
+            time.sleep(delay)
+        raise RuntimeError(
+            f"delta publish to {self.base_url} failed after "
+            f"{self.retries + 1} attempt(s): "
+            f"{type(last).__name__}: {last}"
+        ) from last
 
 
 class OnlineTrainer:

@@ -1,0 +1,404 @@
+"""The staleness- and pressure-aware routing front door.
+
+:class:`RouterServer` fronts N serving replicas: a thin stdlib HTTP
+process (no model, no JAX — it boots in milliseconds and never competes
+with replicas for the accelerator) that
+
+* **health-checks** every replica on a cadence (``GET /healthz``),
+  reading the status, the degraded-reason list, and the replication
+  block's seq watermark;
+* **weights** ``/score`` traffic by staleness: a replica's weight is
+  ``1 / (1 + staleness_penalty * seq_lag)`` against the freshest
+  watermark in the pool, so a converged replica takes proportionally
+  more traffic than one still replaying its backlog;
+* **drains** replicas reporting ``degraded`` (open breakers, memory
+  pressure — docs/robustness.md) or an unhealthy/unreachable state:
+  weight 0 while the condition holds, traffic restored automatically by
+  the next clean health check. When EVERY replica is degraded the router
+  serves through them anyway (a degraded answer beats no answer);
+* **retries** idempotent reads: a connect failure (or a 503 shed) on one
+  replica re-dispatches the same request to the next-best replica,
+  bounded by ``retries`` — a killed replica costs its in-flight requests
+  one retry, not an error;
+* **forwards** ``X-Photon-Trace-Id`` (minting one when absent), so a
+  routed request renders as router → replica one flow in the merged
+  fleet timeline.
+
+Routes: ``POST /score`` (balanced), ``GET /healthz`` (the router's view
+of the pool; 503 when no replica is reachable), ``GET /metrics`` (JSON,
+``?format=prom`` for text exposition).
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from photon_tpu.obs import (
+    MetricsRegistry,
+    REGISTRY as GLOBAL_REGISTRY,
+    new_trace_id,
+    trace_context,
+    trace_span,
+)
+
+_CONNECT_ERRORS = (ConnectionError, TimeoutError, OSError)
+
+
+class _ReplicaState:
+    """The router's last-known view of one replica."""
+
+    def __init__(self, url: str):
+        self.url = url.rstrip("/")
+        self.reachable = False
+        self.status = "unknown"          # ok | degraded | unhealthy | ...
+        self.degraded: list = []
+        self.seq_watermark: Optional[int] = None
+        self.lag: Optional[int] = None
+        self.model_version: Optional[int] = None
+        self.last_check_ts: Optional[float] = None
+        self.consecutive_failures = 0
+
+    def snapshot(self) -> dict:
+        return {
+            "url": self.url,
+            "reachable": self.reachable,
+            "status": self.status,
+            "degraded": list(self.degraded),
+            "seq_watermark": self.seq_watermark,
+            "lag": self.lag,
+            "model_version": self.model_version,
+            "last_check_ts": self.last_check_ts,
+            "consecutive_failures": self.consecutive_failures,
+        }
+
+
+class RouterServer:
+    """Health-checked, staleness-weighted ``/score`` fan-in (module doc)."""
+
+    def __init__(
+        self,
+        replicas: Sequence[str],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        health_interval_s: float = 1.0,
+        health_timeout_s: float = 2.0,
+        staleness_penalty: float = 0.25,
+        retries: int = 1,
+        timeout_s: float = 30.0,
+        logger=None,
+        seed: Optional[int] = None,
+    ):
+        if not replicas:
+            raise ValueError("router needs >= 1 replica URL")
+        self.logger = logger
+        self.health_interval_s = float(health_interval_s)
+        self.health_timeout_s = float(health_timeout_s)
+        self.staleness_penalty = float(staleness_penalty)
+        self.retries = int(retries)
+        self.timeout_s = float(timeout_s)
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._replicas = [_ReplicaState(u) for u in replicas]
+        self._started_at = time.time()
+        self.metrics = MetricsRegistry()
+        self._requests_c = self.metrics.counter(
+            "router_requests_total", "routed /score requests by outcome")
+        self._upstream_c = self.metrics.counter(
+            "router_upstream_requests_total",
+            "requests dispatched to each replica")
+        self._retries_c = self.metrics.counter(
+            "router_retries_total",
+            "idempotent reads re-dispatched to another replica")
+        self._upstream_err_c = self.metrics.counter(
+            "router_upstream_errors_total",
+            "connect failures / sheds per replica")
+        self._latency = self.metrics.histogram(
+            "router_request_latency_seconds",
+            "end-to-end routed /score latency (successes)")
+        self.metrics.gauge_fn(
+            "router_healthy_replicas",
+            lambda: sum(1 for r in self._routable()),
+            "replicas currently eligible for traffic")
+        self.metrics.gauge_fn(
+            "router_known_replicas", lambda: len(self._replicas),
+            "replicas configured on this router")
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                if router.logger is not None:
+                    router.logger.debug("router http: " + fmt, *args)
+
+            def _reply(self, code: int, payload, headers=()) -> None:
+                body = payload if isinstance(payload, bytes) \
+                    else json.dumps(payload).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                if path == "/healthz":
+                    snap = router.health_snapshot()
+                    self._reply(
+                        200 if snap["status"] != "unhealthy" else 503, snap)
+                elif path == "/metrics":
+                    if "prom" in query:
+                        body = router.metrics.to_prometheus(
+                            extra=GLOBAL_REGISTRY).encode("utf-8")
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type",
+                            "text/plain; version=0.0.4; charset=utf-8")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                    else:
+                        self._reply(200, router.metrics_snapshot())
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/score":
+                    n = int(self.headers.get("Content-Length") or 0)
+                    if n:
+                        self.rfile.read(n)
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n) if n else b"{}"
+                tid = self.headers.get("X-Photon-Trace-Id") or new_trace_id()
+                with trace_context(tid), \
+                        trace_span("router.request", cat="router") as sp:
+                    code, payload, hdrs = router.route_score(body, tid, sp)
+                self._reply(code, payload, headers=hdrs)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self._loop_started = False
+        self._serve_thread: Optional[threading.Thread] = None
+        self._health_stop = threading.Event()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="photon-router-health",
+            daemon=True)
+        self._health_thread.start()
+
+    # --------------------------------------------------------------- health
+
+    @property
+    def address(self) -> tuple:
+        return self.httpd.server_address[:2]
+
+    def _health_loop(self) -> None:
+        self.check_replicas()
+        while not self._health_stop.wait(self.health_interval_s):
+            self.check_replicas()
+
+    def check_replicas(self) -> None:
+        """One health sweep (also callable synchronously from tests)."""
+        for r in self._replicas:
+            self._check_one(r)
+
+    def _check_one(self, r: _ReplicaState) -> None:
+        try:
+            with urllib.request.urlopen(
+                    r.url + "/healthz",
+                    timeout=self.health_timeout_s) as resp:
+                payload = json.loads(resp.read())
+            code = resp.status
+        except urllib.error.HTTPError as e:
+            # An HTTP error IS an answer: /healthz replies 503 with a
+            # body when unhealthy — read it rather than marking unreachable.
+            try:
+                payload = json.loads(e.read())
+            except Exception:  # noqa: BLE001 - body is best-effort
+                payload = {}
+            code = e.code
+        except _CONNECT_ERRORS + (urllib.error.URLError,):
+            with self._lock:
+                r.reachable = False
+                r.status = "unreachable"
+                r.consecutive_failures += 1
+                r.last_check_ts = time.time()
+            return
+        with self._lock:
+            r.reachable = True
+            r.consecutive_failures = 0
+            r.last_check_ts = time.time()
+            r.status = payload.get("status") or \
+                ("ok" if code == 200 else "unhealthy")
+            r.degraded = list(payload.get("degraded") or ())
+            rep = payload.get("replication") or {}
+            if rep.get("seq_watermark") is not None:
+                r.seq_watermark = int(rep["seq_watermark"])
+                r.lag = int(rep.get("lag") or 0)
+            fresh = payload.get("freshness") or {}
+            if fresh.get("model_version") is not None:
+                r.model_version = int(fresh["model_version"])
+
+    # -------------------------------------------------------------- routing
+
+    def _routable(self) -> list:
+        """Replicas eligible for traffic: reachable, healthy, undrained."""
+        with self._lock:
+            pool = list(self._replicas)
+        return [r for r in pool
+                if r.reachable and r.status == "ok" and not r.degraded]
+
+    def _weights(self, exclude=()) -> list:
+        """(replica, weight) pairs for one pick. Staleness-weighted over
+        the routable pool; when that pool is empty, degrade to ANY
+        reachable non-unhealthy replica at uniform weight (a stale or
+        pressured answer beats refusing everyone)."""
+        pool = [r for r in self._routable() if r not in exclude]
+        if not pool:
+            with self._lock:
+                pool = [r for r in self._replicas
+                        if r.reachable and r.status != "unhealthy"
+                        and r not in exclude]
+            return [(r, 1.0) for r in pool]
+        marks = [r.seq_watermark for r in pool
+                 if r.seq_watermark is not None]
+        head = max(marks) if marks else None
+        out = []
+        for r in pool:
+            if head is None or r.seq_watermark is None:
+                w = 1.0
+            else:
+                w = 1.0 / (1.0 + self.staleness_penalty
+                           * max(0, head - r.seq_watermark))
+            out.append((r, w))
+        return out
+
+    def _pick(self, exclude=()):
+        weighted = self._weights(exclude=exclude)
+        if not weighted:
+            return None
+        total = sum(w for _, w in weighted)
+        x = self._rng.uniform(0.0, total)
+        for r, w in weighted:
+            x -= w
+            if x <= 0:
+                return r
+        return weighted[-1][0]
+
+    def route_score(self, body: bytes, trace_id: str, span) -> tuple:
+        """Dispatch one /score read; returns (code, payload-bytes, hdrs).
+        Connect failures and 503 sheds retry on the NEXT-best replica
+        (scores are idempotent reads) up to ``retries`` times."""
+        t0 = time.perf_counter()
+        tried: list = []
+        last_err: Optional[str] = None
+        for attempt in range(self.retries + 1):
+            r = self._pick(exclude=tried)
+            if r is None:
+                break
+            if attempt:
+                self._retries_c.inc()
+            tried.append(r)
+            self._upstream_c.inc(1, replica=r.url)
+            try:
+                req = urllib.request.Request(
+                    r.url + "/score", data=body, method="POST",
+                    headers={"Content-Type": "application/json",
+                             "X-Photon-Trace-Id": trace_id})
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout_s) as resp:
+                    payload = resp.read()
+                    code = resp.status
+            except urllib.error.HTTPError as e:
+                payload = e.read()
+                code = e.code
+                if code == 503 and attempt < self.retries:
+                    # A shed (queue full, memory pressure, draining):
+                    # idempotent read, another replica may have room.
+                    self._upstream_err_c.inc(1, replica=r.url,
+                                             kind="shed")
+                    last_err = f"{r.url} shed (503)"
+                    continue
+            except _CONNECT_ERRORS + (urllib.error.URLError,) as e:
+                # Connect failure: mark it down NOW (don't wait for the
+                # health sweep) and retry elsewhere.
+                self._upstream_err_c.inc(1, replica=r.url, kind="connect")
+                with self._lock:
+                    r.reachable = False
+                    r.status = "unreachable"
+                    r.consecutive_failures += 1
+                last_err = f"{r.url}: {type(e).__name__}: {e}"
+                span.set(retried=True)
+                continue
+            # Success or a non-retryable client/server answer: relay it.
+            outcome = "ok" if code == 200 else f"http_{code}"
+            self._requests_c.inc(1, outcome=outcome)
+            if code == 200:
+                self._latency.histogram.observe(time.perf_counter() - t0)
+            span.set(status=code, replica=r.url, attempts=attempt + 1)
+            return code, payload, ()
+        self._requests_c.inc(1, outcome="no_replica")
+        span.set(status=503, attempts=len(tried))
+        return 503, {
+            "error": "no replica available"
+                     + (f" (last: {last_err})" if last_err else ""),
+        }, (("Retry-After", "1"),)
+
+    # ------------------------------------------------------------ snapshots
+
+    def health_snapshot(self) -> dict:
+        with self._lock:
+            reps = [r.snapshot() for r in self._replicas]
+        routable = sum(1 for r in self._routable())
+        reachable = sum(1 for r in reps if r["reachable"])
+        status = "ok" if routable else (
+            "degraded" if reachable else "unhealthy")
+        marks = [r["seq_watermark"] for r in reps
+                 if r["seq_watermark"] is not None]
+        return {
+            "status": status,
+            "routable": routable,
+            "reachable": reachable,
+            "replicas": reps,
+            "head_seq_watermark": max(marks) if marks else None,
+            "uptime_s": round(time.time() - self._started_at, 1),
+        }
+
+    def metrics_snapshot(self) -> dict:
+        return {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "latency": self._latency.histogram.snapshot(),
+            "metrics": self.metrics.snapshot(),
+            "health": self.health_snapshot(),
+        }
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        self._loop_started = True
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name="photon-router-http", daemon=True)
+        self._serve_thread.start()
+
+    def serve_forever(self) -> None:
+        self._loop_started = True
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self._health_stop.set()
+        if self._loop_started:
+            self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5.0)
+        self._health_thread.join(timeout=5.0)

@@ -131,6 +131,18 @@ class ScoringServer:
         self._rate_lock = threading.Lock()
         self._rate_prev_t = self._started_at
         self._rate_prev_requests = 0
+        # Replication (docs/serving.md §"Replication"): a ReplicaTailer
+        # attached via attach_replication surfaces its seq watermark + lag
+        # on /healthz and the metrics snapshot — the staleness signal the
+        # router weights traffic by.
+        self.replication = None
+        # Drain state (SIGTERM contract): the flag 503s requests arriving
+        # on kept-alive connections after the listener closed; the
+        # condition variable lets shutdown() wait for in-flight /score
+        # handlers to finish before the batcher goes away.
+        self._draining = False
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -211,6 +223,10 @@ class ScoringServer:
                         # (docs/robustness.md §"Recovery time").
                         "recovery": server.recovery_snapshot(),
                     }
+                    if server.replication is not None:
+                        # Seq watermark + lag (docs/serving.md
+                        # §"Replication"): the router's staleness signal.
+                        base["replication"] = server.replication.snapshot()
                     if not server.batcher.healthy:
                         self._reply(503, {
                             "status": "unhealthy",
@@ -253,17 +269,43 @@ class ScoringServer:
                     self._reply(404, {"error": f"no route {self.path}"})
 
             def _score(self):
-                # Trace root: one trace id per request, attached to this
-                # thread for the admission spans and carried across the
-                # batcher boundary on the queue item (docs/observability.md).
-                # A client-supplied X-Photon-Trace-Id joins this server's
-                # spans to the CALLER's trace shard — the fleet merger
-                # renders the cross-process flow as one timeline
-                # (docs/observability.md §"Fleet view").
-                tid = self.headers.get("X-Photon-Trace-Id") or new_trace_id()
-                with trace_context(tid), \
-                        trace_span("serve.request", cat="serving") as req_span:
-                    self._score_traced(req_span)
+                # Drain gate (SIGTERM contract, docs/serving.md): once
+                # shutdown began, the listener is closed — but a request
+                # riding an already-open kept-alive connection could still
+                # land here. Refuse it with the shed contract (503 +
+                # Retry-After, connection closed) instead of racing the
+                # batcher teardown; the router retries it on a live
+                # replica.
+                if server._draining:
+                    n = int(self.headers.get("Content-Length") or 0)
+                    if n:
+                        self.rfile.read(n)
+                    self.close_connection = True
+                    self._reply(503, {"error": "server draining",
+                                      "shed": True},
+                                headers=(("Retry-After", "1"),))
+                    return
+                with server._inflight_cv:
+                    server._inflight += 1
+                try:
+                    # Trace root: one trace id per request, attached to
+                    # this thread for the admission spans and carried
+                    # across the batcher boundary on the queue item
+                    # (docs/observability.md). A client-supplied
+                    # X-Photon-Trace-Id joins this server's spans to the
+                    # CALLER's trace shard — the fleet merger renders the
+                    # cross-process flow as one timeline
+                    # (docs/observability.md §"Fleet view").
+                    tid = (self.headers.get("X-Photon-Trace-Id")
+                           or new_trace_id())
+                    with trace_context(tid), \
+                            trace_span("serve.request",
+                                       cat="serving") as req_span:
+                        self._score_traced(req_span)
+                finally:
+                    with server._inflight_cv:
+                        server._inflight -= 1
+                        server._inflight_cv.notify_all()
 
             def _score_traced(self, req_span):
                 t0 = time.perf_counter()
@@ -619,6 +661,8 @@ class ScoringServer:
                 SCORE_KERNEL_NAME),
             # getattr: harness fakes build servers via __new__ and only
             # set what they exercise
+            **({"replication": self.replication.snapshot()}
+               if getattr(self, "replication", None) is not None else {}),
             **({"slo": self._slo_last.to_dict()}
                if getattr(self, "_slo_last", None) is not None else {}),
         }
@@ -673,7 +717,28 @@ class ScoringServer:
         self._loop_started = True
         self.httpd.serve_forever()
 
-    def shutdown(self) -> None:
+    def attach_replication(self, tailer) -> None:
+        """Expose a ``ReplicaTailer``'s watermark/lag on /healthz and the
+        metrics snapshot (the serving driver's ``--delta-log`` replica
+        mode wires this before serving starts)."""
+        self.replication = tailer
+
+    def shutdown(self, drain_timeout_s: float = 10.0) -> None:
+        """Graceful drain (the SIGTERM contract, docs/serving.md):
+
+        1. **Stop accepting** — the draining flag 503-sheds requests that
+           arrive on already-open kept-alive connections, and the
+           listening socket closes, so nothing new is admitted.
+        2. **Finish in-flight batches** — wait (bounded by
+           ``drain_timeout_s``) for every admitted /score handler to get
+           its answer through the batcher before the worker goes away.
+        3. **Close the batcher** — anything still queued past the
+           deadline fails fast rather than hanging its waiter.
+        4. **Flush telemetry** — the final metrics snapshot lands in the
+           JSONL history (and SLOs are judged once more); the driver
+           writes the registry telemetry shard right after this returns.
+        """
+        self._draining = True
         self._metrics_stop.set()
         if self._loop_started:
             # socketserver.shutdown() handshakes with serve_forever() and
@@ -682,5 +747,17 @@ class ScoringServer:
         self.httpd.server_close()
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=5.0)
+        # Handler threads are daemons (never joined by server_close), so
+        # the in-flight wait below is the ONLY thing standing between an
+        # admitted request and a batcher teardown under its feet.
+        deadline = time.monotonic() + float(drain_timeout_s)
+        with self._inflight_cv:
+            while self._inflight > 0 and time.monotonic() < deadline:
+                self._inflight_cv.wait(timeout=0.1)
+            leftover = self._inflight
+        if leftover and self.logger is not None:
+            self.logger.warning(
+                "shutdown drain timed out with %d request(s) in flight",
+                leftover)
         self.batcher.close()
         self.flush_metrics()

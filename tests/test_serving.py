@@ -1046,3 +1046,124 @@ def test_registry_apply_delta_rejects_overwide_patch(trained):
         }})
     assert cache.store.n_patched == 0
     assert registry.freshness_snapshot()["patch_seq"] == 0
+
+
+def test_apply_delta_swap_standby_interleave(trained):
+    """Concurrent apply_delta / swap / prepare_standby on ONE registry
+    (the replica tailer's world: deltas stream in while a snapshot
+    catch-up swaps underneath). The swap lock must serialize them — no
+    torn version, no half-applied delta, and the registry must still
+    score afterwards."""
+    d, (m1, m2), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    key = list(registry.current.scorer._caches["perUser"].store.keys)[0]
+    errors = []
+    applied = []
+    barrier = threading.Barrier(3)
+
+    def deltas():
+        barrier.wait()
+        for i in range(12):
+            try:
+                r = registry.apply_delta(
+                    {"perUser": {str(key): (
+                        np.array([0], np.int32),
+                        np.array([0.01 * i], np.float32))}},
+                    seq=i,
+                )
+                applied.append(r["patch_seq"])
+            except Exception as e:  # noqa: BLE001 - collected for assert
+                errors.append(f"apply: {e}")
+
+    def swapper():
+        barrier.wait()
+        for target in (m2, m1, m2):
+            try:
+                registry.swap(target)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"swap: {e}")
+
+    def standby():
+        barrier.wait()
+        for target in (m1, m2, m1):
+            try:
+                registry.prepare_standby(target)
+            except Exception as e:  # noqa: BLE001
+                errors.append(f"standby: {e}")
+
+    threads = [threading.Thread(target=f)
+               for f in (deltas, swapper, standby)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors
+    assert len(applied) == 12
+    # Patch seqs are strictly monotone: the swap lock serialized every
+    # apply against every swap — no delta landed on a half-built version.
+    assert applied == sorted(applied)
+    v = registry.current
+    assert v.model_dir == m2
+    assert registry.freshness_snapshot()["model_version"] == v.version
+    # The registry still scores: one more delta goes through cleanly.
+    r = registry.apply_delta({"perUser": {str(key): (
+        np.array([0], np.int32), np.array([0.5], np.float32))}})
+    assert r["patched"] == 1
+
+
+def test_sigterm_drain_finishes_inflight_and_flushes(trained, tmp_path):
+    """The SIGTERM drain contract (docs/serving.md): in-flight requests
+    finish with 200, post-drain arrivals shed with 503, and the final
+    metrics snapshot lands in the JSONL history before the process would
+    exit."""
+    d, (m1, _), n_val = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    # A wide coalescing window keeps requests in flight long enough for
+    # shutdown to overlap them deterministically.
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=400.0)
+    metrics_path = tmp_path / "serving-metrics.jsonl"
+    server = ScoringServer(
+        registry, batcher, port=0,
+        metrics_path=str(metrics_path), metrics_interval_s=3600,
+    )
+    server.start()
+    host, port = server.address
+    rec = next(iter(read_records(str(d / "val.avro"))))
+    results = []
+
+    def one():
+        results.append(_post(host, port, "/score", _payload(rec)))
+
+    threads = [threading.Thread(target=one) for _ in range(4)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5
+    while server._inflight < 4 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert server._inflight == 4          # all admitted, none answered
+    server.shutdown(drain_timeout_s=10.0)
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 4
+    assert all(status == 200 for status, _ in results), results
+    assert server._inflight == 0
+    # A straggler on a kept-alive connection after the drain began gets
+    # the shed contract, not a hang against the closed batcher.
+    server._draining = True
+    handler = server.httpd.RequestHandlerClass
+    class _Fake:
+        headers = {"Content-Length": "0"}
+        closed = False
+        def _reply(self, code, payload, headers=()):
+            self.code, self.payload, self.hdrs = code, payload, headers
+    fake = _Fake()
+    handler._score(fake)
+    assert fake.code == 503 and fake.payload["shed"] is True
+    assert ("Retry-After", "1") in tuple(fake.hdrs)
+    # Step 4 of the contract: the final flush wrote the JSONL snapshot.
+    with open(metrics_path) as f:
+        rows = [json.loads(line) for line in f if line.strip()]
+    assert rows, "shutdown must flush a final metrics snapshot"
+    assert rows[-1]["requests"] >= 4
