@@ -247,6 +247,7 @@ def _replication_section(snapshot: Mapping) -> Optional[dict]:
     replicas: dict[str, dict] = {}
     for field, metric in (
         ("applied", "replica_deltas_applied_total"),
+        ("replayed", "replica_deltas_replayed_total"),
         ("duplicates_skipped", "replica_duplicate_seqs_total"),
         ("catchups", "replica_catchups_total"),
         ("apply_errors", "replica_apply_errors_total"),

@@ -159,7 +159,17 @@ class HttpPublisher:
     ``online_publish_retries_total``. An HTTP *response* never retries:
     the server got the delta, and a validation 4xx would fail identically
     forever — except a 503 shed, which is a "not now" the backoff exists
-    for. For durable write-once fan-out use the delta log instead
+    for.
+
+    Retry semantics are AT-LEAST-ONCE, not exactly-once: a timeout can
+    fire after the server applied the patch with the reply still in
+    flight, so a retried publish may re-apply the same delta. That is
+    safe for coefficients — patches are full-replacement, so a re-apply
+    is idempotent for served state — but the server's ``patch_seq``,
+    ``patched_entities_total``, and ``serving.delta_applied``
+    journal/trace rows count applies, and a timeout-retry can
+    double-count there. For durable write-once fan-out with a per-seq
+    exactly-once audit, use the delta log instead
     (``photon_tpu.replication`` — docs/serving.md §"Replication")."""
 
     def __init__(self, base_url: str, timeout_s: float = 30.0,
@@ -227,8 +237,13 @@ class HttpPublisher:
                     ) from e
             except (urllib.error.URLError, ConnectionError,
                     TimeoutError, OSError) as e:
-                # Connection-level failure: the server never saw the
-                # delta — the retryable case.
+                # Connection-level failure. A refused/reset connection
+                # means the server never saw the delta; a TIMEOUT may
+                # fire after the server applied it with the reply in
+                # flight, so this retry is at-least-once (class doc):
+                # idempotent for coefficients (full-replacement patches),
+                # but patch_seq and the delta_applied rows can
+                # double-count the re-post.
                 last = e
             if attempt >= self.retries:
                 break

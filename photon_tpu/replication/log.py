@@ -4,8 +4,10 @@ Generalizes the event-log machinery (``online/events.py``) and the patch
 journal (``online/delta.py``) into the replication substrate: the online
 trainer's publisher appends each :class:`ModelDelta` ONCE, and any number
 of serving replicas tail the file independently, each with its own atomic
-cursor. One record per line, one ``os.write`` per record on an O_APPEND
-fd, so a tailing replica never sees a torn line mid-record.
+cursor. One record per line, one ``os.write`` + ``os.fsync`` per record
+on an O_APPEND fd, so a tailing replica never sees a torn line
+mid-record and a crashed host never loses a record whose append
+returned.
 
 Record schema (``delta-log.jsonl``):
 
@@ -48,6 +50,21 @@ from photon_tpu.online.delta import ModelDelta
 logger = logging.getLogger("photon_tpu.replication")
 
 LOG_FILENAME = "delta-log.jsonl"
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a just-created/renamed entry survives a
+    crash (best-effort: not every platform/filesystem allows it)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 class DeltaLogError(ValueError):
@@ -138,14 +155,25 @@ def log_next_seq(path: str) -> int:
 
 class DeltaLogWriter:
     """Durable appender assigning dense monotone log ``seq``; resuming an
-    existing log continues the sequence from its tail."""
+    existing log continues the sequence from its tail.
+
+    Durability contract: ``append`` returns only after the record is
+    written AND fsynced — the trainer's commit-after-publish step may
+    advance past a delta the moment ``publish`` returns, so a host crash
+    must not be able to eat a record the trainer already committed past.
+    (The log's directory entry is fsynced once at creation; renames never
+    touch this file afterwards, appends only.)"""
 
     def __init__(self, path: str):
         self.path = path
-        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        parent = os.path.dirname(path) or "."
+        os.makedirs(parent, exist_ok=True)
+        existed = os.path.exists(path)
         self._next_seq = _tail_next_seq(path)
         self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
                            0o644)
+        if not existed:
+            _fsync_dir(parent)
 
     @property
     def next_seq(self) -> int:
@@ -156,6 +184,9 @@ class DeltaLogWriter:
         self._next_seq += 1
         row = {"seq": seq, "ts": time.time(), **row}
         os.write(self._fd, (json.dumps(row) + "\n").encode("utf-8"))
+        # Page cache is not durability: a power loss could otherwise
+        # drop a record whose publish already returned (class doc).
+        os.fsync(self._fd)
         return seq
 
     def append(self, delta: ModelDelta,
@@ -293,14 +324,20 @@ def find_latest_snapshot(path: str,
 
 
 class ReplicaCursor:
-    """One replica's consume position, persisted atomically as
-    ``<dir>/replica-cursor.<replica_id>.json``.
+    """One replica's exactly-once AUDIT watermark, persisted atomically
+    as ``<dir>/replica-cursor.<replica_id>.json``.
 
-    ``next_seq`` is the first UNAPPLIED log seq: saved only after
-    ``ModelRegistry.apply_delta`` returns, so a replica killed mid-apply
-    replays that record on rejoin — and the dense-seq reader discipline
-    plus the registry's atomic overlay swap make the replay idempotent
-    in effect (the record applies exactly once to durable state)."""
+    ``next_seq`` is the first log seq this replica identity has not yet
+    journaled as applied — saved only after ``ModelRegistry.apply_delta``
+    returns. It deliberately does NOT set where a rebooted replica starts
+    applying: registry state is in-memory only, so every boot replays the
+    log from 0 (or a snapshot marker) to rebuild it, journaling
+    pre-cursor records as replays (``replication/tailer.py`` module doc).
+    The cursor's job is lag accounting and keeping the per-seq
+    ``replica_delta_applied`` audit rows exactly-once across
+    incarnations. Saves fsync the temp file before the atomic replace,
+    so a crash can never leave a cursor pointing past rows the journal
+    never recorded."""
 
     def __init__(self, out_dir: str, replica_id: str):
         safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
@@ -326,7 +363,10 @@ class ReplicaCursor:
                 "updated_at": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             }, f)
+            f.flush()
+            os.fsync(f.fileno())    # content durable BEFORE the rename
         os.replace(tmp, self.path)  # atomic: never a torn cursor
+        _fsync_dir(os.path.dirname(self.path) or ".")
 
 
 class DeltaLogPublisher:

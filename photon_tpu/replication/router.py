@@ -207,24 +207,37 @@ class RouterServer:
             self.check_replicas()
 
     def check_replicas(self) -> None:
-        """One health sweep (also callable synchronously from tests)."""
+        """One health sweep (also callable synchronously from tests).
+        Never raises: the health thread runs for the router's whole
+        life, and a single replica answering garbage must not freeze the
+        pool view forever."""
         for r in self._replicas:
-            self._check_one(r)
+            try:
+                self._check_one(r)
+            except Exception as e:  # noqa: BLE001 - keep the loop alive
+                if self.logger is not None:
+                    self.logger.warning(
+                        "health check of %s failed unexpectedly: %s: %s",
+                        r.url, type(e).__name__, e)
+                with self._lock:
+                    r.status = "unhealthy"
+                    r.consecutive_failures += 1
+                    r.last_check_ts = time.time()
 
     def _check_one(self, r: _ReplicaState) -> None:
         try:
             with urllib.request.urlopen(
                     r.url + "/healthz",
                     timeout=self.health_timeout_s) as resp:
-                payload = json.loads(resp.read())
+                raw = resp.read()
             code = resp.status
         except urllib.error.HTTPError as e:
             # An HTTP error IS an answer: /healthz replies 503 with a
             # body when unhealthy — read it rather than marking unreachable.
             try:
-                payload = json.loads(e.read())
+                raw = e.read()
             except Exception:  # noqa: BLE001 - body is best-effort
-                payload = {}
+                raw = b""
             code = e.code
         except _CONNECT_ERRORS + (urllib.error.URLError,):
             with self._lock:
@@ -233,20 +246,45 @@ class RouterServer:
                 r.consecutive_failures += 1
                 r.last_check_ts = time.time()
             return
+        # Parse OUTSIDE the fetch try: a 200 carrying a non-JSON body (a
+        # proxy error page, a half-written reply) or malformed fields must
+        # degrade THIS replica, not kill the health thread.
+        try:
+            payload = json.loads(raw)
+            if not isinstance(payload, dict):
+                raise ValueError(f"healthz body is {type(payload).__name__}")
+            status = payload.get("status") or \
+                ("ok" if code == 200 else "unhealthy")
+            degraded = list(payload.get("degraded") or ())
+            rep = payload.get("replication") or {}
+            watermark = (int(rep["seq_watermark"])
+                         if rep.get("seq_watermark") is not None else None)
+            lag = int(rep.get("lag") or 0) if watermark is not None else None
+            fresh = payload.get("freshness") or {}
+            version = (int(fresh["model_version"])
+                       if fresh.get("model_version") is not None else None)
+        except (ValueError, TypeError, AttributeError) as e:
+            if self.logger is not None:
+                self.logger.warning(
+                    "unparseable /healthz from %s (HTTP %d): %s",
+                    r.url, code, e)
+            with self._lock:
+                r.reachable = True        # it answered — just uselessly
+                r.status = "unhealthy"    # drained until it answers sanely
+                r.consecutive_failures += 1
+                r.last_check_ts = time.time()
+            return
         with self._lock:
             r.reachable = True
             r.consecutive_failures = 0
             r.last_check_ts = time.time()
-            r.status = payload.get("status") or \
-                ("ok" if code == 200 else "unhealthy")
-            r.degraded = list(payload.get("degraded") or ())
-            rep = payload.get("replication") or {}
-            if rep.get("seq_watermark") is not None:
-                r.seq_watermark = int(rep["seq_watermark"])
-                r.lag = int(rep.get("lag") or 0)
-            fresh = payload.get("freshness") or {}
-            if fresh.get("model_version") is not None:
-                r.model_version = int(fresh["model_version"])
+            r.status = status
+            r.degraded = degraded
+            if watermark is not None:
+                r.seq_watermark = watermark
+                r.lag = lag
+            if version is not None:
+                r.model_version = version
 
     # -------------------------------------------------------------- routing
 

@@ -7,19 +7,28 @@ its persisted cursor and applies every record through the existing
 swap-lock-serialized route ``POST /admin/patch`` takes, so replication
 and direct pushes can never interleave torn state.
 
-Exactly-once, proven by seq: the log is dense, the reader refuses gaps
-and skips duplicates, and the cursor advances (atomic replace) only after
-``apply_delta`` returns. A replica killed mid-apply rejoins at the same
-record; one that already applied it skips it as a duplicate. The
-per-apply journal rows (``replica_delta_applied``, carrying the log seq)
-are the audit trail ``scripts/replica_smoke.py`` sums across the fleet.
+Applied state is IN-MEMORY ONLY (the registry's coefficient overlay dies
+with the process), so a (re)booting tailer always rebuilds it: replay
+starts at seq 0 into the freshly loaded registry — or jumps straight to
+the log's latest full-snapshot marker when the backlog exceeds
+``catchup_lag`` — and only converges the watermark once the registry
+really holds every logged delta. The persisted cursor deliberately does
+NOT set the replay start: it is the exactly-once AUDIT watermark, the
+first log seq this replica identity has not yet journaled as applied.
+Records below it re-apply on rejoin (full-replacement patches make the
+replay idempotent for coefficients) but are journaled as
+``replica_delta_replayed``; records at/after it journal
+``replica_delta_applied`` and advance the cursor (atomic replace) only
+after ``apply_delta`` returns. Those per-apply rows — each log seq
+exactly once across every incarnation of a replica id — are the audit
+trail ``scripts/replica_smoke.py`` sums across the fleet.
 
-Catch-up: when the replica's lag (log head − cursor) exceeds
-``catchup_lag`` and the log holds a full-snapshot marker at/ahead of the
-cursor, the tailer jumps — ``prepare_standby`` + ``swap`` to the marker's
-model dir (PR 12's warm-standby machinery, so the swap is a pointer move)
-and the cursor lands at ``marker seq + 1``. No eligible marker degrades
-to plain replay, which is always correct, just slower.
+Catch-up: when the boot backlog (log head − replay position) exceeds
+``catchup_lag`` and the log holds a full-snapshot marker ahead of the
+replay position, the tailer jumps — ``prepare_standby`` + ``swap`` to the
+marker's model dir (PR 12's warm-standby machinery, so the swap is a
+pointer move) and replay resumes at ``marker seq + 1``. No eligible
+marker degrades to plain replay, which is always correct, just slower.
 """
 from __future__ import annotations
 
@@ -72,6 +81,10 @@ class ReplicaTailer:
         self._applied_c = m.counter(
             "replica_deltas_applied_total",
             "delta-log records applied by this replica")
+        self._replayed_c = m.counter(
+            "replica_deltas_replayed_total",
+            "pre-cursor records re-applied at boot to rebuild in-memory "
+            "state")
         self._dup_c = m.counter(
             "replica_duplicate_seqs_total",
             "delta-log records skipped as already-applied duplicates")
@@ -89,13 +102,21 @@ class ReplicaTailer:
             "delta-log records between the log head and this replica")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._started = False
         self._lock = threading.Lock()
         self._applied_total = 0
+        self._replayed_total = 0
         self._duplicates = 0
         self._catchups = 0
         self._last_applied_ts: Optional[float] = None
         self._last_error: Optional[str] = None
-        self._next_seq = self.cursor.load()
+        # The registry handed in was just rebuilt from its model dir: it
+        # holds NONE of the deltas a previous incarnation applied (the
+        # overlay is in-memory only), so replay starts at 0 regardless of
+        # the persisted cursor — the cursor is the exactly-once JOURNAL
+        # watermark, not the state watermark (module doc).
+        self._next_seq = 0
+        self._audit_next = self.cursor.load()
         self._stamp_gauges()
 
     # ------------------------------------------------------------ lifecycle
@@ -104,6 +125,7 @@ class ReplicaTailer:
         """Tail in a background thread until :meth:`stop`."""
         if self._thread is not None and self._thread.is_alive():
             return
+        self._started = True
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._run_follow,
@@ -113,6 +135,7 @@ class ReplicaTailer:
         self._thread.start()
 
     def stop(self, timeout: float = 10.0) -> None:
+        self._started = False       # a deliberate stop is not a dead tailer
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
@@ -204,21 +227,37 @@ class ReplicaTailer:
 
     def _advance(self, rec: DeltaLogRecord, applied_delta: bool,
                  result: Optional[dict] = None) -> None:
+        # A record below the audit watermark is a boot-time REPLAY: a
+        # previous incarnation already journaled it as applied, so it
+        # rebuilds in-memory state but must not double-count in the
+        # exactly-once audit, and the durable cursor never regresses.
         with self._lock:
             self._next_seq = rec.seq + 1
+            replay = rec.seq < self._audit_next
+            if not replay:
+                self._audit_next = rec.seq + 1
             if applied_delta:
-                self._applied_total += 1
+                if replay:
+                    self._replayed_total += 1
+                else:
+                    self._applied_total += 1
                 self._last_applied_ts = time.time()
             applied_total = self._applied_total
-        self.cursor.save(rec.seq + 1, applied_total=applied_total)
+        if not replay:
+            self.cursor.save(rec.seq + 1, applied_total=applied_total)
         if applied_delta:
-            self._applied_c.inc(1, replica=self.replica_id)
-            self._journal(
-                "replica_delta_applied", seq=rec.seq,
-                delta_seq=rec.delta.seq,
-                patch_seq=(result or {}).get("patch_seq"),
-                entities=(result or {}).get("patched"),
-            )
+            if replay:
+                self._replayed_c.inc(1, replica=self.replica_id)
+                self._journal("replica_delta_replayed", seq=rec.seq,
+                              delta_seq=rec.delta.seq)
+            else:
+                self._applied_c.inc(1, replica=self.replica_id)
+                self._journal(
+                    "replica_delta_applied", seq=rec.seq,
+                    delta_seq=rec.delta.seq,
+                    patch_seq=(result or {}).get("patch_seq"),
+                    entities=(result or {}).get("patched"),
+                )
         self._stamp_gauges()
 
     def _on_duplicate(self, seq: int) -> None:
@@ -230,9 +269,12 @@ class ReplicaTailer:
     # ------------------------------------------------------------- catch-up
 
     def _maybe_catch_up(self) -> None:
-        """Snapshot catch-up at (re)join time: when the backlog exceeds
-        ``catchup_lag`` and a full-snapshot marker sits at/ahead of the
-        cursor, swap to it instead of replaying the whole backlog."""
+        """Snapshot catch-up at (re)join time: when the replay backlog
+        (log head − in-memory replay position) exceeds ``catchup_lag``
+        and a full-snapshot marker sits at/ahead of that position, swap
+        to it instead of replaying the whole backlog. At boot the replay
+        position is 0, so ANY marker in the log is eligible — including
+        the base marker a fresh log starts with."""
         if self.catchup_lag <= 0:
             return
         head = log_next_seq(self.log_path)
@@ -241,6 +283,11 @@ class ReplicaTailer:
             return
         marker = find_latest_snapshot(self.log_path,
                                       min_seq=self._next_seq)
+        if marker is not None and marker.seq <= self._next_seq:
+            # Jumping to a marker AT the replay position (e.g. the base
+            # marker at seq 0 on a fresh boot) rebuilds nothing replay
+            # wouldn't cover for free — skip the swap.
+            marker = None
         if marker is None:
             if self.logger is not None:
                 self.logger.info(
@@ -260,9 +307,14 @@ class ReplicaTailer:
             self.registry.swap(model_dir)
         with self._lock:
             self._next_seq = marker.seq + 1
+            # The jump covers every seq through the marker; the audit
+            # watermark moves forward (never back — a jump below the
+            # cursor is pure state rebuild, already journaled).
+            self._audit_next = max(self._audit_next, marker.seq + 1)
+            audit_next = self._audit_next
             self._catchups += 1
             applied_total = self._applied_total
-        self.cursor.save(marker.seq + 1, applied_total=applied_total)
+        self.cursor.save(audit_next, applied_total=applied_total)
         self._catchup_c.inc(1, replica=self.replica_id)
         self._journal("replica_catchup_done", snapshot_seq=marker.seq,
                       seconds=round(time.monotonic() - t0, 3))
@@ -296,12 +348,15 @@ class ReplicaTailer:
                 "log_path": self.log_path,
                 "seq_watermark": next_seq - 1,
                 "next_seq": next_seq,
+                "audit_next_seq": self._audit_next,
                 "head_seq": head,
                 "lag": max(0, head - next_seq),
                 "applied_total": self._applied_total,
+                "replayed_total": self._replayed_total,
                 "duplicates_skipped": self._duplicates,
                 "catchups": self._catchups,
                 "last_applied_ts": self._last_applied_ts,
+                "started": self._started,
                 "running": (self._thread is not None
                             and self._thread.is_alive()),
                 "error": self._last_error,

@@ -591,8 +591,11 @@ class ScoringServer:
     def degraded_reasons(self, version=None) -> list:
         """Why this (otherwise alive) server is serving worse answers:
         open/half-open circuit breakers (per-coordinate store breakers and
-        the scorer's kernel breaker) and device memory pressure over the
-        high-water mark. Empty = fully healthy."""
+        the scorer's kernel breaker), device memory pressure over the
+        high-water mark, and a dead or errored replication tailer (a
+        replica whose state is permanently frozen must be drained by the
+        router, not kept in rotation at an ever-staler watermark).
+        Empty = fully healthy."""
         v = version if version is not None else self.registry.current
         reasons = []
         try:
@@ -610,6 +613,20 @@ class ScoringServer:
                 reasons.append("memory_pressure")
         except Exception:  # noqa: BLE001 - health must answer regardless
             pass
+        rep = getattr(self, "replication", None)
+        if rep is not None:
+            try:
+                rsnap = rep.snapshot()
+                if rsnap.get("error"):
+                    # Refused delta or follow-loop crash: the tailer
+                    # refuses to advance, so the watermark is frozen.
+                    reasons.append("replication_error")
+                elif rsnap.get("started") and not rsnap.get("running"):
+                    # start() was called but the thread is gone without a
+                    # deliberate stop(): dead tailer, frozen state.
+                    reasons.append("replication_tailer_dead")
+            except Exception:  # noqa: BLE001 - health must answer
+                reasons.append("replication_unknown")
         return reasons
 
     @property

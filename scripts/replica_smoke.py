@@ -357,6 +357,40 @@ def main() -> None:
         print(f"replica_smoke: r2 rejoined and converged @ {head2 - 1}")
         score_burst(host, router_port, 12, "post-rejoin")
 
+        # ---- coefficient equality: the rejoined replica must SERVE the
+        # same answers, not just report the same watermark. (A replica
+        # that resumed past its backlog without rebuilding state would
+        # pass the seq audit while serving base-model coefficients for
+        # every entity patched before the kill.)
+        def replica_scores(rid):
+            scores = {}
+            for u in range(N_USERS):
+                conn = http.client.HTTPConnection(
+                    host, replicas[rid]["port"], timeout=30)
+                conn.request("POST", "/score", body=json.dumps({
+                    "features": [{"name": "g", "term": "0", "value": 1.0}],
+                    "entities": {"userId": f"user{u}"},
+                }).encode(), headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = resp.read()
+                conn.close()
+                if resp.status != 200:
+                    fail(f"direct /score on {rid} returned {resp.status}: "
+                         f"{body.decode('utf-8', 'replace')[:300]}")
+                scores[f"user{u}"] = json.loads(body)["score"]
+            return scores
+
+        baseline_scores = replica_scores("r0")
+        for rid in ("r1", "r2"):
+            other = replica_scores(rid)
+            for user, s in baseline_scores.items():
+                if abs(other[user] - s) > 1e-6:
+                    fail(f"coefficient divergence after rejoin: {rid} "
+                         f"scores {user}={other[user]!r} vs r0's {s!r} "
+                         "(same watermark, different state)")
+        print(f"replica_smoke: post-rejoin coefficient equality ok "
+              f"({N_USERS} entities x {len(REPLICA_IDS)} replicas)")
+
         # Router books: every routed request succeeded.
         _, rm = get_json(host, router_port, "/metrics")
         outcomes = rm["metrics"].get("router_requests_total") or {}
@@ -403,6 +437,17 @@ def main() -> None:
         if len(joins) != want:
             fail(f"{rid}: expected {want} replica_joined row(s), "
                  f"got {len(joins)}")
+        # r2's second incarnation must have REBUILT its in-memory state:
+        # every wave-1 delta (journaled as applied by the first
+        # incarnation) re-applied as a replay, never double-counted in
+        # the applied audit above.
+        replayed = sorted({r["seq"] for r in rows
+                           if r["event"] == "replica_delta_replayed"})
+        want_replayed = list(range(1, head1)) if rid == "r2" else []
+        if replayed != want_replayed:
+            fail(f"{rid}: replay audit failed: replayed {replayed}, "
+                 f"expected {want_replayed} (boot must rebuild the "
+                 "overlay the kill destroyed)")
     print(f"replica_smoke: exactly-once audit ok "
           f"({n_deltas} deltas x {len(REPLICA_IDS)} replicas, "
           "r2 across 2 incarnations)")

@@ -255,8 +255,11 @@ def test_tailer_exactly_once_and_rejoin(trained, tmp_path):
     journal = RecoveryJournal(str(tmp_path / "recovery.jsonl"))
     with DeltaLogWriter(log_path) as w:
         w.append_snapshot(m1, note="base")
-        for i in range(1, 4):
-            w.append(_delta(i, val=0.1 * i), trace_id=f"tid-{i}")
+        w.append(_delta(1, val=0.1), trace_id="tid-1")
+        # user2 is patched at seq 2 and NEVER again: the rejoin below
+        # only converges if replay actually rebuilds it.
+        w.append(_delta(2, entity="user2", val=0.7), trace_id="tid-2")
+        w.append(_delta(3, val=0.3), trace_id="tid-3")
     registry = _registry(m1)
     tailer = ReplicaTailer(registry, log_path, replica_id="rA",
                            cursor_dir=str(tmp_path), journal=journal)
@@ -268,20 +271,40 @@ def test_tailer_exactly_once_and_rejoin(trained, tmp_path):
     assert tailer.run_once() == 0
     assert tailer.snapshot()["applied_total"] == 3
 
-    # A new delta lands; a REJOINING tailer (same replica id → same
-    # cursor) applies only it.
+    # A new delta lands while the replica is DEAD. The rejoining
+    # incarnation (same replica id → same cursor) boots a FRESH registry
+    # from the base model dir — exactly what a killed-and-restarted
+    # serving process does: the coefficient overlay died with it, so the
+    # tailer must REPLAY the already-journaled backlog to rebuild state,
+    # then apply only the new record against the audit.
     with DeltaLogWriter(log_path) as w:
         w.append(_delta(4, val=0.9))
-    rejoined = ReplicaTailer(registry, log_path, replica_id="rA",
+    registry2 = _registry(m1)
+    rejoined = ReplicaTailer(registry2, log_path, replica_id="rA",
                              cursor_dir=str(tmp_path), journal=journal)
-    assert rejoined.run_once() == 1
-    assert rejoined.snapshot()["seq_watermark"] == 4
+    assert rejoined.run_once() == 4          # 3 replays + 1 new apply
+    snap = rejoined.snapshot()
+    assert snap["seq_watermark"] == 4
+    assert snap["replayed_total"] == 3 and snap["applied_total"] == 1
+
+    # The rebuilt registry SERVES the first incarnation's coefficients —
+    # including the entity patched only by a replayed delta.
+    store = registry2.current.scorer._caches["perUser"].store
+    assert store.lookup("user2")[1][0] == pytest.approx(0.7)
+    assert store.lookup("user1")[1][0] == pytest.approx(0.9)
 
     # The journal's per-apply rows are the fleet-wide exactly-once audit:
-    # each log seq appears exactly once across both incarnations.
-    applied = [r["seq"] for r in _journal_rows(journal.path)
+    # each log seq appears exactly once across both incarnations, with
+    # the boot-time replays booked separately.
+    rows = _journal_rows(journal.path)
+    applied = [r["seq"] for r in rows
                if r["event"] == "replica_delta_applied"]
     assert sorted(applied) == [1, 2, 3, 4]
+    replayed = [r["seq"] for r in rows
+                if r["event"] == "replica_delta_replayed"]
+    assert sorted(replayed) == [1, 2, 3]
+    # The durable cursor never regressed during the replay.
+    assert ReplicaCursor(str(tmp_path), "rA").load() == 5
 
 
 def test_tailer_follow_thread_applies_live(trained, tmp_path):
@@ -750,3 +773,121 @@ def test_router_relays_client_errors_without_retry():
         router.shutdown()
         httpd.shutdown()
         httpd.server_close()
+
+
+def test_router_survives_unparseable_healthz():
+    """A replica answering 200 with a non-JSON body (a proxy error page,
+    a half-dead process) must degrade THAT replica — never kill the
+    health thread and freeze the router's pool view forever."""
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            body = b"<html>bad gateway</html>"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    h, p = httpd.server_address[:2]
+    bad_url = f"http://{h}:{p}"
+    good = _StubReplica("good", watermark=3)
+    router = _router([bad_url, good])
+    host, port = router.address
+    try:
+        router.check_replicas()              # must not raise
+        snap = router.health_snapshot()
+        bad = next(r for r in snap["replicas"] if r["url"] == bad_url)
+        # It answered, so it's reachable — but unhealthy, hence drained.
+        assert bad["reachable"] and bad["status"] == "unhealthy"
+        assert bad["consecutive_failures"] >= 1
+        for _ in range(5):
+            status, body = _post(host, port, "/score", {})
+            assert status == 200 and body["replica"] == "good"
+    finally:
+        router.shutdown()
+        good.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_router_survives_malformed_watermark():
+    """Garbage field TYPES inside an otherwise-JSON health body (e.g. a
+    non-numeric seq_watermark) must not kill the sweep either."""
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({
+                "status": "ok", "degraded": [],
+                "replication": {"seq_watermark": "not-a-number",
+                                "lag": None},
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    httpd.daemon_threads = True
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    h, p = httpd.server_address[:2]
+    router = _router([f"http://{h}:{p}"])
+    try:
+        router.check_replicas()              # must not raise
+        snap = router.health_snapshot()
+        assert snap["replicas"][0]["status"] == "unhealthy"
+    finally:
+        router.shutdown()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ------------------------------------------------- replica health surface
+
+
+class _FakeTailer:
+    def __init__(self, **snap):
+        self._snap = {"error": None, "started": False, "running": False,
+                      **snap}
+
+    def snapshot(self):
+        return dict(self._snap)
+
+
+def test_healthz_degrades_on_dead_tailer():
+    """A dead follow thread (or a refused delta) freezes the replica's
+    state; /healthz must say 'degraded' so the router drains it instead
+    of weighting it by a staleness that never reaches zero."""
+    from types import SimpleNamespace
+
+    from photon_tpu.serving import ScoringServer
+
+    srv = ScoringServer.__new__(ScoringServer)
+    v = SimpleNamespace(scorer=None)       # breaker snapshot unavailable
+
+    def reasons(tailer):
+        srv.replication = tailer
+        return [r for r in srv.degraded_reasons(v)
+                if r.startswith("replication")]
+
+    # No tailer at all / a healthy follower / a deliberate run_once-only
+    # tailer (never started): nothing to report.
+    srv.replication = None
+    assert [r for r in srv.degraded_reasons(v)
+            if r.startswith("replication")] == []
+    assert reasons(_FakeTailer(started=True, running=True)) == []
+    assert reasons(_FakeTailer(started=False, running=False)) == []
+    # Thread started then died without stop(): drained.
+    assert reasons(_FakeTailer(started=True, running=False)) == \
+        ["replication_tailer_dead"]
+    # A recorded error (refused delta) drains even while the thread is
+    # still nominally alive.
+    assert reasons(_FakeTailer(started=True, running=True,
+                               error="ValueError: poisoned")) == \
+        ["replication_error"]
