@@ -121,6 +121,21 @@ echo "== replica smoke (delta-log fan-out, router kill window, rejoin-and-conver
 # topology with >= 1 publish->apply cross-process trace join.
 python scripts/replica_smoke.py
 
+echo "== control smoke (canary promote/rollback + anomaly mitigation; docs/control.md) =="
+# The closed-loop control plane against REAL process boundaries: trainer,
+# online trainer publishing into the canary SIDE-CHANNEL log, a canary
+# replica tailing it, a traffic replica + router on the MAIN log, and the
+# control driver ticking over all of it. A clean wave must soak and
+# PROMOTE into the main log (r0 converges on it); a poisoned delta
+# (coefficients driven to +80) must ROLL BACK — canary swapped to base,
+# promoted mainline deltas resynced, main log head untouched, r0's
+# journal showing zero poison applies. A fault-planned latency level
+# shift on a late-joining replica must be mitigated by the standby+swap
+# lever. Then the books: the control ledger tells the whole story with
+# no lever reversal inside its cooldown, and the fleet report renders a
+# populated Control section with the controller in the topology.
+python scripts/control_smoke.py
+
 echo "== bench analysis (advisory compare of newest artifacts + doc sync) =="
 # Backend-aware regression gate over the two newest checked-in bench
 # artifacts (docs/observability.md §gate). ADVISORY: verdicts print on

@@ -49,6 +49,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "serving replicas tail (docs/serving.md "
                         "§'Replication'); combinable with --serve-url "
                         "(both must succeed per publish)")
+    p.add_argument("--canary-log", default=None,
+                   help="publish deltas into this CANARY side-channel log "
+                        "instead of the mainline --delta-log; only the "
+                        "designated canary replica tails it, and the "
+                        "control driver promotes soaked waves into the "
+                        "main log (docs/control.md §'Canary protocol'). "
+                        "Mutually exclusive with --delta-log")
     p.add_argument("--publish-retries", type=int, default=3,
                    help="bounded retries (decorrelated-jitter backoff) "
                         "for --serve-url publishes hitting transient "
@@ -219,11 +226,21 @@ def _run(args) -> dict:
     if args.serve_url:
         sinks.append(HttpPublisher(args.serve_url,
                                    retries=args.publish_retries))
-    if getattr(args, "delta_log", None):
+    if getattr(args, "delta_log", None) and getattr(args, "canary_log",
+                                                    None):
+        raise SystemExit(
+            "--delta-log and --canary-log are mutually exclusive: under "
+            "canary control the CONTROLLER owns the main log (waves reach "
+            "it only by promotion)")
+    # Under canary control the trainer writes the SIDE CHANNEL only; the
+    # control driver owns the main log and appends promoted waves there.
+    wave_log = (getattr(args, "canary_log", None)
+                or getattr(args, "delta_log", None))
+    if wave_log:
         from photon_tpu.replication import DeltaLogPublisher
 
         sinks.append(DeltaLogPublisher(
-            args.delta_log, snapshot_model_dir=args.model_dir))
+            wave_log, snapshot_model_dir=args.model_dir))
     if len(sinks) > 1:
         from photon_tpu.replication import FanoutPublisher
 
@@ -263,6 +280,7 @@ def _run(args) -> dict:
         "events_path": args.events,
         "serve_url": args.serve_url,
         "delta_log": getattr(args, "delta_log", None),
+        "canary_log": getattr(args, "canary_log", None),
         "start_seq": start_seq,
         **{k: v for k, v in summary.items() if k != "refreshes"},
     }

@@ -26,23 +26,17 @@ Example:
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+from typing import TYPE_CHECKING, Optional, Sequence
 
-from photon_tpu.estimators.config import (
-    CoordinateDataConfig,
-    FactoredRandomEffectDataConfig,
-    FixedEffectDataConfig,
-    GLMOptimizationConfiguration,
-    RandomEffectDataConfig,
-    reg_weight_sweep,
-)
-from photon_tpu.functions.problem import VarianceComputationType
-from photon_tpu.optim import OptimizerType
-from photon_tpu.optim.regularization import (
-    RegularizationContext,
-    RegularizationType,
-    elastic_net_context,
-)
+# The estimator/optimizer config types reach jax-backed kernels on import.
+# They are needed only by the coordinate mini-DSL parsers, so they load
+# lazily inside those functions — the accelerator-free drivers (router,
+# control) import this module for flag helpers and must stay jax-free.
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from photon_tpu.estimators.config import (
+        CoordinateDataConfig,
+        GLMOptimizationConfiguration,
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +67,20 @@ def _parse_bool(cid: str, key: str, raw: str) -> bool:
 
 
 def parse_coordinate_spec(spec: str) -> CoordinateSpec:
+    from photon_tpu.estimators.config import (
+        FactoredRandomEffectDataConfig,
+        FixedEffectDataConfig,
+        GLMOptimizationConfiguration,
+        RandomEffectDataConfig,
+    )
+    from photon_tpu.functions.problem import VarianceComputationType
+    from photon_tpu.optim import OptimizerType
+    from photon_tpu.optim.regularization import (
+        RegularizationContext,
+        RegularizationType,
+        elastic_net_context,
+    )
+
     cid, sep, body = spec.partition(":")
     cid = cid.strip()
     if not sep or not cid:
@@ -180,6 +188,8 @@ def parse_coordinates(specs: Sequence[str]) -> list[CoordinateSpec]:
 def configs_from_specs(specs: Sequence[CoordinateSpec]):
     """(data configs by cid, optimization-config sweep) from parsed specs —
     the reference's Seq[GameOptimizationConfiguration] expansion."""
+    from photon_tpu.estimators.config import reg_weight_sweep
+
     data_configs = {c.cid: c.data for c in specs}
     base = {c.cid: c.optimization.with_reg_weight(c.reg_weights[0]) for c in specs}
     sweep_axes = {

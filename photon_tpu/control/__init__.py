@@ -1,0 +1,42 @@
+"""Closed-loop control plane (docs/control.md).
+
+Observation (PR 15's telemetry + the replicas' live HTTP surfaces) feeds a
+declarative policy engine whose decisions actuate existing levers — PR 12's
+standby+swap, PR 13's memory shed, PR 16's tailer restart, the batcher's
+reconfigure — with hysteresis, per-lever cooldowns, and budgets so the loop
+provably damps. Every decision is journaled to ``control-ledger.jsonl``
+under the PR 15 journal contract. Importable without jax: the control
+driver runs on boxes that never load an accelerator runtime.
+"""
+from photon_tpu.control.actions import LeverError, Levers, promote_wave
+from photon_tpu.control.controller import Controller, ReplicaTarget
+from photon_tpu.control.ledger import (
+    LEDGER_FILENAME,
+    ControlLedger,
+    read_ledger,
+)
+from photon_tpu.control.policy import (
+    AutoscalePolicy,
+    CanaryPolicy,
+    ControlPolicy,
+    Decision,
+    PolicyEngine,
+    Rule,
+)
+
+__all__ = [
+    "AutoscalePolicy",
+    "CanaryPolicy",
+    "Controller",
+    "ControlLedger",
+    "ControlPolicy",
+    "Decision",
+    "LEDGER_FILENAME",
+    "LeverError",
+    "Levers",
+    "PolicyEngine",
+    "ReplicaTarget",
+    "Rule",
+    "promote_wave",
+    "read_ledger",
+]

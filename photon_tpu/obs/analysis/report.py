@@ -205,6 +205,44 @@ def _ledger_counts(rows: Sequence[Mapping]) -> dict:
     return {"rows": len(rows), "by_event": by_event, "by_cause": by_cause}
 
 
+def _control_section(rows: Sequence[Mapping]) -> Optional[dict]:
+    """Digest of the control plane's decision ledger
+    (``control-ledger.jsonl`` — docs/control.md §ledger): action/outcome
+    tallies, canary verdicts, and the suppression counts that evidence
+    the damping guarantees (a loop that never records a cooldown or
+    budget suppression was never tested against pressure)."""
+    if not rows:
+        return None
+    actions: dict[str, int] = {}
+    outcomes = {"ok": 0, "failed": 0}
+    suppressed: dict[str, int] = {}
+    canary = {"promoted": 0, "rolled_back": 0, "last_verdict": None}
+    for r in rows:
+        ev = str(r.get("event", "?"))
+        if ev == "action":
+            a = str(r.get("action", "?"))
+            actions[a] = actions.get(a, 0) + 1
+        elif ev == "action_outcome":
+            outcomes["ok" if r.get("ok") else "failed"] += 1
+        elif ev == "action_suppressed":
+            reason = str(r.get("reason", "?"))
+            suppressed[reason] = suppressed.get(reason, 0) + 1
+        elif ev == "canary_promote":
+            canary["promoted"] += 1
+            canary["last_verdict"] = "promote"
+        elif ev == "canary_rollback":
+            canary["rolled_back"] += 1
+            canary["last_verdict"] = "rollback"
+    return {
+        **_ledger_counts(rows),
+        "actions": actions,
+        "outcomes": outcomes,
+        "suppressed": suppressed,
+        "canary": canary,
+        "events": list(rows)[-200:],
+    }
+
+
 def _freshness_watermarks(metrics_jsonl: Sequence[str]) -> dict:
     """Latest non-empty ``freshness`` block per metrics history file."""
     out = {}
@@ -400,6 +438,7 @@ def build_report(
     # -- merged recovery ledger -------------------------------------------
     ledger = fleet.merge_journals(files.journals)
     patch_rows = fleet.merge_journals(files.patch_journals)
+    control_rows = fleet.merge_journals(files.control_ledgers)
 
     report = {
         "schema": REPORT_SCHEMA,
@@ -419,6 +458,7 @@ def build_report(
             "events": ledger[-200:],
         },
         "patch_ledger": {"rows": len(patch_rows)},
+        "control": _control_section(control_rows),
         "freshness": _freshness_watermarks(files.metrics_jsonl),
         "slo": _last_slo(files.metrics_jsonl),
         "bench": _newest_bench(files.bench_artifacts),
@@ -507,6 +547,29 @@ def format_markdown(report: Mapping, top: int = 5) -> str:
             lines.append(
                 "router: " + ", ".join(
                     f"{k}={json.dumps(v)}" for k, v in sorted(rt.items())))
+
+    ctl = report.get("control")
+    if ctl:
+        out = ctl.get("outcomes") or {}
+        lines += ["", "## Control",
+                  f"{ctl.get('rows', 0)} ledger row(s); actions ok="
+                  f"{out.get('ok', 0)}, failed={out.get('failed', 0)}."]
+        for ev, n in sorted((ctl.get("by_event") or {}).items()):
+            lines.append(f"- {ev}: {n}")
+        if ctl.get("actions"):
+            lines.append("actions by lever: "
+                         + ", ".join(f"{a}={n}" for a, n
+                                     in sorted(ctl["actions"].items())))
+        if ctl.get("suppressed"):
+            lines.append("suppressed (damping): "
+                         + ", ".join(f"{r}={n}" for r, n
+                                     in sorted(ctl["suppressed"].items())))
+        can = ctl.get("canary") or {}
+        if can.get("promoted") or can.get("rolled_back"):
+            lines.append(
+                f"canary: promoted={can.get('promoted', 0)}, "
+                f"rolled_back={can.get('rolled_back', 0)}, "
+                f"last verdict={can.get('last_verdict')}")
 
     fresh = report.get("freshness") or {}
     lines += ["", "## Freshness watermarks"]

@@ -405,6 +405,7 @@ class FleetRunFiles:
     metrics_jsonl: list
     journals: list
     patch_journals: list
+    control_ledgers: list
     bench_artifacts: list
 
     @property
@@ -419,14 +420,16 @@ def discover(run_dir: str, max_depth: int = 4) -> FleetRunFiles:
     Layout (docs/observability.md §"Fleet view"): ``--telemetry-dir``
     writes ``trace.<role>.<pid>.json`` and ``registry.<role>.<pid>.json``
     per process; driver output dirs nested under the run root contribute
-    ``*metrics*.jsonl`` histories, ``recovery*.jsonl`` journals, and
-    ``patch-journal.jsonl``. Bench artifacts (``BENCH_DETAILS*.json`` /
-    ``BENCH_r*.json``) join the report when present.
+    ``*metrics*.jsonl`` histories, ``recovery*.jsonl`` journals,
+    ``patch-journal.jsonl``, and the control plane's
+    ``control-ledger*.jsonl`` decision ledgers. Bench artifacts
+    (``BENCH_DETAILS*.json`` / ``BENCH_r*.json``) join the report when
+    present.
     """
     run_dir = os.path.abspath(run_dir)
     out = FleetRunFiles(run_dir=run_dir, traces=[], registry_shards=[],
                         metrics_jsonl=[], journals=[], patch_journals=[],
-                        bench_artifacts=[])
+                        control_ledgers=[], bench_artifacts=[])
     base_depth = run_dir.rstrip(os.sep).count(os.sep)
     for root, dirs, files in os.walk(run_dir):
         if root.count(os.sep) - base_depth >= max_depth:
@@ -445,6 +448,9 @@ def discover(run_dir: str, max_depth: int = 4) -> FleetRunFiles:
                 out.journals.append(path)
             elif name == "patch-journal.jsonl":
                 out.patch_journals.append(path)
+            elif name.startswith("control-ledger") \
+                    and name.endswith(".jsonl"):
+                out.control_ledgers.append(path)
             elif name.endswith(".jsonl") and "metrics" in name:
                 out.metrics_jsonl.append(path)
             elif name.startswith(("BENCH_DETAILS", "BENCH_r")) \

@@ -47,13 +47,27 @@ from photon_tpu.online.events import (
     resolve_event_features,
 )
 from photon_tpu.online.state import EntityWindows, OnlineModelState
-from photon_tpu.online.trainer import (
-    HttpPublisher,
-    OnlineCoordinate,
-    OnlineTrainer,
-    OnlineTrainerConfig,
-    RegistryPublisher,
+
+# trainer names resolve lazily (PEP 562): importing the trainer module
+# builds an OnlineTrainerConfig default, which reaches the jax-backed
+# Newton kernels — an import cost (and a hard jax dependency) that the
+# jax-free consumers of this package (replication/log's ModelDelta use,
+# the router and control drivers) must not pay.
+_TRAINER_EXPORTS = (
+    "HttpPublisher",
+    "OnlineCoordinate",
+    "OnlineTrainer",
+    "OnlineTrainerConfig",
+    "RegistryPublisher",
 )
+
+
+def __getattr__(name: str):
+    if name in _TRAINER_EXPORTS:
+        from photon_tpu.online import trainer
+
+        return getattr(trainer, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
     "EntityPatch",

@@ -89,6 +89,24 @@ class ModelDelta:
             },
         }
 
+    def idempotency_key(self) -> str:
+        """Identity for at-least-once publication dedupe
+        (``X-Photon-Idempotency-Key`` on ``POST /admin/patch``).
+
+        ``seq`` plus a digest of the canonical wire form — NOT the bare
+        seq: a restarted trainer incarnation restarts ``_delta_seq`` at 0
+        (in-memory by design, PR 16), so two different incarnations reuse
+        low seqs for genuinely different deltas, and those must both
+        apply. Content-addressing makes the key collide exactly when the
+        payload is byte-identical — i.e. exactly when a retry of the SAME
+        publish is in flight."""
+        import hashlib
+
+        digest = hashlib.sha256(
+            json.dumps(self.to_wire(), sort_keys=True).encode()
+        ).hexdigest()[:16]
+        return f"{int(self.seq)}:{digest}"
+
     @classmethod
     def from_wire(cls, d: dict) -> "ModelDelta":
         if not isinstance(d, dict) or not isinstance(d.get("patches"), dict):

@@ -161,16 +161,18 @@ class HttpPublisher:
     forever — except a 503 shed, which is a "not now" the backoff exists
     for.
 
-    Retry semantics are AT-LEAST-ONCE, not exactly-once: a timeout can
-    fire after the server applied the patch with the reply still in
-    flight, so a retried publish may re-apply the same delta. That is
-    safe for coefficients — patches are full-replacement, so a re-apply
-    is idempotent for served state — but the server's ``patch_seq``,
-    ``patched_entities_total``, and ``serving.delta_applied``
-    journal/trace rows count applies, and a timeout-retry can
-    double-count there. For durable write-once fan-out with a per-seq
-    exactly-once audit, use the delta log instead
-    (``photon_tpu.replication`` — docs/serving.md §"Replication")."""
+    Retry semantics are AT-LEAST-ONCE on the wire but exactly-once at the
+    server: every POST carries ``X-Photon-Idempotency-Key`` (the delta's
+    ``seq`` + content digest, :meth:`ModelDelta.idempotency_key`), so a
+    timeout that fired AFTER the server applied the patch — reply lost in
+    flight — makes the retry replay the first application's cached result
+    (``"duplicate": true`` in the reply, ``serve_patch_duplicates_total``
+    bumped) instead of re-applying. ``patch_seq``,
+    ``patched_entities_total``, and the ``serving.delta_applied``
+    journal/trace rows therefore count each logical delta once. For
+    durable write-once fan-out with a per-seq exactly-once audit, use the
+    delta log instead (``photon_tpu.replication`` — docs/serving.md
+    §"Replication")."""
 
     def __init__(self, base_url: str, timeout_s: float = 30.0,
                  retries: int = 3, backoff_s: float = 0.2,
@@ -205,6 +207,10 @@ class HttpPublisher:
         tid = current_trace_id()
         if tid is not None:
             headers["X-Photon-Trace-Id"] = tid
+        # One key for ALL attempts of this publish call: the server
+        # dedupes a retry whose predecessor applied but whose reply was
+        # lost (class docstring — the at-least-once double-count fix).
+        headers["X-Photon-Idempotency-Key"] = delta.idempotency_key()
         data = json.dumps(delta.to_wire()).encode("utf-8")
         delays = self._policy.delays()
         last: Optional[BaseException] = None

@@ -38,6 +38,7 @@ from photon_tpu.replication.log import (
     ReplicaCursor,
     iter_log,
     log_next_seq,
+    pending_records,
 )
 from photon_tpu.replication.router import RouterServer
 from photon_tpu.replication.tailer import ReplicaTailer
@@ -53,4 +54,5 @@ __all__ = [
     "RouterServer",
     "iter_log",
     "log_next_seq",
+    "pending_records",
 ]

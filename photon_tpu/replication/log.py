@@ -153,6 +153,25 @@ def log_next_seq(path: str) -> int:
     return _tail_next_seq(path)
 
 
+def pending_records(path: str, start_seq: int = 0,
+                    end_seq: Optional[int] = None) -> list:
+    """Materialize ``[start_seq, end_seq)`` as a list (non-follow read).
+
+    The control plane's canary window read: the controller snapshots a
+    wave's records at soak begin so its promote decision appends exactly
+    the records it adjudicated, even if the trainer keeps publishing into
+    the side channel mid-soak. Same seq discipline as :func:`iter_log`
+    (duplicates skipped, gaps refused)."""
+    out = []
+    for rec in iter_log(path, start_seq=start_seq, follow=False):
+        if rec is None:
+            continue
+        if end_seq is not None and rec.seq >= end_seq:
+            break
+        out.append(rec)
+    return out
+
+
 class DeltaLogWriter:
     """Durable appender assigning dense monotone log ``seq``; resuming an
     existing log continues the sequence from its tail.

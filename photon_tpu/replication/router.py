@@ -31,6 +31,7 @@ of the pool; 503 when no replica is reachable), ``GET /metrics`` (JSON,
 from __future__ import annotations
 
 import json
+import math
 import random
 import threading
 import time
@@ -128,6 +129,13 @@ class RouterServer:
         self.metrics.gauge_fn(
             "router_known_replicas", lambda: len(self._replicas),
             "replicas configured on this router")
+        # Per-replica drain state as a LABELED gauge (1 = receiving no
+        # traffic: unreachable, unhealthy, or degraded-drained), so the
+        # control plane and the fleet report read drain posture from one
+        # registry scrape instead of a /healthz fan-out.
+        self._drained_g = self.metrics.gauge(
+            "router_drained_replicas",
+            "1 when the labeled replica is excluded from routing")
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -223,6 +231,14 @@ class RouterServer:
                     r.status = "unhealthy"
                     r.consecutive_failures += 1
                     r.last_check_ts = time.time()
+        # Stamp drain posture once per sweep (not per request): the gauge
+        # answers "who is out of rotation RIGHT NOW" at sweep granularity,
+        # which is exactly the granularity the pool view updates at.
+        with self._lock:
+            states = [(r.url, r.reachable and r.status == "ok"
+                       and not r.degraded) for r in self._replicas]
+        for url, routable in states:
+            self._drained_g.set(0.0 if routable else 1.0, replica=url)
 
     def _check_one(self, r: _ReplicaState) -> None:
         try:
@@ -389,7 +405,26 @@ class RouterServer:
         return 503, {
             "error": "no replica available"
                      + (f" (last: {last_err})" if last_err else ""),
-        }, (("Retry-After", "1"),)
+        }, (("Retry-After", self._retry_after_hint()),)
+
+    def _retry_after_hint(self) -> str:
+        """Retry-After for pool exhaustion, derived from the HEALTHIEST
+        replica's probe schedule instead of a fixed constant: the pool
+        view can only improve at that replica's next health sweep, so the
+        honest hint is the time until ``last_check_ts +
+        health_interval_s`` — a client told "1" against a 30 s sweep would
+        hammer a door that cannot open yet. Clamped to >= 1 s (ceil)."""
+        now = time.time()
+        with self._lock:
+            checked = [r for r in self._replicas
+                       if r.last_check_ts is not None]
+            if not checked:
+                return str(max(1, math.ceil(self.health_interval_s)))
+            best = min(checked,
+                       key=lambda r: (r.consecutive_failures,
+                                      -(r.last_check_ts or 0.0)))
+            eta = (best.last_check_ts + self.health_interval_s) - now
+        return str(max(1, math.ceil(eta)))
 
     # ------------------------------------------------------------ snapshots
 

@@ -110,6 +110,7 @@ class ReplicaTailer:
         self._catchups = 0
         self._last_applied_ts: Optional[float] = None
         self._last_error: Optional[str] = None
+        self._refused = False   # a validation-refused delta poisons the log
         # The registry handed in was just rebuilt from its model dir: it
         # holds NONE of the deltas a previous incarnation applied (the
         # overlay is in-memory only), so replay starts at 0 regardless of
@@ -140,6 +141,34 @@ class ReplicaTailer:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+
+    def restart(self) -> dict:
+        """Journaled restart request (``POST /admin/replication/restart``,
+        the control plane's ``replication_tailer_dead`` remediation).
+
+        A live follow thread makes this a no-op (``restarted: false``) —
+        the lever is for the DEAD-tailer state, and an idempotent restart
+        must not double-journal. A VALIDATION-refused delta also refuses
+        to restart: the log itself is poisoned, so re-tailing would refuse
+        again at the same seq — the error correctly keeps the replica
+        drained until an operator intervenes. A transient follow-loop
+        death clears the error and restarts the thread. Returns
+        ``{"restarted", "snapshot"}``."""
+        alive = self._thread is not None and self._thread.is_alive()
+        if alive:
+            return {"restarted": False, "snapshot": self.snapshot()}
+        with self._lock:
+            refused = self._refused
+            err = self._last_error
+            if not refused:
+                self._last_error = None
+        if refused:
+            return {"restarted": False, "refused": True,
+                    "snapshot": self.snapshot()}
+        self._journal("replica_tailer_restarted",
+                      prior_error=(err or "")[:200] or None)
+        self.start()
+        return {"restarted": True, "snapshot": self.snapshot()}
 
     def _run_follow(self) -> None:
         try:
@@ -217,6 +246,7 @@ class ReplicaTailer:
                 self._error_c.inc(1, replica=self.replica_id)
                 with self._lock:
                     self._last_error = f"{type(e).__name__}: {e}"
+                    self._refused = True
                 self._journal(
                     "replica_apply_refused", seq=rec.seq,
                     error=f"{type(e).__name__}: {str(e)[:200]}")

@@ -259,11 +259,38 @@ class MicroBatcher:
                 self.stats["max_batch_rows"], len(batch)
             )
 
+    def reconfigure(self, max_batch: Optional[int] = None,
+                    max_queue: Optional[int] = None) -> dict:
+        """Hot-tune batch/queue limits (the control plane's autoscaling
+        lever, ``POST /admin/tune``).
+
+        The worker reads ``self.max_batch`` fresh at every assembly round
+        and ``Queue.maxsize`` is consulted under the queue's own mutex on
+        each ``put_nowait``, so both changes take effect at the next
+        admission/dispatch without pausing the worker. Shrinking
+        ``max_queue`` below the current depth never drops queued waiters —
+        the bound only gates NEW admissions. Returns the active config."""
+        with self._submit_lock:
+            if max_batch is not None:
+                if int(max_batch) < 1:
+                    raise ValueError(
+                        f"max_batch must be >= 1, got {max_batch}")
+                self.max_batch = int(max_batch)
+            if max_queue is not None:
+                if int(max_queue) < 1:
+                    raise ValueError(
+                        f"max_queue must be >= 1, got {max_queue}")
+                self.max_queue = int(max_queue)
+                self._q.maxsize = self.max_queue
+            return {"max_batch": self.max_batch,
+                    "max_queue": self.max_queue}
+
     def snapshot(self) -> dict:
         s = dict(self.stats)
         s["mean_batch_rows"] = round(
             s["rows"] / s["batches"], 2) if s["batches"] else 0.0
         s["queued"] = self._q.qsize()
+        s["max_batch"] = self.max_batch
         s["max_queue"] = self.max_queue
         s["healthy"] = self.healthy
         return s

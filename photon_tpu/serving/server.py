@@ -31,6 +31,7 @@ import json
 import threading
 import time
 import urllib.parse
+from collections import OrderedDict
 from concurrent.futures import TimeoutError as FuturesTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -39,6 +40,7 @@ from photon_tpu.estimators.game_transformer import SCORE_KERNEL_NAME
 from photon_tpu.obs import (
     MetricsRegistry,
     REGISTRY as GLOBAL_REGISTRY,
+    instant,
     new_trace_id,
     retrace,
     trace_context,
@@ -102,9 +104,17 @@ class ScoringServer:
                 f"serve_{name}_total", f"scoring requests: {name}")
             for name in (
                 "requests", "errors", "swaps", "patches", "shed", "expired",
-                "degraded",
+                "degraded", "patch_duplicates", "tunes", "memory_sheds",
             )
         }
+        # /admin/patch idempotency (docs/online.md): a publisher whose
+        # POST timed out AFTER the server applied the delta retries the
+        # same logical delta; replaying the cached result instead of
+        # re-applying keeps the patch counters and patch_seq honest.
+        # Bounded LRU — the publisher retries back-to-back, so even a
+        # tiny window covers the at-least-once race with room to spare.
+        self._patch_seen: "OrderedDict[str, dict]" = OrderedDict()
+        self._patch_seen_lock = threading.Lock()
         self._latency = self.metrics.histogram(
             "serve_request_latency_seconds",
             "end-to-end /score latency (successful requests)",
@@ -257,6 +267,12 @@ class ScoringServer:
                     self._standby()
                 elif self.path == "/admin/patch":
                     self._patch()
+                elif self.path == "/admin/tune":
+                    self._tune()
+                elif self.path == "/admin/memory/shed":
+                    self._memory_shed()
+                elif self.path == "/admin/replication/restart":
+                    self._replication_restart()
                 else:
                     # Drain the unread body first: on a kept-alive
                     # connection it would otherwise be parsed as the next
@@ -426,6 +442,85 @@ class ScoringServer:
                     server.logger.info("standby prepared: %s", model_dir)
                 self._reply(200, {"status": "prepared", **info})
 
+            def _tune(self):
+                """Hot-tune the micro-batcher (the control plane's damped
+                autoscaling lever — docs/control.md §levers). Bounds are
+                validated by ``MicroBatcher.reconfigure``; a bad value
+                changes nothing."""
+                try:
+                    payload = self._read_json()
+                    if not isinstance(payload, dict):
+                        raise RequestError(
+                            "request body must be a JSON object")
+                    max_batch = payload.get("max_batch")
+                    max_queue = payload.get("max_queue")
+                    if max_batch is None and max_queue is None:
+                        raise RequestError(
+                            "max_batch or max_queue required")
+                    try:
+                        cfg = server.batcher.reconfigure(
+                            max_batch=(None if max_batch is None
+                                       else int(max_batch)),
+                            max_queue=(None if max_queue is None
+                                       else int(max_queue)),
+                        )
+                    except (TypeError, ValueError) as e:
+                        raise RequestError(str(e)) from None
+                except RequestError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 - keep old config
+                    server._count(errors=1)
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                server._count(tunes=1)
+                instant("serving.batcher_tuned", cat="serving", **cfg)
+                if server.logger is not None:
+                    server.logger.info(
+                        "batcher tuned: max_batch=%d max_queue=%d",
+                        cfg["max_batch"], cfg["max_queue"])
+                self._reply(200, cfg)
+
+            def _memory_shed(self):
+                """Proactive device-memory shed (control plane's answer to
+                a rising watermark, fired BEFORE the OOM ladder would).
+                Spills every pinned sweep-cache byte — expendable by
+                contract: spilled entries re-stream on next use."""
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    self.rfile.read(n)  # body carries nothing
+                try:
+                    out = server.shed_memory()
+                except Exception as e:  # noqa: BLE001 - shed must not 500
+                    server._count(errors=1)
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                server._count(memory_sheds=1)
+                self._reply(200, out)
+
+            def _replication_restart(self):
+                """Journaled restart request for a dead replica tailer
+                (the controller's ``replication_tailer_dead`` remediation;
+                budget enforcement lives controller-side)."""
+                n = int(self.headers.get("Content-Length") or 0)
+                if n:
+                    self.rfile.read(n)
+                if server.replication is None:
+                    self._reply(400, {
+                        "error": "no replication tailer attached"})
+                    return
+                try:
+                    out = server.replication.restart()
+                except Exception as e:  # noqa: BLE001
+                    server._count(errors=1)
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                if server.logger is not None:
+                    server.logger.info(
+                        "replication tailer restart requested "
+                        "(restarted=%s)", out.get("restarted"))
+                self._reply(200, out)
+
             def _patch(self):
                 """Online model delta (docs/online.md §"Delta protocol"):
                 changed-entity coefficient patches applied atomically to
@@ -441,6 +536,29 @@ class ScoringServer:
                     self._patch_traced()
 
             def _patch_traced(self):
+                # At-least-once dedupe: HttpPublisher stamps each POST
+                # with the delta's identity (seq + content digest); a
+                # retry of a publish whose reply was lost replays the
+                # FIRST application's result instead of double-applying —
+                # patch_seq, patched_entities_total, and the
+                # serving.delta_applied instant stay exactly-once. Keyed
+                # on content, not bare seq: a restarted trainer
+                # incarnation reuses low seqs for genuinely NEW deltas
+                # (PR 16 replay contract), and those must apply.
+                idem_key = self.headers.get("X-Photon-Idempotency-Key")
+                if idem_key:
+                    with server._patch_seen_lock:
+                        cached = server._patch_seen.get(idem_key)
+                        if cached is not None:
+                            server._patch_seen.move_to_end(idem_key)
+                    if cached is not None:
+                        server._count(patch_duplicates=1)
+                        if server.logger is not None:
+                            server.logger.info(
+                                "duplicate delta publish suppressed "
+                                "(key=%s)", idem_key)
+                        self._reply(200, {**cached, "duplicate": True})
+                        return
                 try:
                     payload = self._read_json()
                     from photon_tpu.online.delta import ModelDelta
@@ -470,6 +588,11 @@ class ScoringServer:
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
                 server._count(patches=1)
+                if idem_key:
+                    with server._patch_seen_lock:
+                        server._patch_seen[idem_key] = result
+                        while len(server._patch_seen) > 256:
+                            server._patch_seen.popitem(last=False)
                 if server.logger is not None:
                     server.logger.info(
                         "applied delta patch_seq=%d (%d entities)",
@@ -576,6 +699,33 @@ class ScoringServer:
         except Exception:  # noqa: BLE001 - harness fakes lack a registry
             out["standby"] = {"ready": False}
         return out
+
+    def shed_memory(self) -> dict:
+        """Unconditional proactive shed (``POST /admin/memory/shed``):
+        spill ALL pinned sweep-cache bytes and resample the watermark.
+        Unlike ``MemoryGuard.check`` this does not wait for high water —
+        the control plane fires it on a watermark TREND, before the OOM
+        ladder would have to act reactively. Spilled entries re-stream on
+        next use: throughput cost, never a wrong answer."""
+        from photon_tpu.data.device_cache import shed_pins
+        from photon_tpu.runtime.memory_guard import guard
+
+        freed = shed_pins(1 << 62)
+        g = guard()
+        sample = g.sample(force=True)
+        instant("serving.memory_shed", cat="serving",
+                freed_bytes=int(freed),
+                watermark=(None if sample is None
+                           else round(sample["watermark"], 4)))
+        if self.logger is not None:
+            self.logger.info(
+                "proactive memory shed freed %d bytes", freed)
+        return {
+            "freed_bytes": int(freed),
+            "watermark": (None if sample is None
+                          else round(sample["watermark"], 4)),
+            "available": sample is not None,
+        }
 
     def shed_for_memory_pressure(self) -> bool:
         """Admission gate: shed once the device-memory watermark crosses

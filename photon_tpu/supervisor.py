@@ -53,6 +53,7 @@ from photon_tpu.faults import fault_point
 
 __all__ = [
     "RestartPolicy",
+    "RestartBudget",
     "AttemptFailure",
     "RestartsExhausted",
     "run_with_recovery",
@@ -242,6 +243,46 @@ class RestartPolicy:
             else:
                 yield min(self.max_backoff_seconds, delay)
                 delay *= self.backoff_multiplier
+
+
+class RestartBudget:
+    """Counted restart allowance with :class:`RestartPolicy` pacing — the
+    supervision contract exported as a primitive other subsystems can
+    hold.
+
+    The control plane's ``replication_tailer_dead`` rule journals a
+    restart REQUEST per firing; this budget is what makes the requests
+    "within its restart budget" (ISSUE/docs/control.md): at most
+    ``policy.max_restarts`` grants, spaced no tighter than the policy's
+    decorrelated-jitter delay sequence. ``allow()`` returns True and
+    consumes a grant, or False (exhausted / still inside the pacing
+    window) — callers journal the refusal, they don't block on it."""
+
+    def __init__(self, policy: RestartPolicy,
+                 clock: Optional[Callable[[], float]] = None):
+        self.policy = policy
+        self._clock = clock or time.monotonic
+        self._delays = policy.delays()
+        self.spent = 0
+        self._not_before: Optional[float] = None
+
+    @property
+    def remaining(self) -> int:
+        return max(0, self.policy.max_restarts - self.spent)
+
+    def allow(self) -> bool:
+        if self.spent >= self.policy.max_restarts:
+            return False
+        now = self._clock()
+        if self._not_before is not None and now < self._not_before:
+            return False
+        self.spent += 1
+        self._not_before = now + next(self._delays)
+        return True
+
+    def snapshot(self) -> dict:
+        return {"spent": self.spent, "remaining": self.remaining,
+                "max_restarts": self.policy.max_restarts}
 
 
 @dataclasses.dataclass

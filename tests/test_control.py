@@ -1,0 +1,751 @@
+"""Closed-loop control plane (photon_tpu/control/ — docs/control.md).
+
+Coverage per ISSUE: the ledger's journal-contract row shape; the policy
+engine's damping guarantees driven with synthetic series and an
+injectable clock (hysteresis min-runs, structurally-impossible reversal
+inside a lever cooldown, budget exhaustion journaled once); the
+autoscaler's banded up/down decisions; and the controller's
+observe→decide→actuate→journal loop plus the canary promote/rollback
+protocol — all against scripted stub replicas, no accelerator needed.
+"""
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from photon_tpu.control import (
+    AutoscalePolicy,
+    CanaryPolicy,
+    ControlLedger,
+    ControlPolicy,
+    Controller,
+    LEDGER_FILENAME,
+    Levers,
+    PolicyEngine,
+    ReplicaTarget,
+    Rule,
+    promote_wave,
+    read_ledger,
+)
+from photon_tpu.online.delta import EntityPatch, ModelDelta
+from photon_tpu.replication import DeltaLogWriter, iter_log, log_next_seq
+from photon_tpu.supervisor import RestartPolicy
+
+
+def _delta(seq, entity="user1", val=0.1):
+    return ModelDelta(
+        seq=seq,
+        patches={"perUser": {entity: EntityPatch(
+            key=entity, cols=np.array([0], np.int32),
+            vals=np.array([val], np.float32))}},
+        event_horizon=seq,
+    )
+
+
+class _Clock:
+    """Injectable monotonic clock: cooldown tests never sleep."""
+
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ---------------------------------------------------------------- ledger
+
+
+def test_ledger_journal_row_contract(tmp_path):
+    """Rows carry the PR 15 journal contract (time/t/event/pid) so
+    fleet.merge_journals interleaves control rows with recovery rows."""
+    path = str(tmp_path / LEDGER_FILENAME)
+    ledger = ControlLedger(path)
+    ledger.record("controller_started", policy_digest="abc123")
+    ledger.record("action", action="shed_cache", target="http://r0")
+    rows = ledger.rows()
+    assert [r["event"] for r in rows] == ["controller_started", "action"]
+    for r in rows:
+        assert r["time"].endswith("Z") and "T" in r["time"]
+        assert isinstance(r["t"], float)
+        assert isinstance(r["pid"], int)
+    assert rows[0]["policy_digest"] == "abc123"
+    assert rows[1]["action"] == "shed_cache"
+
+
+def test_ledger_reader_tolerates_garbage(tmp_path):
+    path = tmp_path / LEDGER_FILENAME
+    ledger = ControlLedger(str(path))
+    ledger.record("observation", target="r0")
+    with open(path, "a") as f:
+        f.write('{"torn": tr')  # crashed-writer tail
+    assert [r["event"] for r in read_ledger(str(path))] == ["observation"]
+    assert list(read_ledger(str(tmp_path / "absent.jsonl"))) == []
+
+
+# --------------------------------------------------------- policy engine
+
+
+def _policy(**kw):
+    kw.setdefault("autoscale", None)
+    return ControlPolicy(**kw)
+
+
+def test_policy_json_roundtrip_and_digest(tmp_path):
+    p = ControlPolicy()
+    q = ControlPolicy.from_json(p.to_json())
+    assert q == p and q.digest() == p.digest()
+    path = tmp_path / "policy.json"
+    path.write_text(p.to_json())
+    assert ControlPolicy.from_file(str(path)).digest() == p.digest()
+    # Digest is content-addressed: any knob change moves it.
+    import dataclasses
+
+    assert dataclasses.replace(p, tick_s=2.0).digest() != p.digest()
+
+
+def test_policy_rejects_unknown_vocabulary():
+    with pytest.raises(ValueError):
+        Rule(name="x", signal="nope", kind="flag", action="shed_cache")
+    with pytest.raises(ValueError):
+        Rule(name="x", signal="errors", kind="vibes", action="shed_cache")
+    with pytest.raises(ValueError):
+        Rule(name="x", signal="errors", kind="flag", action="format_disk")
+    with pytest.raises(ValueError):
+        ControlPolicy(rules=(
+            Rule(name="dup", signal="errors", kind="flag",
+                 action="shed_cache"),
+            Rule(name="dup", signal="errors", kind="flag",
+                 action="shed_cache"),
+        ))
+
+
+def test_flag_rule_needs_min_run_consecutive():
+    """Hysteresis: one bad sample never fires a lever (min_run=2)."""
+    policy = _policy(rules=(Rule(
+        name="tailer_dead", signal="tailer_dead", kind="flag",
+        action="restart_tailer", min_run=2, cooldown_s=10.0, budget=5),))
+    eng = PolicyEngine(policy, clock=_Clock())
+    eng.observe("r0", {"tailer_dead": 1.0})
+    assert eng.decide("r0", {}) == []
+    eng.observe("r0", {"tailer_dead": 0.0})   # flicker resets the run
+    eng.observe("r0", {"tailer_dead": 1.0})
+    assert eng.decide("r0", {}) == []
+    eng.observe("r0", {"tailer_dead": 1.0})
+    out = eng.decide("r0", {})
+    assert [d.action for d in out] == ["restart_tailer"]
+
+
+def test_threshold_rule_requires_rising_trend():
+    """The memory rule fires on TRAJECTORY (high AND rising), not level —
+    a stable-high watermark is the guard's steady state, not a ramp."""
+    policy = _policy(rules=(Rule(
+        name="memory_trend", signal="memory_watermark", kind="threshold",
+        action="shed_cache", high=0.75, min_run=2, trend_ticks=3,
+        cooldown_s=10.0, budget=5),))
+    eng = PolicyEngine(policy, clock=_Clock())
+    for v in (0.80, 0.80, 0.80):              # high but flat
+        eng.observe("r0", {"memory_watermark": v})
+    assert eng.decide("r0", {}) == []
+    eng2 = PolicyEngine(policy, clock=_Clock())
+    for v in (0.76, 0.82, 0.90):              # high and climbing
+        eng2.observe("r0", {"memory_watermark": v})
+    out = eng2.decide("r0", {})
+    assert [d.action for d in out] == ["shed_cache"]
+    assert out[0].evidence["value"] == 0.90
+
+
+def test_level_shift_rule_fires_only_at_live_edge():
+    """A shift that detected ticks ago and re-baselined is history — the
+    predicate demands the anomaly be live at the newest sample."""
+    rule = Rule(name="latency_shift", signal="probe_latency_ms",
+                kind="level_shift", action="standby_swap",
+                z_threshold=6.0, window=8, min_history=4, min_run=2,
+                cooldown_s=0.0, budget=None)
+    policy = _policy(rules=(rule,))
+    clock = _Clock()
+    eng = PolicyEngine(policy, clock=clock)
+    for i in range(6):
+        eng.observe("r0", {"probe_latency_ms": 10.0 + (i % 3) * 0.2})
+        assert eng.decide("r0", {}) == []
+    eng.observe("r0", {"probe_latency_ms": 80.0})
+    assert eng.decide("r0", {}) == []          # run of 1: still hysteresis
+    eng.observe("r0", {"probe_latency_ms": 82.0})
+    fired = eng.decide("r0", {})
+    assert [d.action for d in fired] == ["standby_swap"]
+    assert fired[0].evidence["z"] >= 6.0
+    # Keep feeding the shifted level until the trailing window re-baselines:
+    # the rule must go quiet again (no cooldown/budget doing the work here).
+    quiet = 0
+    for _ in range(12):
+        eng.observe("r0", {"probe_latency_ms": 81.0})
+        if not eng.decide("r0", {}):
+            quiet += 1
+    assert quiet >= 4
+
+
+def test_cooldown_blocks_refire_until_elapsed():
+    """No lever refires (in EITHER direction) inside its cooldown — the
+    chaos drill's no-reversal property, provable with a fake clock."""
+    policy = _policy(rules=(Rule(
+        name="tailer_dead", signal="tailer_dead", kind="flag",
+        action="restart_tailer", min_run=1, cooldown_s=30.0, budget=None),))
+    clock = _Clock()
+    eng = PolicyEngine(policy, clock=clock)
+    eng.observe("r0", {"tailer_dead": 1.0})
+    assert len(eng.decide("r0", {})) == 1
+    clock.advance(5.0)
+    eng.observe("r0", {"tailer_dead": 1.0})
+    assert eng.decide("r0", {}) == []          # suppressed, not fired
+    sup = eng.drain_suppressed()
+    assert sup and sup[0]["reason"] == "cooldown"
+    assert 0 < sup[0]["cooldown_remaining_s"] <= 30.0
+    clock.advance(26.0)                        # past the window
+    eng.observe("r0", {"tailer_dead": 1.0})
+    assert len(eng.decide("r0", {})) == 1
+    # Cooldowns are per-target: r1 was never in r0's shadow.
+    eng.observe("r1", {"tailer_dead": 1.0})
+    assert len(eng.decide("r1", {})) == 1
+
+
+def test_budget_exhaustion_suppresses_and_flags_once():
+    policy = _policy(rules=(Rule(
+        name="tailer_dead", signal="tailer_dead", kind="flag",
+        action="restart_tailer", min_run=1, cooldown_s=1.0, budget=1),))
+    clock = _Clock()
+    eng = PolicyEngine(policy, clock=clock)
+    eng.observe("r0", {"tailer_dead": 1.0})
+    assert len(eng.decide("r0", {})) == 1      # spends the whole budget
+    firsts = []
+    for _ in range(3):
+        clock.advance(5.0)
+        eng.observe("r0", {"tailer_dead": 1.0})
+        assert eng.decide("r0", {}) == []
+        sup = eng.drain_suppressed()
+        assert sup[0]["reason"] == "budget"
+        firsts.append(sup[0]["first"])
+    assert firsts == [True, False, False]      # journaled once, not spammed
+
+
+def test_autoscale_up_down_and_dead_zone():
+    ap = AutoscalePolicy(queue_high=0.75, queue_low=0.25,
+                         knee_latency_ms=250.0, min_run=2,
+                         max_batch_floor=8, max_batch_ceiling=64,
+                         queue_per_batch=4, cooldown_s=20.0, budget=6)
+    policy = ControlPolicy(rules=(), autoscale=ap)
+    clock = _Clock()
+    eng = PolicyEngine(policy, clock=clock)
+    ctx = {"max_batch": 16, "max_queue": 64}
+    # Saturated queue + latency below the knee -> scale up x2.
+    for _ in range(2):
+        eng.observe("r0", {"queue_frac": 0.9, "probe_latency_ms": 50.0})
+    (d,) = eng.decide("r0", ctx)
+    assert d.action == "scale_batcher" and d.rule == "autoscale"
+    assert d.params == {"max_batch": 32, "max_queue": 128}
+    assert d.evidence["direction"] == "up"
+    # Dead zone between the bands: no decision, no suppression noise.
+    clock.advance(60.0)
+    for _ in range(2):
+        eng.observe("r0", {"queue_frac": 0.5, "probe_latency_ms": 300.0})
+    assert eng.decide("r0", ctx) == []
+    assert eng.drain_suppressed() == []
+    # Shallow queue + latency past the knee -> the batch IS the bottleneck.
+    clock.advance(60.0)
+    for _ in range(2):
+        eng.observe("r0", {"queue_frac": 0.1, "probe_latency_ms": 400.0})
+    (d,) = eng.decide("r0", ctx)
+    assert d.params["max_batch"] == 8 and d.evidence["direction"] == "down"
+    # Ceiling/floor clamp: at the floor, down decisions stop entirely.
+    clock.advance(60.0)
+    for _ in range(2):
+        eng.observe("r0", {"queue_frac": 0.1, "probe_latency_ms": 400.0})
+    assert eng.decide("r0", {"max_batch": 8}) == []
+
+
+def test_autoscale_shares_one_cooldown_both_directions():
+    """Up then immediately down is a reversal — structurally impossible
+    inside the shared (scale_batcher, target) cooldown."""
+    ap = AutoscalePolicy(min_run=1, cooldown_s=30.0, budget=None,
+                         max_batch_floor=8, max_batch_ceiling=64)
+    policy = ControlPolicy(rules=(), autoscale=ap)
+    clock = _Clock()
+    eng = PolicyEngine(policy, clock=clock)
+    eng.observe("r0", {"queue_frac": 0.9, "probe_latency_ms": 50.0})
+    (up,) = eng.decide("r0", {"max_batch": 16})
+    assert up.evidence["direction"] == "up"
+    clock.advance(1.0)
+    # Signals now argue DOWN; the cooldown set by the up-action refuses.
+    eng.observe("r0", {"queue_frac": 0.1, "probe_latency_ms": 400.0})
+    assert eng.decide("r0", {"max_batch": 32}) == []
+    assert eng.drain_suppressed()[0]["reason"] == "cooldown"
+    clock.advance(30.0)
+    eng.observe("r0", {"queue_frac": 0.1, "probe_latency_ms": 400.0})
+    (down,) = eng.decide("r0", {"max_batch": 32})
+    assert down.evidence["direction"] == "down"
+
+
+def test_decisions_capped_per_tick():
+    policy = _policy(
+        rules=(
+            Rule(name="a", signal="tailer_dead", kind="flag",
+                 action="restart_tailer", min_run=1, cooldown_s=0.0,
+                 budget=None),
+            Rule(name="b", signal="errors", kind="threshold",
+                 action="shed_cache", high=1.0, min_run=1, cooldown_s=0.0,
+                 budget=None),
+        ),
+        max_actions_per_tick=1,
+    )
+    eng = PolicyEngine(policy, clock=_Clock())
+    eng.observe("r0", {"tailer_dead": 1.0, "errors": 5.0})
+    assert len(eng.decide("r0", {})) == 1
+
+
+# ------------------------------------------------------- stub replicas
+
+
+class _StubControlReplica:
+    """A scripted serving replica for controller tests: /healthz,
+    /metrics, /score and every admin lever, with call recording."""
+
+    def __init__(self, name, score=1.0):
+        self.name = name
+        self.score = score
+        self.score_delay_s = 0.0
+        self.degraded = []
+        self.status = "ok"
+        self.watermark = 0
+        self.memory_watermark = 0.1
+        self.queued = 0
+        self.max_batch = 16
+        self.max_queue = 64
+        self.model_version = 1
+        self.calls = []          # (endpoint, payload) actuation record
+        self.patches = []        # wire deltas taken at /admin/patch
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _read_json(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                return json.loads(self.rfile.read(n)) if n else {}
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, {
+                        "status": stub.status,
+                        "degraded": list(stub.degraded),
+                        "model_version": stub.model_version,
+                        "replication": {"seq_watermark": stub.watermark,
+                                        "lag": 0},
+                    })
+                elif self.path == "/metrics":
+                    self._reply(200, {
+                        "latency": {"p95_ms": 5.0},
+                        "batcher": {"max_batch": stub.max_batch,
+                                    "max_queue": stub.max_queue,
+                                    "queued": stub.queued},
+                        "memory": {"watermark": stub.memory_watermark},
+                        "errors": 0,
+                    })
+                else:
+                    self._reply(404, {})
+
+            def do_POST(self):
+                payload = self._read_json()
+                if self.path == "/score":
+                    if stub.score_delay_s:
+                        time.sleep(stub.score_delay_s)
+                    self._reply(200, {"score": stub.score,
+                                      "model_version": stub.model_version})
+                    return
+                stub.calls.append((self.path, payload))
+                if self.path == "/admin/patch":
+                    stub.patches.append(payload)
+                    self._reply(200, {"patch_seq": len(stub.patches)})
+                elif self.path == "/admin/swap":
+                    stub.model_version += 1
+                    self._reply(200, {"version": stub.model_version})
+                elif self.path in ("/admin/standby", "/admin/memory/shed",
+                                   "/admin/tune"):
+                    self._reply(200, {"ok": True})
+                elif self.path == "/admin/replication/restart":
+                    self._reply(200, {"restarted": True})
+                else:
+                    self._reply(404, {})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd.daemon_threads = True
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self):
+        h, p = self.httpd.server_address[:2]
+        return f"http://{h}:{p}"
+
+    def admin_calls(self, path):
+        return [p for (ep, p) in self.calls if ep == path]
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+@pytest.fixture
+def stub():
+    s = _StubControlReplica("r0")
+    yield s
+    s.close()
+
+
+def _controller(policy, replicas, tmp_path, **kw):
+    ledger = ControlLedger(str(tmp_path / LEDGER_FILENAME))
+    return Controller(policy, replicas, ledger, **kw)
+
+
+# ----------------------------------------------------------- controller
+
+
+def test_controller_tailer_dead_restart_within_budget(stub, tmp_path):
+    """healthz replication_tailer_dead for min_run ticks -> one journaled
+    restart POST; the supervisor RestartBudget bounds repeat requests."""
+    stub.degraded = ["replication_tailer_dead"]
+    policy = _policy(
+        tick_s=0.01,
+        rules=(Rule(name="tailer_dead", signal="tailer_dead", kind="flag",
+                    action="restart_tailer", min_run=2, cooldown_s=0.0,
+                    budget=None),))
+    ctl = _controller(
+        policy, [ReplicaTarget(stub.url)], tmp_path,
+        restart_policy=RestartPolicy(max_restarts=1, backoff_seconds=0.0,
+                                     jitter=False))
+    ctl.tick()
+    assert stub.admin_calls("/admin/replication/restart") == []
+    ctl.tick()
+    assert len(stub.admin_calls("/admin/replication/restart")) == 1
+    # Third tick: predicate still holds, cooldown 0 — but the restart
+    # BUDGET refuses, journaled as a failed outcome, no HTTP fired.
+    ctl.tick()
+    assert len(stub.admin_calls("/admin/replication/restart")) == 1
+    rows = ctl.ledger.rows()
+    outcomes = [r for r in rows if r["event"] == "action_outcome"]
+    assert [o["ok"] for o in outcomes] == [True, False]
+    assert "budget" in outcomes[1]["error"]
+    assert any(r["event"] == "rule_fired" for r in rows)
+    assert any(r["event"] == "observation" for r in rows)
+
+
+def test_controller_memory_ramp_sheds_cache(stub, tmp_path):
+    policy = _policy(
+        tick_s=0.01,
+        rules=(Rule(name="memory_trend", signal="memory_watermark",
+                    kind="threshold", action="shed_cache", high=0.75,
+                    min_run=2, trend_ticks=3, cooldown_s=60.0, budget=3),))
+    ctl = _controller(policy, [ReplicaTarget(stub.url)], tmp_path)
+    for w in (0.5, 0.78, 0.85, 0.93):
+        stub.memory_watermark = w
+        ctl.tick()
+    assert len(stub.admin_calls("/admin/memory/shed")) == 1
+    # Cooldown holds the lever even as the ramp continues.
+    stub.memory_watermark = 0.97
+    ctl.tick()
+    assert len(stub.admin_calls("/admin/memory/shed")) == 1
+    assert any(r["event"] == "action_suppressed"
+               and r["reason"] == "cooldown" for r in ctl.ledger.rows())
+
+
+def test_controller_latency_shift_triggers_standby_swap(stub, tmp_path):
+    """The live 8x latency shift: the controller's own probe round-trips
+    shift immediately (the server histogram is lifetime-cumulative and
+    would take thousands of samples) and the standby+swap lever fires."""
+    policy = _policy(
+        tick_s=0.01,
+        rules=(Rule(name="latency_shift", signal="probe_latency_ms",
+                    kind="level_shift", action="standby_swap",
+                    z_threshold=6.0, window=8, min_history=4, min_run=2,
+                    cooldown_s=60.0, budget=2),))
+    ctl = _controller(
+        policy, [ReplicaTarget(stub.url)], tmp_path,
+        base_model_dir="/models/base",
+        probe_rows=[{"features": {}, "entities": {}}])
+    for _ in range(6):
+        ctl.tick()
+    assert stub.admin_calls("/admin/swap") == []
+    stub.score_delay_s = 0.25                 # the injected shift
+    ctl.tick()
+    ctl.tick()
+    assert stub.admin_calls("/admin/standby") == [
+        {"model_dir": "/models/base"}]
+    assert stub.admin_calls("/admin/swap") == [
+        {"model_dir": "/models/base"}]
+    rows = ctl.ledger.rows()
+    fired = [r for r in rows if r["event"] == "rule_fired"]
+    assert fired and fired[0]["rule"] == "latency_shift"
+    assert fired[0]["z"] >= 6.0
+
+
+def test_controller_autoscales_batcher_with_damping(stub, tmp_path):
+    stub.queued = 60                          # 60/64 ~ 0.94 saturation
+    policy = ControlPolicy(
+        tick_s=0.01, rules=(),
+        autoscale=AutoscalePolicy(min_run=2, cooldown_s=60.0,
+                                  max_batch_ceiling=64))
+    ctl = _controller(policy, [ReplicaTarget(stub.url)], tmp_path,
+                      probe_rows=[{"features": {}, "entities": {}}])
+    ctl.tick()
+    ctl.tick()
+    tunes = stub.admin_calls("/admin/tune")
+    assert tunes == [{"max_batch": 32, "max_queue": 128}]
+    ctl.tick()                                # cooldown: no second tune
+    assert len(stub.admin_calls("/admin/tune")) == 1
+
+
+def test_controller_unreachable_replica_journaled_not_fatal(tmp_path):
+    policy = _policy(tick_s=0.01)
+    ctl = _controller(policy, [ReplicaTarget("http://127.0.0.1:1")],
+                      tmp_path)
+    out = ctl.tick()
+    assert out["decisions"] == 0
+    rows = ctl.ledger.rows()
+    assert rows and rows[0]["event"] == "observation"
+    assert "error" in rows[0]
+
+
+def test_controller_rejects_two_canaries(tmp_path):
+    with pytest.raises(ValueError):
+        _controller(_policy(), [ReplicaTarget("http://a", canary=True),
+                                ReplicaTarget("http://b", canary=True)],
+                    tmp_path)
+    with pytest.raises(ValueError):
+        # Canary mode without the log plumbing is a config error, loudly.
+        _controller(_policy(), [ReplicaTarget("http://a", canary=True)],
+                    tmp_path)
+
+
+# ------------------------------------------------------ canary protocol
+
+
+def _canary_setup(tmp_path, policy=None):
+    ref = _StubControlReplica("ref", score=1.0)
+    can = _StubControlReplica("can", score=1.0)
+    main_log = str(tmp_path / "delta-log.jsonl")
+    canary_log = str(tmp_path / "delta-log.canary.jsonl")
+    policy = policy or ControlPolicy(
+        tick_s=0.01, rules=(), autoscale=None,
+        canary=CanaryPolicy(soak_ticks=2, drift_threshold=0.25,
+                            settle_ticks=2))
+    ctl = _controller(
+        policy,
+        [ReplicaTarget(ref.url), ReplicaTarget(can.url, canary=True)],
+        tmp_path,
+        main_log_path=main_log, canary_log_path=canary_log,
+        base_model_dir="/models/base",
+        probe_rows=[{"features": {}, "entities": {}}])
+    return ref, can, main_log, canary_log, ctl
+
+
+def test_canary_wave_promoted_after_clean_soak(tmp_path):
+    ref, can, main_log, canary_log, ctl = _canary_setup(tmp_path)
+    try:
+        # Controller owns the main log: base marker at seq 0 already.
+        assert log_next_seq(main_log) == 1
+        ctl.tick()                            # idle: no wave yet
+        assert ctl._canary.phase == "idle"
+        with DeltaLogWriter(canary_log) as w:
+            w.append(_delta(0, val=0.5), trace_id="tw-0")
+            w.append(_delta(1, val=0.7))
+        can.watermark = 2                     # canary applied the wave
+        ctl.tick()                            # soak begins
+        ctl.tick()                            # settle check -> soaking+probe
+        ctl.tick()                            # probe 2 of 2 -> promote
+        rows = ctl.ledger.rows()
+        events = [r["event"] for r in rows]
+        assert "canary_soak_begin" in events
+        assert "canary_promote" in events
+        assert "canary_rollback" not in events
+        promote = next(r for r in rows if r["event"] == "canary_promote")
+        assert promote["main_seqs"] == [1, 2]  # fresh MAINLINE seqs
+        probes = [r for r in rows if r["event"] == "canary_probe"]
+        assert len(probes) == 2
+        assert all(p["drift"] == 0.0 for p in probes)
+        recs = [r for r in iter_log(main_log)]
+        assert recs[0].is_snapshot
+        assert [r.seq for r in recs] == [0, 1, 2]
+        # The wave window is consumed: nothing re-adjudicates.
+        ctl.tick()
+        assert ctl._canary.phase == "idle"
+        assert log_next_seq(main_log) == 3
+    finally:
+        ref.close()
+        can.close()
+
+
+def test_canary_poisoned_wave_rolled_back_and_resynced(tmp_path):
+    ref, can, main_log, canary_log, ctl = _canary_setup(tmp_path)
+    try:
+        # First, promote a good wave so the mainline has real deltas the
+        # rollback's resync must restore.
+        with DeltaLogWriter(canary_log) as w:
+            w.append(_delta(0, val=0.5))
+        can.watermark = 1
+        ctl.tick()
+        ctl.tick()
+        ctl.tick()
+        assert log_next_seq(main_log) == 2    # base marker + promoted delta
+        # Poisoned wave: the canary's scores drift far from the reference.
+        with DeltaLogWriter(canary_log) as w:
+            w.append(_delta(0, val=99.0))
+        can.watermark = 2
+        can.score = 9.0                       # drift 8.0 >> 0.25
+        ctl.tick()                            # soak begins
+        ctl.tick()                            # settle -> probe -> breach
+        rows = ctl.ledger.rows()
+        rb = [r for r in rows if r["event"] == "canary_rollback"]
+        assert len(rb) == 1 and rb[0]["reason"] == "score_drift"
+        # Rollback: pointer move to base + resync of the ONE mainline delta.
+        assert can.admin_calls("/admin/standby") == [
+            {"model_dir": "/models/base"}]
+        assert len(can.admin_calls("/admin/swap")) == 1
+        resync = next(r for r in rows if r["event"] == "canary_resync")
+        assert resync["ok"] is True and resync["deltas"] == 1
+        assert len(can.patches) == 1
+        # The resynced delta is the GOOD promoted one, not the poison.
+        vals = can.patches[0]["patches"]["perUser"]["user1"]["vals"]
+        assert vals == pytest.approx([0.5])
+        # THE acceptance property: the poisoned wave never reached the main
+        # log, so no non-canary replica can ever see it.
+        assert log_next_seq(main_log) == 2
+        assert ref.patches == []
+        assert ref.admin_calls("/admin/swap") == []
+    finally:
+        ref.close()
+        can.close()
+
+
+def test_canary_unreachable_through_settle_rolls_back(tmp_path):
+    ref, can, main_log, canary_log, ctl = _canary_setup(tmp_path)
+    can.close()                               # canary down before the wave
+    try:
+        with DeltaLogWriter(canary_log) as w:
+            w.append(_delta(0, val=0.5))
+        ctl.tick()                            # soak begins
+        ctl.tick()                            # settle 1 (no signals)
+        ctl.tick()                            # settle 2 -> verdict
+        rows = ctl.ledger.rows()
+        rb = [r for r in rows if r["event"] == "canary_rollback"]
+        assert len(rb) == 1
+        assert rb[0]["reason"] == "canary_unreachable"
+        assert log_next_seq(main_log) == 1    # nothing promoted
+    finally:
+        ref.close()
+
+
+def test_canary_stalled_wave_rolls_back(tmp_path):
+    """A REACHABLE canary whose watermark never reaches the wave (tailer
+    stuck or refusing the delta) must not gate the fleet forever: the
+    settle window expires into a rollback, not an infinite wait."""
+    ref, can, main_log, canary_log, ctl = _canary_setup(tmp_path)
+    try:
+        with DeltaLogWriter(canary_log) as w:
+            w.append(_delta(0, val=0.5))
+            w.append(_delta(1, val=0.7))
+        # can.watermark stays 0: the canary answers /healthz but its
+        # watermark never reaches the wave's last seq (1).
+        ctl.tick()                            # soak begins
+        ctl.tick()                            # settle 1 (stuck at 0)
+        ctl.tick()                            # settle 2 -> verdict
+        rows = ctl.ledger.rows()
+        rb = [r for r in rows if r["event"] == "canary_rollback"]
+        assert len(rb) == 1
+        assert rb[0]["reason"] == "canary_stalled"
+        assert log_next_seq(main_log) == 1    # nothing promoted
+        # The rollback still repoints the canary at the base model.
+        assert len(can.admin_calls("/admin/swap")) == 1
+        assert ctl._canary.phase == "idle"
+    finally:
+        ref.close()
+        can.close()
+
+
+def test_promote_wave_skips_snapshots_and_assigns_fresh_seqs(tmp_path):
+    canary_log = str(tmp_path / "c.jsonl")
+    with DeltaLogWriter(canary_log) as w:
+        w.append_snapshot("/models/base", note="base")
+        w.append(_delta(0, val=0.1))
+        w.append(_delta(1, val=0.2))
+    main_log = str(tmp_path / "m.jsonl")
+    with DeltaLogWriter(main_log) as w:
+        w.append_snapshot("/models/base", note="base")
+        recs = [r for r in iter_log(canary_log)]
+        assert promote_wave(w, recs) == [1, 2]
+    assert [r.seq for r in iter_log(main_log)] == [0, 1, 2]
+
+
+# -------------------------------------------------------------- driver
+
+
+def test_control_driver_is_jax_free_and_validates(tmp_path, monkeypatch):
+    """The eighth driver must keep deciding while replicas recompile —
+    importing it (and ticking it) must never pull jax."""
+    import builtins
+    import sys
+
+    real_import = builtins.__import__
+
+    def guard(name, *a, **kw):
+        assert name != "jax", "control driver pulled jax"
+        return real_import(name, *a, **kw)
+
+    monkeypatch.setattr(builtins, "__import__", guard)
+    for mod in [m for m in sys.modules if m == "jax"]:
+        pass  # already-imported jax elsewhere is fine; new imports are not
+    from photon_tpu.cli import control_driver
+
+    with pytest.raises(SystemExit):
+        control_driver.run(["--output-dir", str(tmp_path)])
+    with pytest.raises(SystemExit):
+        control_driver.run([
+            "--canary", "http://127.0.0.1:1",
+            "--output-dir", str(tmp_path)])   # canary without log plumbing
+
+
+def test_control_driver_runs_ticks_and_writes_ledger(stub, tmp_path):
+    from photon_tpu.cli import control_driver
+
+    out = tmp_path / "ctl"
+    policy = ControlPolicy(tick_s=0.01, rules=(), autoscale=None)
+    ppath = tmp_path / "policy.json"
+    ppath.write_text(policy.to_json())
+    summary = control_driver.run([
+        "--replica", stub.url,
+        "--policy", str(ppath),
+        "--max-ticks", "3",
+        "--output-dir", str(out),
+    ])
+    assert summary["ticks"] == 3
+    assert summary["policy_digest"] == policy.digest()
+    rows = list(read_ledger(str(out / LEDGER_FILENAME)))
+    events = [r["event"] for r in rows]
+    assert events[0] == "controller_started"
+    assert events[-1] == "controller_stopped"
+    assert rows[0]["policy_digest"] == policy.digest()
+    assert (out / "control-summary.json").exists()

@@ -436,6 +436,48 @@ def test_detector_lone_spike_suppressed_by_min_run():
     assert detect_level_shifts(vals, min_run=1)  # knob still exposes it
 
 
+def test_detector_shift_at_trailing_window_boundary():
+    """ISSUE 17 satellite: a shift flags while pre-shift history remains
+    inside the trailing window, then RE-BASELINES once the window fills
+    with post-shift samples — the new level becomes normal, exactly the
+    streaming behavior the control loop's level-shift rule relies on
+    (fire at the edge, go quiet after)."""
+    window = 8
+    clean = [10.0 + 0.1 * (i % 4) for i in range(16)]
+    shifted = clean + [80.0 + 0.1 * (i % 3) for i in range(16)]
+    flags = detect_level_shifts(shifted, window=window, min_history=4,
+                                min_run=2)
+    idx = [f["index"] for f in flags]
+    # Flags begin at the shift point...
+    assert idx[0] == 16
+    # ...and run exactly until the trailing window's MEDIAN crosses over:
+    # once half the window (window/2 points) holds post-shift samples the
+    # median jumps to the new level and the detector re-baselines — quiet
+    # well before the window fully saturates.
+    assert idx == list(range(16, 16 + window // 2))
+    assert all(f["z"] >= 6.0 for f in flags)
+
+
+def test_detector_window_shorter_than_min_run_rebaselines_first():
+    """ISSUE 17 satellite: with window < min_run the baseline re-anchors
+    onto the shift BEFORE a qualifying run can complete — the second
+    shifted point scores against the first one, so a 2-consecutive rule
+    can never latch. Streaming configs must keep window >= min_run; the
+    knob combination degrades to quiet, not to a crash or a false fire."""
+    series = [10.0] * 12 + [80.0] * 6
+    assert detect_level_shifts(series, window=1, min_history=1,
+                               min_run=2) == []
+    # min_run=1 on the same series still exposes the single live edge —
+    # the quietness above is the run rule interacting with the window,
+    # not the detector missing the shift.
+    one = detect_level_shifts(series, window=1, min_history=1, min_run=1)
+    assert [f["index"] for f in one] == [12]
+    # And a window that does cover the run latches normally: same series,
+    # window=4 flags the first post-shift points.
+    four = detect_level_shifts(series, window=4, min_history=1, min_run=2)
+    assert [f["index"] for f in four][:2] == [12, 13]
+
+
 def test_anomaly_scan_over_jsonl(tmp_path):
     path = tmp_path / "metrics.serving.1.jsonl"
     rows = [{"latency": {"p50_ms": 20.0 + 0.1 * (i % 3), "p99_ms": 40.0},

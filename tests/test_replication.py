@@ -8,6 +8,7 @@ the HTTP publisher's bounded retry; and the routing front door's
 staleness weighting, degraded-drain, connect-failure retry, and
 trace-id forwarding — all on stub replicas, no accelerator needed.
 """
+import http.client
 import json
 import threading
 import time
@@ -409,6 +410,91 @@ def test_tailer_refused_delta_never_advances(trained, tmp_path):
     assert ReplicaCursor(str(tmp_path), "rE").load() == 0
 
 
+def test_tailer_restart_recovers_transient_death(trained, tmp_path):
+    """ISSUE 17 satellite lever: a follow loop killed by a transient
+    error (I/O hiccup) restarts on request — journaled, error cleared,
+    and the revived thread converges on the backlog."""
+    _, (m1, _) = trained
+    log_path = str(tmp_path / "delta-log.jsonl")
+    journal = RecoveryJournal(str(tmp_path / "recovery.jsonl"))
+    with DeltaLogWriter(log_path) as w:
+        w.append(_delta(1, val=0.1))
+    tailer = ReplicaTailer(_registry(m1), log_path, replica_id="rR",
+                           cursor_dir=str(tmp_path), journal=journal,
+                           poll_s=0.01)
+    orig_consume = tailer._consume
+    died = {"n": 0}
+
+    def flaky(follow):
+        if follow and died["n"] == 0:
+            died["n"] += 1
+            raise OSError("simulated disk hiccup")
+        return orig_consume(follow)
+
+    tailer._consume = flaky
+    try:
+        tailer.start()
+        tailer._thread.join(timeout=5)
+        snap = tailer.snapshot()
+        assert snap["running"] is False
+        assert "disk hiccup" in snap["error"]
+        out = tailer.restart()
+        assert out["restarted"] is True
+        assert out["snapshot"]["error"] is None   # transient: cleared
+        deadline = time.monotonic() + 5
+        while (tailer.snapshot()["applied_total"] < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        snap = tailer.snapshot()
+        assert snap["applied_total"] == 1 and snap["lag"] == 0
+        # A second restart against the LIVE thread is an idempotent no-op.
+        again = tailer.restart()
+        assert again["restarted"] is False and "refused" not in again
+        rows = _journal_rows(journal.path)
+        events = [r["event"] for r in rows]
+        assert events.count("replica_tailer_died") == 1
+        assert events.count("replica_tailer_restarted") == 1
+        restarted = next(r for r in rows
+                         if r["event"] == "replica_tailer_restarted")
+        assert "disk hiccup" in restarted["prior_error"]
+    finally:
+        tailer.stop()
+
+
+def test_tailer_restart_refuses_poisoned_log(trained, tmp_path):
+    """A validation-refused delta poisons the log itself: restarting
+    would refuse again at the same seq, so the lever declines and the
+    replica stays drained for an operator."""
+    _, (m1, _) = trained
+    log_path = str(tmp_path / "delta-log.jsonl")
+    journal = RecoveryJournal(str(tmp_path / "recovery.jsonl"))
+    poisoned = ModelDelta(
+        seq=1,
+        patches={"noSuchCoordinate": {"x": EntityPatch(
+            key="x", cols=np.array([0], np.int32),
+            vals=np.array([1.0], np.float32))}},
+    )
+    with DeltaLogWriter(log_path) as w:
+        w.append(poisoned)
+    tailer = ReplicaTailer(_registry(m1), log_path, replica_id="rP",
+                           cursor_dir=str(tmp_path), journal=journal,
+                           poll_s=0.01)
+    tailer.start()
+    try:
+        deadline = time.monotonic() + 5
+        while (tailer.snapshot()["running"]
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert tailer.snapshot()["running"] is False
+        out = tailer.restart()
+        assert out["restarted"] is False and out["refused"] is True
+        assert out["snapshot"]["error"] is not None   # NOT cleared
+        events = [r["event"] for r in _journal_rows(journal.path)]
+        assert "replica_tailer_restarted" not in events
+    finally:
+        tailer.stop()
+
+
 # -------------------------------------------------- publisher retries
 
 
@@ -458,6 +544,43 @@ def test_http_publisher_retries_through_shed():
         assert out == {"applied": 1, "seq": 1}
         assert _FlakyPatchHandler.state["posts"] == 3    # 2 sheds + 1 ok
         assert _retry_count() - before == 2
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_http_publisher_carries_one_idempotency_key_across_retries():
+    """ISSUE 17 satellite: at-least-once on the wire, exactly-once at the
+    server — every attempt of one publish carries the SAME content-
+    addressed X-Photon-Idempotency-Key, and a different delta gets a
+    different key even at the same trainer seq."""
+
+    class _Record(_FlakyPatchHandler):
+        state = {"sheds": 0, "posts": 0}
+        keys = []
+
+        def do_POST(self):
+            self.keys.append(self.headers.get("X-Photon-Idempotency-Key"))
+            super().do_POST()
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Record)
+    _Record.state.update(sheds=2, posts=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    host, port = httpd.server_address[:2]
+    try:
+        pub = HttpPublisher(f"http://{host}:{port}", retries=3,
+                            backoff_s=0.01, max_backoff_s=0.02, seed=7)
+        d1 = _delta(1, val=0.1)
+        pub.publish(d1)
+        assert len(_Record.keys) == 3                 # 2 sheds + 1 ok
+        assert len(set(_Record.keys)) == 1            # one key, all attempts
+        assert _Record.keys[0] == d1.idempotency_key()
+        assert _Record.keys[0].startswith("1:")
+        # Same seq, different payload (a restarted trainer incarnation):
+        # the key differs, so the server will apply rather than dedupe.
+        pub.publish(_delta(1, val=0.9))
+        assert _Record.keys[-1] != _Record.keys[0]
+        assert _Record.keys[-1].startswith("1:")
     finally:
         httpd.shutdown()
         httpd.server_close()
@@ -731,6 +854,80 @@ def test_router_all_dead_is_503():
         assert status == 503 and health["status"] == "unhealthy"
     finally:
         router.shutdown()
+
+
+def test_router_retry_after_derived_from_probe_interval():
+    """ISSUE 17 satellite: exhaustion's Retry-After names the healthiest
+    replica's NEXT health probe (last_check_ts + interval - now) instead
+    of a fixed constant — a client told "1" against a 30s sweep would
+    hammer a pool that cannot possibly have changed its mind yet."""
+    a = _StubReplica("a", status="unhealthy")   # answers, fully drained
+    router = _router([a], health_interval_s=30)
+    host, port = router.address
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("POST", "/score", body=b"{}",
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        retry_after = resp.getheader("Retry-After")
+        conn.close()
+        assert resp.status == 503
+        # The sweep just ran (in _router): the hint is ~the full interval.
+        assert retry_after is not None
+        assert 25 <= int(retry_after) <= 30
+    finally:
+        router.shutdown()
+        a.close()
+
+
+def test_router_retry_after_prefers_least_failing_replica():
+    """With one dead and one merely unhealthy-but-answering replica, the
+    hint tracks the answering one (fewest consecutive failures) — the
+    replica most likely to be routable after its next probe."""
+    dead = _StubReplica("dead")
+    dead_url = dead.url
+    dead.close()
+    soft = _StubReplica("soft", status="unhealthy")
+    router = _router([dead_url, soft], health_interval_s=20)
+    host, port = router.address
+    try:
+        router.check_replicas()               # dead accrues failures
+        router.check_replicas()
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("POST", "/score", body=b"{}",
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read()
+        retry_after = int(resp.getheader("Retry-After"))
+        conn.close()
+        assert resp.status == 503
+        assert 1 <= retry_after <= 20
+    finally:
+        router.shutdown()
+        soft.close()
+
+
+def test_router_drained_replicas_gauge_labels_posture():
+    """ISSUE 17 satellite: router_drained_replicas exposes per-replica
+    drain posture (1 = out of rotation) so the fleet report and the
+    controller can SEE a drain instead of inferring it from traffic."""
+    ok = _StubReplica("ok", watermark=3)
+    bad = _StubReplica("bad", degraded=["replication_tailer_dead"])
+    router = _router([ok, bad])
+    try:
+        g = router.metrics.gauge("router_drained_replicas")
+        assert g.value(replica=ok.url) == 0.0
+        assert g.value(replica=bad.url) == 1.0
+        bad.degraded = []                     # replica recovers
+        router.check_replicas()
+        assert g.value(replica=bad.url) == 0.0
+        ok.close()                            # and another one dies
+        router.check_replicas()
+        assert g.value(replica=ok.url) == 1.0
+    finally:
+        router.shutdown()
+        bad.close()
 
 
 def test_router_relays_client_errors_without_retry():

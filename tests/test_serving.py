@@ -1031,6 +1031,133 @@ def test_admin_patch_applies_delta_and_reports_freshness(trained):
         server.shutdown()
 
 
+def _post_with_headers(host, port, path, payload, headers):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("POST", path, body=json.dumps(payload).encode(),
+                 headers={"Content-Type": "application/json", **headers})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    conn.close()
+    return resp.status, body
+
+
+def test_admin_patch_idempotency_key_dedupes_retries(trained):
+    """ISSUE 17 satellite: the HTTP publisher is at-least-once — a retry
+    that raced a success must NOT double-apply. A repeated
+    X-Photon-Idempotency-Key replays the cached result (flagged
+    ``duplicate``) without touching the store; a DIFFERENT key with the
+    same trainer seq still applies (restarted trainer incarnations reuse
+    low seqs for genuinely new deltas)."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    rec = read_records(str(d / "val.avro"))[0]
+    key = rec["metadataMap"]["userId"]
+    store = registry.current.scorer._caches["perUser"].store
+    cols, vals = store.lookup(key)
+    wire = {
+        "seq": 0, "event_horizon": 7,
+        "patches": {"perUser": {str(key): {
+            "cols": [int(c) for c in cols],
+            "vals": [float(v) * 2.0 for v in vals],
+        }}},
+    }
+    try:
+        status, first = _post_with_headers(
+            host, port, "/admin/patch", wire,
+            {"X-Photon-Idempotency-Key": "0:deadbeef"})
+        assert status == 200 and first["patch_seq"] == 1
+        assert "duplicate" not in first
+        # The retry: same key, same payload — replayed, not re-applied.
+        status, again = _post_with_headers(
+            host, port, "/admin/patch", wire,
+            {"X-Photon-Idempotency-Key": "0:deadbeef"})
+        assert status == 200 and again["duplicate"] is True
+        assert again["patch_seq"] == 1
+        status, health = _get(host, port, "/healthz")
+        assert health["freshness"]["patch_seq"] == 1        # once
+        status, m = _get(host, port, "/metrics")
+        assert m["patch_duplicates"] == 1
+        assert m["patches"] == 1
+        # Same trainer seq, different content digest: a NEW delta from a
+        # restarted incarnation — must apply, not be swallowed.
+        status, other = _post_with_headers(
+            host, port, "/admin/patch", wire,
+            {"X-Photon-Idempotency-Key": "0:0123456789abcdef"})
+        assert status == 200 and "duplicate" not in other
+        assert other["patch_seq"] == 2
+        # No key at all keeps the legacy at-least-once behavior (the
+        # canary resync path re-applies mainline deltas on purpose).
+        status, nokey = _post(host, port, "/admin/patch", wire)
+        assert status == 200 and nokey["patch_seq"] == 3
+    finally:
+        server.shutdown()
+
+
+def test_admin_tune_reconfigures_batcher_live(trained):
+    """ISSUE 17 satellite: the autoscaler lever — POST /admin/tune
+    resizes the live micro-batcher (and its queue bound) without a
+    restart; bad input is a 400 and changes nothing."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    rec = read_records(str(d / "val.avro"))[0]
+    try:
+        status, cfg = _post(host, port, "/admin/tune",
+                            {"max_batch": 16, "max_queue": 64})
+        assert status == 200
+        assert cfg["max_batch"] == 16 and cfg["max_queue"] == 64
+        assert batcher.max_batch == 16 and batcher.max_queue == 64
+        # Scoring still works through the resized batcher.
+        status, out = _post(host, port, "/score", _payload(rec))
+        assert status == 200 and "score" in out
+        status, m = _get(host, port, "/metrics")
+        assert m["batcher"]["max_batch"] == 16
+        assert m["tunes"] == 1
+        for bad in ({}, {"max_batch": 0}, {"max_queue": -1}):
+            status, body = _post(host, port, "/admin/tune", bad)
+            assert status == 400, body
+        assert batcher.max_batch == 16 and batcher.max_queue == 64
+    finally:
+        server.shutdown()
+
+
+def test_admin_memory_shed_frees_pinned_cache(trained):
+    """ISSUE 17 satellite lever: POST /admin/memory/shed runs the memory
+    guard's pinned-cache sweep proactively (the controller fires it on a
+    watermark ramp, BEFORE the OOM ladder would)."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    rec = read_records(str(d / "val.avro"))[0]
+    try:
+        # Warm the device cache so there is something sheddable.
+        status, _ = _post(host, port, "/score", _payload(rec))
+        assert status == 200
+        status, out = _post(host, port, "/admin/memory/shed", {})
+        assert status == 200
+        assert out["freed_bytes"] >= 0
+        status, m = _get(host, port, "/metrics")
+        assert m["memory_sheds"] == 1
+        # Scoring survives the shed (cold caches refill, scores unchanged).
+        status, after = _post(host, port, "/score", _payload(rec))
+        assert status == 200 and "score" in after
+    finally:
+        server.shutdown()
+
+
 def test_registry_apply_delta_rejects_overwide_patch(trained):
     """A patch wider than the device-cache row width must refuse the WHOLE
     delta (atomicity) with actionable guidance, applying nothing."""
