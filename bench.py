@@ -969,6 +969,136 @@ def bench_serve():
             mine.sort()
             ovh[mode] = mine
 
+        # Zipf closed-loop leg (docs/serving.md §"Latency waterfall"):
+        # entity traffic at tunable skew s against a server whose device
+        # hot set is DELIBERATELY smaller than the entity population —
+        # the headline server caches every user, which would pin the
+        # hit-rate-vs-skew curve at 1.0 and say nothing. Per skew this
+        # reports saturation throughput, request p50/p95/p99, the
+        # hot-set hit rate, and per-stage p50/p95/p99 read from the
+        # serve_stage_latency_seconds waterfall children as BEFORE/AFTER
+        # bin deltas (the histogram is cumulative; a leg's quantiles
+        # must not inherit the previous leg's samples).
+        from photon_tpu.estimators.game_transformer import (
+            SCORE_KERNEL_NAME,
+        )
+        from photon_tpu.obs import retrace
+        from photon_tpu.utils.logging import LatencyHistogram
+
+        zipf_skews = (0.0, 0.8, 1.2)
+        n_zipf = 160 if SMOKE else 1024
+        zipf_cfg = ServingConfig(
+            max_batch=32, max_wait_ms=1.0,
+            cache_entities=max(8, n_users // 4),
+            max_row_nnz=32)
+        zipf_registry = ModelRegistry(mdir, zipf_cfg)
+        zipf_batcher = MicroBatcher(max_batch=zipf_cfg.max_batch,
+                                    max_wait_ms=zipf_cfg.max_wait_ms)
+        zipf_server = ScoringServer(zipf_registry, zipf_batcher, port=0)
+        zipf_server.start()
+        zhost, zport = zipf_server.address
+        # Rows grouped by entity so a sampled RANK maps to one user's
+        # payloads; rank order is the stable user order, which is all a
+        # synthetic popularity law needs.
+        by_user: dict = {}
+        for r in range(len(payloads)):
+            by_user.setdefault(str(users[r]), []).append(r)
+        zipf_users = sorted(by_user)
+        rng = np.random.default_rng(11)
+        stage_hist = zipf_server.metrics.histogram(
+            "serve_stage_latency_seconds")
+        stage_names = ("admission", "queue_wait", "batch_assembly",
+                       "store_resolve", "kernel", "response")
+
+        def _hist_delta(after: dict, before: dict) -> dict:
+            d = dict(after)
+            d["counts"] = [a - b for a, b in
+                           zip(after["counts"], before["counts"])]
+            d["sum"] = after["sum"] - before["sum"]
+            d["n"] = after["n"] - before["n"]
+            return d
+
+        conn = http.client.HTTPConnection(zhost, zport, timeout=30)
+        for i in range(8):
+            fire(conn, payloads[i % len(payloads)])
+        conn.close()
+        zipf_retraces0 = retrace.retraces_after_warmup(SCORE_KERNEL_NAME)
+        zipf_metrics: dict = {}
+        for s in zipf_skews:
+            w = 1.0 / np.power(np.arange(1, len(zipf_users) + 1), s)
+            ranks = rng.choice(len(zipf_users), size=n_zipf,
+                               p=w / w.sum())
+            reqs = [
+                payloads[by_user[zipf_users[k]][
+                    int(rng.integers(len(by_user[zipf_users[k]])))]]
+                for k in ranks
+            ]
+            cache0 = zipf_server.metrics_snapshot()[
+                "coefficient_caches"].get("perUser", {})
+            stage0 = {st: stage_hist.child(stage=st).state()
+                      for st in stage_names}
+            zlat: list = []
+            zerrors: list = []
+
+            def zworker(wid: int) -> None:
+                try:
+                    c = http.client.HTTPConnection(zhost, zport,
+                                                   timeout=30)
+                    mine = [fire(c, reqs[i])
+                            for i in range(wid, n_zipf, conc)]
+                    c.close()
+                    with lat_lock:
+                        zlat.extend(mine)
+                except Exception as e:  # noqa: BLE001 - re-raised below
+                    zerrors.append(e)
+
+            with suspend_tracing():
+                zt0 = time.perf_counter()
+                zthreads = [threading.Thread(target=zworker, args=(w,))
+                            for w in range(conc)]
+                for t in zthreads:
+                    t.start()
+                for t in zthreads:
+                    t.join()
+                zwall = time.perf_counter() - zt0
+            if zerrors:
+                raise RuntimeError(
+                    f"zipf leg s={s}: {len(zerrors)} worker(s) failed: "
+                    f"{zerrors[0]!r}")
+            cache1 = zipf_server.metrics_snapshot()[
+                "coefficient_caches"].get("perUser", {})
+            dh = cache1.get("hits", 0) - cache0.get("hits", 0)
+            dm = cache1.get("misses", 0) - cache0.get("misses", 0)
+            zlat.sort()
+            tag = f"{{s={s}}}"
+            zipf_metrics[f"serve_zipf_rows_per_sec{tag}"] = round(
+                len(zlat) / zwall, 1)
+            for p, lbl in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                zipf_metrics[f"serve_zipf_{lbl}_ms{tag}"] = round(
+                    zlat[min(len(zlat) - 1, int(p * len(zlat)))] * 1e3, 2)
+            zipf_metrics[f"serve_zipf_hot_set_hit_rate{tag}"] = round(
+                dh / max(1, dh + dm), 4)
+            stage_ms = {}
+            for st in stage_names:
+                delta = _hist_delta(
+                    stage_hist.child(stage=st).state(), stage0[st])
+                if delta["n"] <= 0:
+                    continue
+                h = LatencyHistogram.from_state(delta)
+                stage_ms[st] = {
+                    "p50": round(h.quantile_ms(0.50), 3),
+                    "p95": round(h.quantile_ms(0.95), 3),
+                    "p99": round(h.quantile_ms(0.99), 3),
+                }
+            zipf_metrics[f"serve_zipf_stage_ms{tag}"] = stage_ms
+        zipf_metrics["serve_zipf_retraces_after_warmup"] = int(
+            retrace.retraces_after_warmup(SCORE_KERNEL_NAME)
+            - zipf_retraces0)
+        zipf_metrics["serve_zipf_hot_set_entities"] = max(
+            zipf_cfg.cache_entities, zipf_cfg.max_batch)
+        zipf_metrics["serve_zipf_entities"] = len(zipf_users)
+        zipf_server.shutdown()
+
         # Degraded-mode phase (docs/robustness.md): inject a coefficient-
         # store outage, let the circuit breaker open, and measure the
         # fixed-effect-only path — every request must still answer 200,
@@ -1078,6 +1208,10 @@ def bench_serve():
         "serve_fleet_merged_trace_spans": int(mt.get("spans") or 0),
         "serve_fleet_anomalies": int(
             (fleet_report.get("anomalies") or {}).get("n_anomalies", 0)),
+        # Zipf closed-loop leg: skewed entity traffic over a small device
+        # hot set — throughput, request and per-stage percentiles, and
+        # the hit-rate-vs-skew curve.
+        **zipf_metrics,
         **slo_metrics,
     }
 

@@ -108,6 +108,18 @@ echo "== fleet smoke (3-process telemetry aggregation + run report; docs/observa
 # shift in the serving metrics JSONL.
 python scripts/fleet_smoke.py
 
+echo "== obs-live smoke (streaming fleet view while the fleet is still up; docs/observability.md §Live fleet view) =="
+# The live edge of fleet observability: the jax-free obs driver tails the
+# run root BESIDE a running fleet (training shards on disk, serving driver
+# still alive and re-exporting its registry shard on the flush cadence).
+# GET /fleet must carry both roles and a tailed metrics history WHILE the
+# serving process is verifiably running, and the streaming median/MAD
+# detector must flag an injected latency level shift BEFORE any process
+# exits — the guarantee the post-hoc report cannot give. Both long-running
+# processes must then stop cleanly on SIGTERM, the observer leaving its
+# own registry shard for the post-hoc report.
+python scripts/obs_live_smoke.py
+
 echo "== replica smoke (delta-log fan-out, router kill window, rejoin-and-converge; docs/serving.md §Replication) =="
 # The replicated serving tier against REAL process boundaries and a REAL
 # kill: one trainer, one online trainer publishing into the durable delta

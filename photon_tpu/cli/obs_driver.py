@@ -1,0 +1,171 @@
+"""Streaming fleet-view driver (docs/observability.md §"Live fleet view").
+
+The ninth driver: where ``python -m photon_tpu.obs.analysis report``
+fuses a run's telemetry AFTER every process has exited, this one tails
+the same ``--telemetry-dir`` while the fleet is still running — merging
+registry shards incrementally, folding metrics JSONL histories through
+the run report's median/MAD level-shift detector at the live edge, and
+serving the continuously refreshed fleet state over HTTP:
+
+    python -m photon_tpu.cli.obs_driver \\
+        --telemetry-dir /tmp/fleet --port 8090 --interval 2
+
+    curl -s localhost:8090/fleet              # JSON fleet state
+    curl -s localhost:8090/fleet?format=md    # rendered run report
+
+Deliberately accelerator-free, same contract as the router and control
+drivers: the observer must keep answering while every serving process
+behind it is recompiling, recovering, or dead — that is exactly when the
+fleet view matters most.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional, Sequence
+
+from photon_tpu.utils import PhotonLogger
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="obs-driver",
+        description="Serve a live, continuously refreshed fleet view "
+                    "(merged metrics + streaming anomaly detection) over "
+                    "a shared telemetry directory.",
+    )
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8090,
+                   help="0 binds an ephemeral port (logged at startup)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between telemetry-dir refresh ticks")
+    p.add_argument("--window", type=int, default=None,
+                   help="trailing window for the level-shift detector "
+                        "(default: the run report's, 16)")
+    p.add_argument("--z-threshold", type=float, default=None,
+                   help="robust z-score threshold (default: 6.0)")
+    p.add_argument("--min-history", type=int, default=None,
+                   help="predecessors required before a point scores "
+                        "(default: 8)")
+    p.add_argument("--min-run", type=int, default=None,
+                   help="consecutive over-threshold points that make a "
+                        "level shift (default: 2; lone spikes are noise)")
+    p.add_argument("--metric", action="append", default=None,
+                   metavar="DOTTED", dest="metrics",
+                   help="flattened metric path to watch, repeatable "
+                        "(default: latency.p50_ms/p95_ms/p99_ms)")
+    p.add_argument("--report-top", type=int, default=5,
+                   help="rows per section in the embedded run report")
+    p.add_argument("--output-dir", default=None,
+                   help="photon.log lands here")
+    from photon_tpu.cli.params import add_telemetry_flag, add_trace_flag
+
+    # --telemetry-dir does double duty here: it is the directory this
+    # driver WATCHES, and (per the shared convention) where its own
+    # trace/registry shards land at exit — the observer shows up in the
+    # post-hoc fleet report like any other role.
+    add_telemetry_flag(p)
+    add_trace_flag(p)
+    return p
+
+
+def run(argv: Optional[Sequence[str]] = None,
+        serve_forever: bool = True) -> dict:
+    args = build_arg_parser().parse_args(argv)
+    from photon_tpu.cli.params import finish_trace
+
+    try:
+        return _run(args, serve_forever)
+    finally:
+        finish_trace(args.trace_out)
+
+
+def _run(args, serve_forever: bool) -> dict:
+    from photon_tpu.cli.params import (
+        enable_telemetry,
+        enable_trace,
+        finish_telemetry,
+    )
+    from photon_tpu.obs.live import LiveFleetServer
+
+    if not getattr(args, "telemetry_dir", None):
+        raise SystemExit("obs-driver: --telemetry-dir required "
+                         "(the directory to watch)")
+    telemetry_dir = enable_telemetry(args, role="obs")
+    enable_trace(args.trace_out)
+    plogger = PhotonLogger(args.output_dir)
+    logger = plogger.logger
+    kwargs = {}
+    for flag, key in (("window", "window"), ("z_threshold", "z_threshold"),
+                      ("min_history", "min_history"),
+                      ("min_run", "min_run")):
+        v = getattr(args, flag)
+        if v is not None:
+            kwargs[key] = v
+    server = LiveFleetServer(
+        telemetry_dir,
+        host=args.host,
+        port=args.port,
+        interval_s=args.interval,
+        logger=logger,
+        metrics=args.metrics,
+        report_top=args.report_top,
+        **kwargs,
+    )
+    summary = {
+        "address": list(server.address),
+        "telemetry_dir": server.watcher.run_dir,
+        "interval_s": args.interval,
+        "watch_metrics": list(server.watcher.watch_metrics),
+    }
+    logger.info("live fleet view on http://%s:%d watching %s: %s",
+                *server.address, server.watcher.run_dir,
+                json.dumps(summary))
+    if not serve_forever:
+        # Smoke/integration entry: one synchronous tick so the summary
+        # reflects a real pass over the directory, then tear down.
+        state = server.watcher.tick()
+        server.shutdown()
+        summary["roles"] = state.get("roles", [])
+        summary["n_live_anomalies"] = state.get("n_live_anomalies", 0)
+        finish_telemetry(args)
+        plogger.close()
+        return summary
+
+    def _graceful(signum, frame):
+        raise KeyboardInterrupt
+
+    try:
+        import signal
+
+        # SIGTERM routes through the same graceful stop as Ctrl-C, same
+        # contract as the other drivers. Main-thread only.
+        signal.signal(signal.SIGTERM, _graceful)
+    except ValueError:
+        pass
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        state = server.watcher.state()
+        summary["ticks"] = state.get("ticks", 0)
+        summary["roles"] = state.get("roles", [])
+        summary["n_live_anomalies"] = state.get("n_live_anomalies", 0)
+        # Only this process's own registry: exporting the FOLDED fleet
+        # registry back into the directory it was folded from would
+        # double-count every other role's metrics on the next merge.
+        finish_telemetry(args)
+        plogger.close()
+    return summary
+
+
+def main() -> None:  # pragma: no cover - console entry
+    from photon_tpu.cli.params import console_main
+
+    console_main(run)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -190,6 +190,13 @@ def build_server(args) -> tuple[ScoringServer, PhotonLogger]:
         request_timeout_s=config.request_timeout_s,
         slo_config=args.slo_config,
     )
+    if telemetry_dir:
+        # Live fleet view: the flush loop re-exports this shard on the
+        # metrics cadence (same path finish_telemetry finalizes at exit),
+        # so the obs driver's /fleet aggregates this process while it
+        # still serves.
+        server.telemetry_shard_path = os.path.join(
+            telemetry_dir, f"registry.{role}.{os.getpid()}.json")
     if getattr(args, "delta_log", None):
         from photon_tpu.replication import ReplicaTailer
         from photon_tpu.supervisor import RecoveryJournal

@@ -24,7 +24,10 @@ The CONSUMERS of these artifacts live in ``photon_tpu.obs.analysis``
 (``python -m photon_tpu.obs.analysis``), the backend-aware bench
 regression gate (``scripts/bench_compare.py``), and the declarative SLO
 watchdog (``obs.analysis.slo``) evaluated at serving flushes, supervisor
-heartbeats, and bench end.
+heartbeats, and bench end. ``photon_tpu.obs.live`` (same on-demand rule —
+it imports the analysis layer) is the streaming fleet view behind
+``python -m photon_tpu.cli.obs_driver``: the run-report detector folded
+online over a live telemetry dir, served at ``GET /fleet``.
 """
 from photon_tpu.obs.metrics import (
     Counter,
@@ -36,8 +39,10 @@ from photon_tpu.obs.metrics import (
 )
 from photon_tpu.obs.trace import (
     ANCHOR_EVENT,
+    TailSampler,
     TraceCollector,
     current_trace_id,
+    install_tail_sampler,
     instant,
     new_trace_id,
     process_role,
@@ -45,10 +50,12 @@ from photon_tpu.obs.trace import (
     start_tracing,
     stop_tracing,
     suspend_tracing,
+    tail_sampler,
     trace_context,
     trace_span,
     tracing,
     tracing_active,
+    uninstall_tail_sampler,
 )
 from photon_tpu.obs import retrace
 
@@ -60,8 +67,10 @@ __all__ = [
     "MetricsRegistry",
     "REGISTRY",
     "get_registry",
+    "TailSampler",
     "TraceCollector",
     "current_trace_id",
+    "install_tail_sampler",
     "instant",
     "new_trace_id",
     "process_role",
@@ -70,8 +79,10 @@ __all__ = [
     "start_tracing",
     "stop_tracing",
     "suspend_tracing",
+    "tail_sampler",
     "trace_context",
     "trace_span",
     "tracing",
     "tracing_active",
+    "uninstall_tail_sampler",
 ]

@@ -1,0 +1,486 @@
+"""Streaming fleet view: live telemetry aggregation + ``GET /fleet``.
+
+PR 15's fleet layer is post-hoc by construction — shards merge and the
+run report builds after every process has exited. This module is the
+same aggregation run *at the live edge* (docs/observability.md §"Live
+fleet view"):
+
+* :class:`LiveFleetWatcher` tails a ``--telemetry-dir`` on an interval:
+  registry shards re-merge idempotently (per-``shard_id`` delta fold, so
+  a replica's periodic re-export never double-counts), metrics JSONL
+  histories are tailed incrementally by byte offset (torn tails from a
+  live writer are left for the next tick), and recovery/patch journals +
+  control ledgers are re-read for the fleet story.
+* :class:`StreamingDetector` is the PR 15 median/MAD level-shift
+  detector restated as an online fold: each new point is scored against
+  the trailing window of its predecessors (the point itself excluded),
+  and a run of ``min_run`` consecutive over-threshold points flags —
+  the SAME points the batch ``detect_level_shifts`` would flag, but
+  available while the fleet is still running.
+* :class:`LiveFleetServer` is a jax-free stdlib HTTP front end
+  (``cli/obs_driver.py``): ``GET /fleet`` returns the continuously
+  refreshed JSON state (``?format=md`` renders the run report as
+  markdown), ``GET /healthz`` liveness, ``GET /metrics`` the folded
+  fleet registry.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+from photon_tpu.obs.analysis.report import (
+    DEFAULT_ANOMALY_METRICS,
+    DEFAULT_MIN_HISTORY,
+    DEFAULT_MIN_RUN,
+    DEFAULT_WINDOW,
+    DEFAULT_Z,
+    _MAD_SCALE,
+    _median,
+    build_report,
+    format_markdown,
+)
+from photon_tpu.obs.metrics import MetricsRegistry
+
+__all__ = [
+    "LIVE_SCHEMA",
+    "StreamingDetector",
+    "LiveFleetWatcher",
+    "LiveFleetServer",
+]
+
+LIVE_SCHEMA = "photon-fleet-live/1"
+
+
+class StreamingDetector:
+    """The median/MAD level-shift detector as an online fold.
+
+    Semantics match ``report.detect_level_shifts`` point-for-point: a
+    point's robust z is measured against the trailing ``window``
+    predecessors (itself excluded; fewer than ``min_history``
+    predecessors → no score), over-threshold points accumulate into a
+    run, and the run flags once it reaches ``min_run`` — first the
+    buffered run points (so batch and streaming flag the SAME indices),
+    then every further point while the run continues.
+    """
+
+    def __init__(self, window: int = DEFAULT_WINDOW,
+                 z_threshold: float = DEFAULT_Z,
+                 min_history: int = DEFAULT_MIN_HISTORY,
+                 min_run: int = DEFAULT_MIN_RUN):
+        self.window = int(window)
+        self.z_threshold = float(z_threshold)
+        self.min_history = max(1, int(min_history))
+        self.min_run = max(1, int(min_run))
+        self._hist: deque = deque(maxlen=self.window)
+        self._run: list[dict] = []
+        self.points = 0
+        self.anomalies: list[dict] = []
+
+    def push(self, value: float) -> list[dict]:
+        """Fold one new point; returns the rows flagged BY this point
+        (empty for quiet points), each ``{"index","value","median","z"}``."""
+        x = float(value)
+        idx = self.points
+        self.points += 1
+        z = None
+        med = None
+        if len(self._hist) >= self.min_history:
+            hist = list(self._hist)
+            med = _median(hist)
+            mad = _median([abs(h - med) for h in hist])
+            scale = _MAD_SCALE * mad
+            if scale <= 0:
+                scale = max(abs(med) * 0.05, 1e-9)
+            z = abs(x - med) / scale
+        flagged: list[dict] = []
+        if z is not None and z >= self.z_threshold:
+            self._run.append({
+                "index": idx,
+                "value": round(x, 6),
+                "median": round(med, 6),
+                "z": round(z, 3),
+            })
+            if len(self._run) == self.min_run:
+                flagged = list(self._run)
+            elif len(self._run) > self.min_run:
+                flagged = [self._run[-1]]
+        else:
+            self._run = []
+        self._hist.append(x)
+        if flagged:
+            self.anomalies.extend(flagged)
+        return flagged
+
+
+class _JsonlTail:
+    """Incremental reader of one JSONL file: remembers the byte offset
+    of the last COMPLETE line consumed, so a live writer's torn tail is
+    simply re-read whole on the next tick. A shrunken file (truncate /
+    rewrite) resets the offset — re-reading beats silently skipping."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.offset = 0
+
+    def read_new(self) -> list[dict]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self.offset:
+            self.offset = 0
+        if size == self.offset:
+            return []
+        rows: list[dict] = []
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(self.offset)
+                chunk = f.read(size - self.offset)
+        except OSError:
+            return []
+        # Only complete lines advance the offset; a partial tail waits
+        # for its newline.
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []
+        complete, self.offset = chunk[:end + 1], self.offset + end + 1
+        for line in complete.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue  # torn or corrupt row: skip, loudly counted upstream
+            if isinstance(row, dict):
+                rows.append(row)
+        return rows
+
+
+class LiveFleetWatcher:
+    """Tail one telemetry dir; fold every tick into a live fleet state."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        metrics: Optional[Sequence[str]] = None,
+        window: int = DEFAULT_WINDOW,
+        z_threshold: float = DEFAULT_Z,
+        min_history: int = DEFAULT_MIN_HISTORY,
+        min_run: int = DEFAULT_MIN_RUN,
+        report_top: int = 5,
+    ):
+        self.run_dir = os.path.abspath(run_dir)
+        self.watch_metrics = tuple(metrics or DEFAULT_ANOMALY_METRICS)
+        self.window = int(window)
+        self.z_threshold = float(z_threshold)
+        self.min_history = int(min_history)
+        self.min_run = int(min_run)
+        self.report_top = int(report_top)
+        self._lock = threading.Lock()
+        # Persistent fold target: collect_shards' per-shard_id delta
+        # merge makes re-collection of a re-exported shard idempotent.
+        self.registry = MetricsRegistry()
+        self._tails: dict[str, _JsonlTail] = {}
+        # (file, metric) -> detector, state carried across ticks — the
+        # "streaming at the live edge" part.
+        self._detectors: dict[tuple, StreamingDetector] = {}
+        self._shard_meta: dict[str, dict] = {}
+        self.ticks = 0
+        self.last_tick_at: Optional[float] = None
+        self.last_error: Optional[str] = None
+        self._state: dict = {"schema": LIVE_SCHEMA,
+                             "telemetry_dir": self.run_dir,
+                             "ticks": 0, "roles": [],
+                             "live_anomalies": [],
+                             "n_live_anomalies": 0}
+        self._markdown = "(no tick yet)\n"
+
+    # ---------------------------------------------------------------- tick
+
+    def tick(self) -> dict:
+        """One refresh: discover artifacts, fold new evidence, rebuild
+        the run report. Never raises — the watcher outlives any single
+        bad artifact (the error lands in the payload instead)."""
+        from photon_tpu.obs import fleet
+
+        t0 = time.time()
+        try:
+            state = self._tick_inner(fleet)
+            self.last_error = None
+        except Exception as e:  # noqa: BLE001 - the watcher must outlive a bad tick
+            self.last_error = f"{type(e).__name__}: {e}"
+            with self._lock:
+                state = dict(self._state)
+                state["last_error"] = self.last_error
+                self._state = state
+            return state
+        self.ticks += 1
+        self.last_tick_at = t0
+        state["ticks"] = self.ticks
+        state["last_tick_at"] = t0
+        state["tick_seconds"] = round(time.time() - t0, 4)
+        with self._lock:
+            self._state = state
+        return state
+
+    def _tick_inner(self, fleet) -> dict:
+        files = fleet.discover(self.run_dir)
+
+        # Registry shards: idempotent incremental re-merge into the
+        # persistent registry; shard metadata feeds the live role list.
+        # Per-shard isolation: one torn/corrupt shard (a writer mid-crash)
+        # must not blind the view to every healthy role.
+        shard_warnings: list[str] = []
+        for path in files.registry_shards:
+            try:
+                _, metas = fleet.collect_shards([path],
+                                                registry=self.registry)
+            except fleet.FleetMergeError as e:
+                shard_warnings.append(str(e))
+                continue
+            for m in metas:
+                self._shard_meta[m.get("shard_id") or m.get("path")] = {
+                    "shard_id": m.get("shard_id"),
+                    "role": m.get("role"),
+                    "pid": m.get("pid"),
+                    "anchor": m.get("anchor"),
+                    "path": m.get("path"),
+                }
+
+        # Metrics JSONL: tail new rows into the streaming detectors.
+        from photon_tpu.obs.analysis.artifacts import flatten_metrics
+
+        live_anoms: list[dict] = []
+        new_points = 0
+        for path in files.metrics_jsonl:
+            tail = self._tails.get(path)
+            if tail is None:
+                tail = self._tails[path] = _JsonlTail(path)
+            for row in tail.read_new():
+                flat = flatten_metrics(row)
+                for metric in self.watch_metrics:
+                    v = flat.get(metric)
+                    if v is None:
+                        continue
+                    key = (path, metric)
+                    det = self._detectors.get(key)
+                    if det is None:
+                        det = self._detectors[key] = StreamingDetector(
+                            window=self.window,
+                            z_threshold=self.z_threshold,
+                            min_history=self.min_history,
+                            min_run=self.min_run)
+                    new_points += 1
+                    for row_flagged in det.push(v):
+                        live_anoms.append({
+                            "file": os.path.relpath(path, self.run_dir),
+                            "metric": metric,
+                            **row_flagged,
+                        })
+
+        # Full run report (the PR 15 batch view) rebuilt per tick: traces
+        # and journals are small while a run is live, and the payload
+        # contract says "the run report, continuously refreshed". Best
+        # effort — a single corrupt artifact degrades to the previous
+        # tick's report plus a warning, not a dead /fleet.
+        try:
+            report = build_report(
+                self.run_dir, metrics=self.watch_metrics,
+                window=self.window, z_threshold=self.z_threshold,
+                min_run=self.min_run, top=self.report_top)
+        except Exception as e:  # noqa: BLE001 - keep serving the live view
+            shard_warnings.append(f"report: {type(e).__name__}: {e}")
+            report = self._state.get("report") or {}
+
+        detectors = [{
+            "file": os.path.relpath(path, self.run_dir),
+            "metric": metric,
+            "points": det.points,
+            "anomalies": det.anomalies[-self.report_top:],
+            "n_anomalies": len(det.anomalies),
+        } for (path, metric), det in sorted(self._detectors.items())]
+        n_live = sum(d["n_anomalies"] for d in detectors)
+
+        roles = sorted({m["role"] for m in self._shard_meta.values()
+                        if m.get("role")})
+        state = {
+            "schema": LIVE_SCHEMA,
+            "telemetry_dir": self.run_dir,
+            "roles": roles,
+            "registry_shards": sorted(
+                self._shard_meta.values(),
+                key=lambda m: (m.get("role") or "", m.get("pid") or 0)),
+            "sources": {
+                "registry_shards": len(files.registry_shards),
+                "metrics_jsonl": [os.path.relpath(p, self.run_dir)
+                                  for p in files.metrics_jsonl],
+                "traces": len(files.traces),
+                "journals": len(files.journals),
+                "patch_journals": len(files.patch_journals),
+                "control_ledgers": len(files.control_ledgers),
+            },
+            "detector": {
+                "window": self.window,
+                "z_threshold": self.z_threshold,
+                "min_history": self.min_history,
+                "min_run": self.min_run,
+                "metrics": list(self.watch_metrics),
+                "new_points_this_tick": new_points,
+            },
+            "streams": detectors,
+            "live_anomalies_this_tick": live_anoms,
+            "n_live_anomalies": n_live,
+            "shard_warnings": shard_warnings,
+            "registry": self.registry.snapshot(),
+            "report": report,
+        }
+        md = ["# Live fleet view",
+              "",
+              f"- telemetry dir: `{self.run_dir}`",
+              f"- roles (registry shards): "
+              f"{', '.join(roles) if roles else '(none yet)'}",
+              f"- live anomalies: {n_live}",
+              ""]
+        for d in detectors:
+            if d["n_anomalies"]:
+                md.append(f"- **{d['metric']}** in `{d['file']}`: "
+                          f"{d['n_anomalies']} flagged point(s) over "
+                          f"{d['points']}")
+        md.append("")
+        if report:
+            try:
+                md.append(format_markdown(report, top=self.report_top))
+            except Exception as e:  # noqa: BLE001 - md is a convenience view
+                md.append(f"(report render failed: {e})")
+        self._markdown = "\n".join(md)
+        return state
+
+    # -------------------------------------------------------------- reads
+
+    def state(self) -> dict:
+        with self._lock:
+            return dict(self._state)
+
+    def markdown(self) -> str:
+        with self._lock:
+            return self._markdown
+
+
+class LiveFleetServer:
+    """Jax-free HTTP front end over a :class:`LiveFleetWatcher` (the
+    router/control driver pattern: stdlib ``ThreadingHTTPServer``, a
+    daemon tick thread, ``start``/``serve_forever``/``shutdown``)."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        interval_s: float = 2.0,
+        logger=None,
+        **watcher_kwargs,
+    ):
+        self.logger = logger
+        self.interval_s = float(interval_s)
+        self.watcher = LiveFleetWatcher(run_dir, **watcher_kwargs)
+        self._started_at = time.time()
+        live = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                if live.logger is not None:
+                    live.logger.debug("obs http: " + fmt, *args)
+
+            def _reply(self, code: int, body: bytes,
+                       ctype: str = "application/json") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                if path == "/fleet":
+                    if "md" in query or "markdown" in query:
+                        self._reply(
+                            200, live.watcher.markdown().encode("utf-8"),
+                            ctype="text/markdown; charset=utf-8")
+                    else:
+                        self._reply(200, json.dumps(
+                            live.watcher.state()).encode("utf-8"))
+                elif path == "/healthz":
+                    w = live.watcher
+                    self._reply(200 if w.ticks else 503, json.dumps({
+                        "status": "ok" if w.ticks else "warming",
+                        "ticks": w.ticks,
+                        "last_tick_at": w.last_tick_at,
+                        "last_error": w.last_error,
+                        "interval_s": live.interval_s,
+                        "uptime_s": round(
+                            time.time() - live._started_at, 1),
+                    }).encode("utf-8"))
+                elif path == "/metrics":
+                    if "prom" in query:
+                        self._reply(
+                            200,
+                            live.watcher.registry.to_prometheus().encode(
+                                "utf-8"),
+                            ctype="text/plain; version=0.0.4; "
+                                  "charset=utf-8")
+                    else:
+                        self._reply(200, json.dumps(
+                            live.watcher.registry.snapshot()
+                        ).encode("utf-8"))
+                else:
+                    self._reply(404, json.dumps(
+                        {"error": f"no route {self.path}"}).encode("utf-8"))
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self._loop_started = False
+        self._serve_thread: Optional[threading.Thread] = None
+        self._tick_stop = threading.Event()
+        # First tick happens synchronously on the ticker thread before
+        # the wait, so /healthz goes ready within one tick, not one
+        # interval.
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, name="photon-obs-tick", daemon=True)
+        self._tick_thread.start()
+
+    @property
+    def address(self) -> tuple:
+        return self.httpd.server_address[:2]
+
+    def _tick_loop(self) -> None:
+        self.watcher.tick()
+        while not self._tick_stop.wait(self.interval_s):
+            self.watcher.tick()
+
+    def start(self) -> None:
+        self._loop_started = True
+        self._serve_thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name="photon-obs-http", daemon=True)
+        self._serve_thread.start()
+
+    def serve_forever(self) -> None:
+        self._loop_started = True
+        self.httpd.serve_forever()
+
+    def shutdown(self) -> None:
+        self._tick_stop.set()
+        if self._loop_started:
+            self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(timeout=5.0)
+        self._tick_thread.join(timeout=5.0)

@@ -211,7 +211,14 @@ class Gauge(Counter):
 
 
 class HistogramMetric:
-    """A named ``LatencyHistogram`` exported as a Prometheus summary."""
+    """A named ``LatencyHistogram`` exported as a Prometheus summary.
+
+    Supports the same single flat label level as Counter/Gauge:
+    ``observe(seconds, stage="kernel")`` lands the sample in a per-label
+    child histogram (identical bin layout to the base, so children stay
+    mergeable), and the exposition emits one quantile/sum/count series
+    per child — p95 queue-wait vs p95 kernel is ONE scrape, not a
+    trace-file autopsy (docs/serving.md §"Latency waterfall")."""
 
     kind = "summary"
     QUANTILES = (0.5, 0.95, 0.99)
@@ -221,31 +228,101 @@ class HistogramMetric:
         self.name = name
         self.help = help
         self.histogram = histogram or LatencyHistogram()
+        self._children: dict[tuple, LatencyHistogram] = {}
+        self._lock = threading.Lock()
 
-    def observe(self, seconds: float) -> None:
-        self.histogram.observe(seconds)
+    @staticmethod
+    def _key(labels: Mapping[str, str]) -> tuple:
+        return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+    def _blank_child(self) -> LatencyHistogram:
+        """A zeroed histogram with EXACTLY the base's bin layout, so every
+        child of one metric merges bin-for-bin across shards."""
+        h = self.histogram
+        return LatencyHistogram.from_state({
+            "lo_ms": h._lo * 1e3,
+            "bins_per_decade": h._bins_per_decade,
+            "counts": [0] * len(h._counts),
+            "sum": 0.0, "max": 0.0, "n": 0,
+        })
+
+    def child(self, **labels) -> LatencyHistogram:
+        """The (created-on-first-use) child histogram for one label set;
+        no labels returns the base histogram."""
+        if not labels:
+            return self.histogram
+        k = self._key(labels)
+        with self._lock:
+            h = self._children.get(k)
+            if h is None:
+                h = self._blank_child()
+                self._children[k] = h
+            return h
+
+    def observe(self, seconds: float, **labels) -> None:
+        if labels:
+            self.child(**labels).observe(seconds)
+        else:
+            self.histogram.observe(seconds)
 
     def reset(self) -> None:
         # LatencyHistogram has no public reset; replace it wholesale (racy
         # observers at worst land one sample in the discarded instance).
         self.histogram = LatencyHistogram()
+        with self._lock:
+            self._children.clear()
+
+    def collect_children(self) -> list[tuple[dict, LatencyHistogram]]:
+        with self._lock:
+            return [(dict(k), h) for k, h in sorted(self._children.items())]
+
+    def fold_child(self, labels: Mapping[str, str], state: Mapping) -> None:
+        """Merge primitive (obs/fleet.py): fold one child's histogram
+        state from another process's shard. Raises ValueError on a bin
+        layout mismatch, same contract as ``LatencyHistogram.merge_state``."""
+        k = self._key(labels)
+        with self._lock:
+            h = self._children.get(k)
+            if h is None:
+                self._children[k] = LatencyHistogram.from_state(state)
+                return
+        h.merge_state(state)
 
     def snapshot_value(self) -> dict:
-        return self.histogram.snapshot()
+        with self._lock:
+            children = dict(self._children)
+        if not children:
+            return self.histogram.snapshot()
+        out = {
+            ".".join(v for _, v in k): h.snapshot()
+            for k, h in sorted(children.items())
+        }
+        if self.histogram._n:
+            out[""] = self.histogram.snapshot()
+        return out
 
     def prometheus_lines(self, exposed_name: Optional[str] = None) -> list[str]:
-        h = self.histogram
         name = exposed_name or _prom_name(self.name)
-        with h._lock:
-            n, s = h._n, h._sum
-        lines = []
-        for q in self.QUANTILES:
+        with self._lock:
+            children = sorted(self._children.items())
+        lines: list[str] = []
+
+        def emit(h: LatencyHistogram, labels: dict) -> None:
+            with h._lock:
+                n, s = h._n, h._sum
+            for q in self.QUANTILES:
+                lines.append(
+                    f"{name}{_prom_labels({**labels, 'quantile': str(q)})} "
+                    f"{_prom_value(h.quantile_ms(q) / 1e3)}"
+                )
+            lines.append(f"{name}_sum{_prom_labels(labels)} {_prom_value(s)}")
             lines.append(
-                f'{name}{{quantile="{q}"}} '
-                f"{_prom_value(h.quantile_ms(q) / 1e3)}"
-            )
-        lines.append(f"{name}_sum {_prom_value(s)}")
-        lines.append(f"{name}_count {_prom_value(n)}")
+                f"{name}_count{_prom_labels(labels)} {_prom_value(n)}")
+
+        if self.histogram._n or not children:
+            emit(self.histogram, {})
+        for k, h in children:
+            emit(h, dict(k))
         return lines
 
 
@@ -333,8 +410,13 @@ class MetricsRegistry:
         out = {}
         for name, m in sorted(metrics.items()):
             if isinstance(m, HistogramMetric):
-                out[name] = {"kind": "summary", "help": m.help,
-                             "state": m.histogram.state()}
+                spec = {"kind": "summary", "help": m.help,
+                        "state": m.histogram.state()}
+                children = m.collect_children()
+                if children:
+                    spec["children"] = [[labels, h.state()]
+                                        for labels, h in children]
+                out[name] = spec
             else:
                 out[name] = {
                     "kind": m.kind, "help": m.help,
@@ -357,19 +439,28 @@ class MetricsRegistry:
                     # Create with the SHARD's bin layout, not the default:
                     # a component exporting a non-default LatencyHistogram
                     # must fold, not mismatch.
-                    self.histogram(
+                    hm = self.histogram(
                         name, help_,
                         histogram=LatencyHistogram.from_state(hstate))
-                    continue
-                try:
-                    self.histogram(name, help_).histogram.merge_state(
-                        hstate)
-                except (ValueError, TypeError, KeyError) as e:
-                    # One incompatible shard histogram must not kill the
-                    # whole aggregation (the run report's contract) —
-                    # skip the metric, loudly.
-                    logging.getLogger("photon_tpu.obs").warning(
-                        "fleet merge: skipping histogram %r (%s)", name, e)
+                else:
+                    hm = self.histogram(name, help_)
+                    try:
+                        hm.histogram.merge_state(hstate)
+                    except (ValueError, TypeError, KeyError) as e:
+                        # One incompatible shard histogram must not kill the
+                        # whole aggregation (the run report's contract) —
+                        # skip the metric, loudly.
+                        logging.getLogger("photon_tpu.obs").warning(
+                            "fleet merge: skipping histogram %r (%s)",
+                            name, e)
+                        continue
+                for labels, cstate in spec.get("children", ()):
+                    try:
+                        hm.fold_child(labels, cstate)
+                    except (ValueError, TypeError, KeyError) as e:
+                        logging.getLogger("photon_tpu.obs").warning(
+                            "fleet merge: skipping histogram %r child %r "
+                            "(%s)", name, labels, e)
             elif kind == "gauge":
                 g = self.gauge(name, help_)
                 for labels, value in spec.get("series", ()):
@@ -385,6 +476,22 @@ class MetricsRegistry:
                         c.fold_series(labels, value)
             # unknown kinds are skipped: a newer shard schema must not
             # kill an older aggregator
+
+    @staticmethod
+    def _hist_state_delta(ns: Mapping, os_: Mapping) -> Optional[dict]:
+        """Elementwise ``new - old`` of one histogram state, or ``None``
+        when the bin layout changed (caller folds the whole new state)."""
+        if (len(ns.get("counts", ())) != len(os_.get("counts", ()))
+                or ns.get("lo_ms") != os_.get("lo_ms")):
+            return None
+        return {
+            **ns,
+            "counts": [int(a) - int(b) for a, b
+                       in zip(ns["counts"], os_["counts"])],
+            "sum": float(ns["sum"]) - float(os_["sum"]),
+            "n": int(ns["n"]) - int(os_["n"]),
+            "max": max(float(ns["max"]), float(os_["max"])),
+        }
 
     @staticmethod
     def _state_delta(new: Mapping, old: Mapping) -> dict:
@@ -413,19 +520,43 @@ class MetricsRegistry:
                     series.append([dict(key), -value])
                 out[name] = {**spec, "series": series}
             elif kind == "summary":
-                ns, os_ = spec["state"], prev["state"]
-                if (len(ns.get("counts", ())) != len(os_.get("counts", ()))
-                        or ns.get("lo_ms") != os_.get("lo_ms")):
+                diff = MetricsRegistry._hist_state_delta(
+                    spec["state"], prev["state"])
+                if diff is None:
                     out[name] = spec  # layout changed: fold whole (skipped
                     continue          # by merge_state's mismatch guard)
-                out[name] = {**spec, "state": {
-                    **ns,
-                    "counts": [int(a) - int(b) for a, b
-                               in zip(ns["counts"], os_["counts"])],
-                    "sum": float(ns["sum"]) - float(os_["sum"]),
-                    "n": int(ns["n"]) - int(os_["n"]),
-                    "max": max(float(ns["max"]), float(os_["max"])),
-                }}
+                delta_spec = {**spec, "state": diff}
+                if "children" in spec or "children" in prev:
+                    old_children = {
+                        tuple(sorted((str(k), str(v))
+                                     for k, v in labels.items())): st
+                        for labels, st in prev.get("children", ())
+                    }
+                    children = []
+                    for labels, st in spec.get("children", ()):
+                        key = tuple(sorted((str(k), str(v))
+                                           for k, v in labels.items()))
+                        ost = old_children.pop(key, None)
+                        cdiff = (None if ost is None
+                                 else MetricsRegistry._hist_state_delta(
+                                     st, ost))
+                        children.append([labels, st if cdiff is None
+                                         else cdiff])
+                    # Vanished children (an in-place reset) fold as a
+                    # negative correction, mirroring counter series.
+                    for key, ost in old_children.items():
+                        children.append([dict(key), {
+                            **ost,
+                            "counts": [-int(c) for c in ost["counts"]],
+                            "sum": -float(ost["sum"]),
+                            "n": -int(ost["n"]),
+                            "max": float(ost["max"]),
+                        }])
+                    if children:
+                        delta_spec["children"] = children
+                    else:
+                        delta_spec.pop("children", None)
+                out[name] = delta_spec
             else:
                 out[name] = spec
         return out

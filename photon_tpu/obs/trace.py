@@ -40,12 +40,17 @@ import os
 import threading
 import time
 import zlib
+from collections import deque
 from typing import Optional
 
 __all__ = [
     "ANCHOR_EVENT",
     "ANCHOR_SCHEMA",
+    "TailSampler",
     "TraceCollector",
+    "install_tail_sampler",
+    "uninstall_tail_sampler",
+    "tail_sampler",
     "trace_span",
     "instant",
     "process_role",
@@ -314,6 +319,10 @@ class TraceCollector:
         args: Optional[dict] = None,
     ) -> None:
         """One 'X' (complete) event; ``t0`` is a perf_counter value."""
+        tail = _TAIL
+        if tail is not None and tail.intercept(name, cat, t0, dur_s, args):
+            return  # buffered; promoted into this collector only if the
+            # owning request breaches the tail threshold (or errors)
         if self.sample < 1.0 and not self._keep_span(args):
             return
         self.add({
@@ -373,7 +382,233 @@ class TraceCollector:
         return path
 
 
+class _BufferedSpan:
+    """One buffered span event, shareable between requests: batch-level
+    spans (kernel, store resolve) carry a ``trace_ids`` list and are
+    buffered ONCE with the same object appended to every member request's
+    buffer — the ``emitted`` flag makes promotion exactly-once however
+    many members breach."""
+
+    __slots__ = ("event", "emitted")
+
+    def __init__(self, event: dict):
+        self.event = event
+        self.emitted = False
+
+
+class TailSampler:
+    """Tail-based trace sampling (docs/observability.md §"Tail sampling").
+
+    Head sampling (``PHOTON_TRACE_SAMPLE``) decides before the request
+    runs, so it keeps mostly boring traces; tail sampling decides AFTER:
+    a bounded ring holds every in-flight request's span set cheaply
+    (plain dicts, no serialization), and on completion the request is
+    either promoted into the active collector — it breached the rolling
+    latency threshold, or it errored — or discarded. Production traces
+    then capture exactly the interesting tails, still under the
+    collector's ``PHOTON_TRACE_MAX_BYTES``/``max_events`` bounds
+    (promotion goes through :meth:`TraceCollector.add`).
+
+    The rolling threshold is the ``quantile`` (default p95) of the last
+    ``window`` request durations; until ``min_history`` requests have
+    completed nothing is promoted on latency (errors always promote).
+    Spans reach the sampler through :meth:`TraceCollector.complete` —
+    any span whose ``trace_id`` (or ``trace_ids`` member) matches a
+    request registered via :meth:`begin` is buffered instead of
+    appended; everything else (training spans, instants, anchors) passes
+    straight through. Enable via ``PHOTON_TRACE_TAIL=1`` (knobs:
+    ``PHOTON_TRACE_TAIL_QUANTILE``, ``PHOTON_TRACE_TAIL_WINDOW``) or
+    install one explicitly with :func:`install_tail_sampler`.
+    """
+
+    def __init__(self, capacity: int = 512, window: int = 256,
+                 quantile: float = 0.95, min_history: int = 30,
+                 max_spans_per_request: int = 64):
+        if not 0.0 < quantile < 1.0:
+            raise ValueError(f"tail quantile must be in (0, 1): {quantile}")
+        self.capacity = max(1, int(capacity))
+        self.quantile = float(quantile)
+        self.min_history = max(1, int(min_history))
+        self.max_spans_per_request = max(1, int(max_spans_per_request))
+        self._lock = threading.Lock()
+        self._inflight: dict[str, list[_BufferedSpan]] = {}
+        self._order: list[str] = []  # FIFO eviction order (begin() order)
+        self._durations = deque(maxlen=max(self.min_history, int(window)))
+        # Loud bookkeeping, surfaced via snapshot() and the promotion
+        # instant — a sampler silently eating spans would be worse than
+        # no sampler.
+        self.promoted = 0
+        self.promoted_error = 0
+        self.discarded = 0
+        self.evicted = 0
+        self.span_overflow = 0
+
+    # ------------------------------------------------------------- intake
+
+    def begin(self, trace_id: str) -> None:
+        """Register one in-flight request; called at the request edge
+        (``ScoringServer._score``) right after the trace id is minted.
+        Beyond ``capacity`` in-flight requests the OLDEST buffer is
+        evicted (its spans are unrecoverable — counted, never silent)."""
+        with self._lock:
+            if trace_id in self._inflight:
+                return
+            self._inflight[trace_id] = []
+            self._order.append(trace_id)
+            while len(self._order) > self.capacity:
+                victim = self._order.pop(0)
+                if self._inflight.pop(victim, None) is not None:
+                    self.evicted += 1
+
+    def intercept(self, name: str, cat: str, t0: float, dur_s: float,
+                  args: Optional[dict]) -> bool:
+        """Divert one completed span into the buffers of the in-flight
+        request(s) it belongs to. Returns False — pass through to the
+        collector — when no owning request is registered."""
+        a = args or {}
+        ids = []
+        tid = a.get("trace_id")
+        if tid is not None:
+            ids.append(tid)
+        multi = a.get("trace_ids")
+        if multi:
+            ids.extend(multi)
+        if not ids:
+            return False
+        event = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": round((t0 - _EPOCH) * 1e6, 1),
+            "dur": round(dur_s * 1e6, 1),
+            "pid": os.getpid(),
+            "tid": threading.get_ident() & 0xFFFFFFFF,
+            "args": {**a, "span_id": next(_span_ids)},
+        }
+        span = _BufferedSpan(event)
+        hit = False
+        with self._lock:
+            for t in ids:
+                buf = self._inflight.get(t)
+                if buf is None:
+                    continue
+                hit = True
+                if len(buf) >= self.max_spans_per_request:
+                    self.span_overflow += 1
+                else:
+                    buf.append(span)
+        return hit
+
+    # ---------------------------------------------------------- decision
+
+    def _threshold_locked(self) -> Optional[float]:
+        n = len(self._durations)
+        if n < self.min_history:
+            return None
+        ordered = sorted(self._durations)
+        return ordered[min(n - 1, int(self.quantile * n))]
+
+    def threshold_s(self) -> Optional[float]:
+        """The current promotion threshold (None while history warms up)."""
+        with self._lock:
+            return self._threshold_locked()
+
+    def finish(self, trace_id: str, duration_s: float,
+               error: bool = False) -> bool:
+        """Completion verdict for one request: promote its buffered spans
+        into the active collector (threshold breach or error) or discard
+        them. Always feeds the rolling window. Returns True iff
+        promoted."""
+        with self._lock:
+            spans = self._inflight.pop(trace_id, None)
+            threshold = self._threshold_locked()
+            self._durations.append(float(duration_s))
+            # Strictly greater: a uniform-latency workload (everything ==
+            # the p95) is the BORING case and must not promote 100%.
+            promote = bool(error) or (
+                threshold is not None and duration_s > threshold)
+            if not promote:
+                if spans is not None:
+                    self.discarded += 1
+                return False
+            if spans is None:
+                return False  # evicted before the verdict: already counted
+            to_emit = [s for s in spans if not s.emitted]
+            for s in to_emit:
+                s.emitted = True
+            if error:
+                self.promoted_error += 1
+            self.promoted += 1
+        col = _ACTIVE
+        if col is not None:
+            for s in to_emit:
+                col.add(s.event)
+            col.instant("photon.trace.tail_promoted", "meta", {
+                "trace_id": trace_id,
+                "duration_ms": round(duration_s * 1e3, 3),
+                "threshold_ms": (None if threshold is None
+                                 else round(threshold * 1e3, 3)),
+                "reason": "error" if error else "latency",
+                "spans": len(to_emit),
+            })
+        return True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "inflight": len(self._inflight),
+                "capacity": self.capacity,
+                "quantile": self.quantile,
+                "window": len(self._durations),
+                "threshold_s": self._threshold_locked(),
+                "promoted": self.promoted,
+                "promoted_error": self.promoted_error,
+                "discarded": self.discarded,
+                "evicted": self.evicted,
+                "span_overflow": self.span_overflow,
+            }
+
+
 _ACTIVE: Optional[TraceCollector] = None
+_TAIL: Optional[TailSampler] = None
+
+
+def tail_sampler() -> Optional[TailSampler]:
+    return _TAIL
+
+
+def install_tail_sampler(sampler: Optional[TailSampler]) -> Optional[TailSampler]:
+    """Install (or clear, with None) the process-wide tail sampler."""
+    global _TAIL
+    _TAIL = sampler
+    return sampler
+
+
+def uninstall_tail_sampler() -> Optional[TailSampler]:
+    global _TAIL
+    s = _TAIL
+    _TAIL = None
+    return s
+
+
+def _env_tail_sampler() -> Optional[TailSampler]:
+    """Build a TailSampler from the environment, or None when off.
+    Malformed knob values degrade to defaults — a typo must never kill
+    tracing (same contract as ``_env_sample``)."""
+    raw = (os.environ.get("PHOTON_TRACE_TAIL") or "").strip().lower()
+    if raw in ("", "0", "false", "off", "no"):
+        return None
+    try:
+        q = float(os.environ.get("PHOTON_TRACE_TAIL_QUANTILE", 0.95))
+    except (TypeError, ValueError):
+        q = 0.95
+    if not 0.0 < q < 1.0:
+        q = 0.95
+    try:
+        window = int(os.environ.get("PHOTON_TRACE_TAIL_WINDOW", 256))
+    except (TypeError, ValueError):
+        window = 256
+    return TailSampler(quantile=q, window=window)
 
 
 def tracing_active() -> bool:
@@ -385,9 +620,13 @@ def active_collector() -> Optional[TraceCollector]:
 
 
 def start_tracing(max_events: int = _DEFAULT_MAX_EVENTS) -> TraceCollector:
-    """Install a process-wide collector (replacing any active one)."""
-    global _ACTIVE
+    """Install a process-wide collector (replacing any active one).
+    ``PHOTON_TRACE_TAIL=1`` also installs a tail sampler, unless one is
+    already installed (explicit installs win over the env default)."""
+    global _ACTIVE, _TAIL
     _ACTIVE = TraceCollector(max_events=max_events)
+    if _TAIL is None:
+        _TAIL = _env_tail_sampler()
     return _ACTIVE
 
 

@@ -122,6 +122,19 @@ class RouterServer:
         self._latency = self.metrics.histogram(
             "router_request_latency_seconds",
             "end-to-end routed /score latency (successes)")
+        # Per-dispatch upstream latency labeled by outcome, so a retry
+        # storm (ok collapsing into retry) and a shed flood are
+        # distinguishable in ONE Prometheus scrape: ok = 200 on the first
+        # attempt, retry = 200 after re-dispatch, shed = upstream 503
+        # re-dispatched, error = connect failure or non-200 relay.
+        self._upstream_latency = self.metrics.histogram(
+            "router_upstream_latency_seconds",
+            "per-dispatch upstream latency by outcome "
+            "(ok/retry/shed/error)")
+        for outcome in ("ok", "retry", "shed", "error"):
+            # Registered empty at startup: a warm-up scrape reads four
+            # zero-count series, never "metric missing".
+            self._upstream_latency.child(outcome=outcome)
         self.metrics.gauge_fn(
             "router_healthy_replicas",
             lambda: sum(1 for r in self._routable()),
@@ -136,6 +149,12 @@ class RouterServer:
         self._drained_g = self.metrics.gauge(
             "router_drained_replicas",
             "1 when the labeled replica is excluded from routing")
+        # Startup registration (docs/observability.md §"Gauge warm-up"):
+        # every configured replica starts DRAINED (1) until its first
+        # clean health sweep proves otherwise — a scrape during warm-up
+        # reads the honest posture, never "metric missing".
+        for r in self._replicas:
+            self._drained_g.set(1.0, replica=r.url)
         router = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -188,9 +207,12 @@ class RouterServer:
                 n = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(n) if n else b"{}"
                 tid = self.headers.get("X-Photon-Trace-Id") or new_trace_id()
+                timing = (self.headers.get("X-Photon-Timing")
+                          or "").lower() in ("1", "true", "yes", "on")
                 with trace_context(tid), \
                         trace_span("router.request", cat="router") as sp:
-                    code, payload, hdrs = router.route_score(body, tid, sp)
+                    code, payload, hdrs = router.route_score(
+                        body, tid, sp, timing=timing)
                 self._reply(code, payload, headers=hdrs)
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
@@ -348,10 +370,13 @@ class RouterServer:
                 return r
         return weighted[-1][0]
 
-    def route_score(self, body: bytes, trace_id: str, span) -> tuple:
+    def route_score(self, body: bytes, trace_id: str, span,
+                    timing: bool = False) -> tuple:
         """Dispatch one /score read; returns (code, payload-bytes, hdrs).
         Connect failures and 503 sheds retry on the NEXT-best replica
-        (scores are idempotent reads) up to ``retries`` times."""
+        (scores are idempotent reads) up to ``retries`` times. With
+        ``timing`` the X-Photon-Timing opt-in is forwarded upstream and
+        the router hop is prepended to the replica's stage breakdown."""
         t0 = time.perf_counter()
         tried: list = []
         last_err: Optional[str] = None
@@ -363,21 +388,29 @@ class RouterServer:
                 self._retries_c.inc()
             tried.append(r)
             self._upstream_c.inc(1, replica=r.url)
+            a0 = time.perf_counter()
             try:
+                headers = {"Content-Type": "application/json",
+                           "X-Photon-Trace-Id": trace_id}
+                if timing:
+                    headers["X-Photon-Timing"] = "1"
                 req = urllib.request.Request(
                     r.url + "/score", data=body, method="POST",
-                    headers={"Content-Type": "application/json",
-                             "X-Photon-Trace-Id": trace_id})
+                    headers=headers)
                 with urllib.request.urlopen(
                         req, timeout=self.timeout_s) as resp:
                     payload = resp.read()
                     code = resp.status
+                    upstream_timing = resp.headers.get("X-Photon-Timing")
             except urllib.error.HTTPError as e:
                 payload = e.read()
                 code = e.code
+                upstream_timing = e.headers.get("X-Photon-Timing")
                 if code == 503 and attempt < self.retries:
                     # A shed (queue full, memory pressure, draining):
                     # idempotent read, another replica may have room.
+                    self._upstream_latency.observe(
+                        time.perf_counter() - a0, outcome="shed")
                     self._upstream_err_c.inc(1, replica=r.url,
                                              kind="shed")
                     last_err = f"{r.url} shed (503)"
@@ -385,6 +418,8 @@ class RouterServer:
             except _CONNECT_ERRORS + (urllib.error.URLError,) as e:
                 # Connect failure: mark it down NOW (don't wait for the
                 # health sweep) and retry elsewhere.
+                self._upstream_latency.observe(
+                    time.perf_counter() - a0, outcome="error")
                 self._upstream_err_c.inc(1, replica=r.url, kind="connect")
                 with self._lock:
                     r.reachable = False
@@ -394,12 +429,28 @@ class RouterServer:
                 span.set(retried=True)
                 continue
             # Success or a non-retryable client/server answer: relay it.
+            upstream_s = time.perf_counter() - a0
             outcome = "ok" if code == 200 else f"http_{code}"
+            self._upstream_latency.observe(
+                upstream_s,
+                outcome=("ok" if code == 200 and not attempt else
+                         "retry" if code == 200 else "error"))
             self._requests_c.inc(1, outcome=outcome)
+            total = time.perf_counter() - t0
             if code == 200:
-                self._latency.histogram.observe(time.perf_counter() - t0)
+                self._latency.histogram.observe(total)
             span.set(status=code, replica=r.url, attempts=attempt + 1)
-            return code, payload, ()
+            hdrs = ()
+            if timing:
+                # router hop = everything spent in front of the replica
+                # (pick, failed attempts, proxying) — total minus the
+                # answering attempt's upstream wall time.
+                hop = max(0.0, total - upstream_s)
+                breakdown = f"router;dur={(hop * 1e3):.3f}"
+                if upstream_timing:
+                    breakdown += ", " + upstream_timing
+                hdrs = (("X-Photon-Timing", breakdown),)
+            return code, payload, hdrs
         self._requests_c.inc(1, outcome="no_replica")
         span.set(status=503, attempts=len(tried))
         return 503, {

@@ -224,19 +224,25 @@ class MicroBatcher:
             v0 = items[0].version
             batch = [it for it in items if it.version is v0]
             self._carry = [it for it in items if it.version is not v0]
+            picked = time.perf_counter()
             col = obs_trace.active_collector()
             if col is not None:
                 # Queue-wait spans, one per admitted row, stamped with the
                 # ORIGINATING request's trace id: the span starts at submit
                 # time (producer thread) and ends here (worker thread) —
                 # exactly the cross-thread hop the timeline must bridge.
-                now = time.perf_counter()
                 for it in batch:
                     col.complete(
                         "serve.queue_wait", "serving", it.enqueued_at,
-                        now - it.enqueued_at,
+                        picked - it.enqueued_at,
                         {"trace_id": it.trace_id} if it.trace_id else {},
                     )
+            # Per-batch stage clock (docs/serving.md §"Latency waterfall"):
+            # the scorer accumulates batch_assembly / store_resolve /
+            # kernel seconds into the sink; queue_wait is per-row. The
+            # whole dict rides each ScoreResult back across the thread
+            # boundary so the server can expose the waterfall.
+            stage_sink: dict = {}
             try:
                 with trace_span(
                     "serve.batch", cat="serving", rows=len(batch),
@@ -244,10 +250,13 @@ class MicroBatcher:
                                if it.trace_id is not None] or None,
                 ):
                     scores, flags = v0.scorer.score_rows_flagged(
-                        [it.row for it in batch]
+                        [it.row for it in batch], stage_sink=stage_sink
                     )
                 for it, s, fl in zip(batch, scores, flags):
-                    it.future.set_result(ScoreResult(float(s), fl))
+                    it.future.set_result(ScoreResult(
+                        float(s), fl,
+                        {"queue_wait": picked - it.enqueued_at,
+                         **stage_sink}))
             except Exception as e:  # noqa: BLE001 - routed to the waiter
                 for it in batch:
                     if not it.future.done():
@@ -299,11 +308,14 @@ class MicroBatcher:
 class ScoreResult(float):
     """A score that IS a float (full arithmetic/JSON compatibility) plus the
     degradation flags: which RE coordinates scored fixed-effect-only because
-    their coefficient-store circuit breaker was open."""
+    their coefficient-store circuit breaker was open — and the per-stage
+    latency waterfall (``stages``: stage name → seconds) the batcher
+    measured for this row's batch."""
 
-    __slots__ = ("degraded",)
+    __slots__ = ("degraded", "stages")
 
-    def __new__(cls, value: float, degraded=()):
+    def __new__(cls, value: float, degraded=(), stages=None):
         obj = super().__new__(cls, value)
         obj.degraded = tuple(degraded)
+        obj.stages = stages or {}
         return obj

@@ -267,12 +267,19 @@ class RowScorer:
                 seconds=round(seconds, 4))
 
     def score_rows_flagged(
-        self, rows: Sequence[ParsedRow]
+        self, rows: Sequence[ParsedRow],
+        stage_sink: Optional[dict] = None,
     ) -> tuple[np.ndarray, list]:
         """``(scores, flags)``: ``flags[i]`` is the tuple of RE coordinate
         ids whose contribution row ``i`` LOST to an open coefficient-store
         circuit breaker (fixed-effect-only degradation, docs/robustness.md);
         empty for fully-scored rows.
+
+        ``stage_sink``, when given, accumulates the per-stage latency
+        waterfall in seconds (``batch_assembly`` / ``store_resolve`` /
+        ``kernel``) across every chunk — including downshift retries, so
+        the waterfall prices what the batch actually cost, not what a
+        clean pass would have.
 
         An ``oom``-classified kernel failure is absorbed by the bounded
         max-batch downshift (``_absorb_kernel_oom``): only the failed
@@ -289,9 +296,9 @@ class RowScorer:
             try:
                 if downshifted:
                     with retrace.expected_compiles():
-                        s, f = self._score_chunk(chunk)
+                        s, f = self._score_chunk(chunk, stage_sink)
                 else:
-                    s, f = self._score_chunk(chunk)
+                    s, f = self._score_chunk(chunk, stage_sink)
             except Exception as e:  # noqa: BLE001 - classified below
                 if not self._absorb_kernel_oom(e):
                     raise
@@ -352,25 +359,29 @@ class RowScorer:
         return True
 
     def _score_chunk(
-        self, rows: Sequence[ParsedRow]
+        self, rows: Sequence[ParsedRow],
+        stage_sink: Optional[dict] = None,
     ) -> tuple[np.ndarray, list]:
         b = len(rows)
-        bp = self._bucket(b)
-        k = self.config.max_row_nnz
-        shard_idx, shard_val = {}, {}
-        for shard in self._shards_used:
-            dim = len(self.index_maps[shard])
-            mi = np.full((bp, k), dim, np.int32)
-            mv = np.zeros((bp, k), np.float32)
+        with trace_span("serve.batch_assembly", cat="serving",
+                        rows=b) as assembly_span:
+            bp = self._bucket(b)
+            k = self.config.max_row_nnz
+            shard_idx, shard_val = {}, {}
+            for shard in self._shards_used:
+                dim = len(self.index_maps[shard])
+                mi = np.full((bp, k), dim, np.int32)
+                mv = np.zeros((bp, k), np.float32)
+                for r, row in enumerate(rows):
+                    mi[r] = row.shard_idx[shard]
+                    mv[r] = row.shard_val[shard]
+                shard_idx[shard] = jnp.asarray(mi)
+                shard_val[shard] = jnp.asarray(mv)
+            offsets = np.zeros(bp, np.float32)
             for r, row in enumerate(rows):
-                mi[r] = row.shard_idx[shard]
-                mv[r] = row.shard_val[shard]
-            shard_idx[shard] = jnp.asarray(mi)
-            shard_val[shard] = jnp.asarray(mv)
-        offsets = np.zeros(bp, np.float32)
-        for r, row in enumerate(rows):
-            offsets[r] = row.offset
+                offsets[r] = row.offset
 
+        resolve_seconds = 0.0
         re_proj, re_coef = {}, {}
         degraded_rows: list[list[str]] = [[] for _ in range(b)]
         for cid, _ in self.re_parts:
@@ -378,8 +389,9 @@ class RowScorer:
             keys = [row.entity_keys[cid] for row in rows]
             keys += [None] * (bp - b)  # pad rows → fallback zero row
             with trace_span("serve.store_resolve", cat="serving",
-                            coordinate=cid, keys=b):
+                            coordinate=cid, keys=b) as resolve_span:
                 slots, degraded = cache.resolve(keys)
+            resolve_seconds += resolve_span.seconds
             if degraded.any():
                 for r in np.flatnonzero(degraded[:b]):
                     degraded_rows[int(r)].append(cid)
@@ -406,13 +418,21 @@ class RowScorer:
             # kernel span reports completed compute, not async dispatch.
             return np.asarray(scores)
 
-        with trace_span("serve.kernel", cat="serving", rows=b, bucket=bp):
+        with trace_span("serve.kernel", cat="serving", rows=b,
+                        bucket=bp) as kernel_span:
             try:
                 host_scores = run_kernel()
                 if self.kernel_breaker is not None:
                     self.kernel_breaker.record_success()
             except Exception as e:  # noqa: BLE001 - classified below
                 host_scores = self._recover_kernel(e, run_kernel)
+        if stage_sink is not None:
+            # Accumulate (not assign): a downshift retry re-runs the chunk
+            # and the waterfall must price both passes.
+            for stage, sec in (("batch_assembly", assembly_span.seconds),
+                               ("store_resolve", resolve_seconds),
+                               ("kernel", kernel_span.seconds)):
+                stage_sink[stage] = stage_sink.get(stage, 0.0) + sec
         return host_scores[:b], [tuple(d) for d in degraded_rows]
 
     def _recover_kernel(self, err: Exception, run_kernel) -> np.ndarray:

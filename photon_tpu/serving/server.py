@@ -46,6 +46,7 @@ from photon_tpu.obs import (
     trace_context,
     trace_span,
 )
+from photon_tpu.obs import trace as obs_trace
 from photon_tpu.serving.batcher import (
     DeadlineExceeded,
     MicroBatcher,
@@ -119,6 +120,15 @@ class ScoringServer:
             "serve_request_latency_seconds",
             "end-to-end /score latency (successful requests)",
         )
+        # Per-stage latency waterfall (docs/serving.md §"Latency
+        # waterfall"): one labeled summary, so p95 queue-wait vs p95
+        # kernel is a single scrape, not a trace-file autopsy.
+        self._stage_hist = self.metrics.histogram(
+            "serve_stage_latency_seconds",
+            "per-request stage waterfall: admission / queue_wait / "
+            "batch_assembly / store_resolve / kernel / response "
+            "(successful requests)",
+        )
         self.metrics.gauge_fn(
             "serve_queue_depth", lambda: self.batcher.snapshot()["queued"],
             "requests waiting in the micro-batcher admission queue",
@@ -133,6 +143,22 @@ class ScoringServer:
             "seconds since server start",
         )
         retrace.install_device_memory_gauges(self.metrics)
+        # Startup registration of the recovery watermarks (gauge warm-up
+        # audit, docs/observability.md): both read 0 ("never yet") from
+        # the very first scrape instead of being absent until the first
+        # swap/restart stamps them. recovery_snapshot still maps 0 →
+        # None, so /healthz semantics are unchanged.
+        for gname, ghelp in (
+            ("swap_to_first_score_seconds",
+             "seconds from a registry hot-swap publishing a version to "
+             "its first completed scored batch"),
+            ("restart_to_first_step_seconds",
+             "seconds from process start to the restarted run's first "
+             "completed step"),
+        ):
+            g = GLOBAL_REGISTRY.gauge(gname, ghelp)
+            if not g.value():
+                g.set(0.0)
         self._started_at = time.time()
         # Interval-rate state (satellite fix): lifetime requests/uptime
         # understates the current rate after any idle period, so each
@@ -146,6 +172,10 @@ class ScoringServer:
         # on /healthz and the metrics snapshot — the staleness signal the
         # router weights traffic by.
         self.replication = None
+        # Live fleet view: when set (serving driver, --telemetry-dir),
+        # every metrics flush also exports the registry shard here so the
+        # obs driver can aggregate this process BEFORE it exits.
+        self.telemetry_shard_path: Optional[str] = None
         # Drain state (SIGTERM contract): the flag 503s requests arriving
         # on kept-alive connections after the listener closed; the
         # condition variable lets shutdown() wait for in-flight /score
@@ -314,10 +344,30 @@ class ScoringServer:
                     # (docs/observability.md §"Fleet view").
                     tid = (self.headers.get("X-Photon-Trace-Id")
                            or new_trace_id())
-                    with trace_context(tid), \
-                            trace_span("serve.request",
-                                       cat="serving") as req_span:
-                        self._score_traced(req_span)
+                    # Tail-based sampling (docs/observability.md §"Tail
+                    # sampling"): register the request so its spans buffer
+                    # in the ring; the verdict comes after the root span
+                    # closes — promote on threshold breach or error,
+                    # discard the boring majority.
+                    tail = obs_trace.tail_sampler()
+                    if tail is not None:
+                        tail.begin(tid)
+                    try:
+                        with trace_context(tid), \
+                                trace_span("serve.request",
+                                           cat="serving") as req_span:
+                            self._score_traced(req_span)
+                    finally:
+                        if tail is not None:
+                            status = req_span.args.get("status")
+                            tail.finish(
+                                tid, req_span.seconds,
+                                # Sheds are fast, loud, and counted — a
+                                # shed flood must not flood the trace too.
+                                error=status is None or (
+                                    int(status) >= 500
+                                    and not req_span.args.get("shed")),
+                            )
                 finally:
                     with server._inflight_cv:
                         server._inflight -= 1
@@ -326,7 +376,8 @@ class ScoringServer:
             def _score_traced(self, req_span):
                 t0 = time.perf_counter()
                 try:
-                    with trace_span("serve.admission", cat="serving"):
+                    with trace_span("serve.admission",
+                                    cat="serving") as adm_span:
                         payload = self._read_json()
                         # Pressure-aware load shedding (docs/robustness.md
                         # §"Memory pressure"): past the critical device-
@@ -378,9 +429,20 @@ class ScoringServer:
                     req_span.set(status=500)
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     return
-                server.latency.observe(time.perf_counter() - t0)
+                total = time.perf_counter() - t0
+                server.latency.observe(total)
                 server._count(requests=1)
                 req_span.set(status=200)
+                # Stage waterfall (docs/serving.md §"Latency waterfall"):
+                # admission measured here, queue_wait/batch_assembly/
+                # store_resolve/kernel carried back on the ScoreResult,
+                # response = everything the stage clock didn't cover
+                # (future handoff, reply serialization).
+                stages = {"admission": adm_span.seconds}
+                stages.update(getattr(score, "stages", None) or {})
+                stages["response"] = max(0.0, total - sum(stages.values()))
+                for stage, sec in stages.items():
+                    server._stage_hist.observe(sec, stage=stage)
                 out = {"score": score, "model_version": version.version}
                 degraded = getattr(score, "degraded", ())
                 if degraded:
@@ -391,7 +453,16 @@ class ScoringServer:
                     out["degraded"] = sorted(degraded)
                 if "uid" in payload:
                     out["uid"] = payload["uid"]
-                self._reply(200, out)
+                headers = ()
+                if (self.headers.get("X-Photon-Timing") or "").lower() in (
+                        "1", "true", "yes", "on"):
+                    # Server-Timing-style opt-in breakdown on the response
+                    # — durations in ms, stage order = waterfall order.
+                    parts = [f"{st};dur={sec * 1e3:.3f}"
+                             for st, sec in stages.items()]
+                    parts.append(f"total;dur={total * 1e3:.3f}")
+                    headers = (("X-Photon-Timing", ", ".join(parts)),)
+                self._reply(200, out, headers=headers)
 
             def _swap(self):
                 try:
@@ -860,7 +931,8 @@ class ScoringServer:
         # snapshot serves both, so the persisted record and the SLO values
         # written beside it can never disagree (and the interval window
         # only advances when a record is actually persisted).
-        if self.slo_config is None and not self.metrics_path:
+        if (self.slo_config is None and not self.metrics_path
+                and not self.telemetry_shard_path):
             return
         snap = self.metrics_snapshot(
             advance_interval=bool(self.metrics_path))
@@ -869,6 +941,21 @@ class ScoringServer:
             snap = {**snap, "slo": slo}
         if self.metrics_path:
             write_metrics_jsonl(self.metrics_path, [snap])
+        if self.telemetry_shard_path:
+            # Live fleet view (docs/observability.md §"Live fleet view"):
+            # export the registry shard on the flush cadence, not only at
+            # exit, so the obs driver's /fleet sees this replica's
+            # counters WHILE it serves. Atomic write + idempotent
+            # per-shard_id merge make the re-export safe; best-effort by
+            # the telemetry contract.
+            try:
+                from photon_tpu.obs import fleet
+                fleet.write_registry_shard(
+                    self.telemetry_shard_path, registries=(self.metrics,))
+            except Exception as e:  # noqa: BLE001 - evidence, never a failure
+                if self.logger is not None:
+                    self.logger.warning(
+                        "live registry shard export failed: %s", e)
 
     def start(self) -> None:
         """Serve in a background thread (tests / embedded use)."""

@@ -1,0 +1,248 @@
+"""CI smoke for the streaming fleet view (docs/observability.md §"Live
+fleet view").
+
+Where fleet_smoke proves the POST-HOC path (every process exits, then
+the report CLI merges), this drill proves the LIVE edge:
+
+1. the training driver runs to completion, leaving its shards in the
+   shared ``--telemetry-dir``;
+2. the serving driver starts and KEEPS RUNNING, re-exporting its
+   registry shard on the metrics-flush cadence;
+3. the obs driver starts beside it, tailing the run root (which holds
+   the shared telemetry dir AND the serving driver's output dir, so the
+   live ``serving-metrics.jsonl`` history is in view);
+4. while the serving process is still alive, ``GET /fleet`` must carry
+   BOTH roles (training from its exited shard, serving from the live
+   re-export) plus a latency history being tailed;
+5. an injected latency level shift (appended to a separate metrics
+   JSONL between watcher ticks) must be flagged by the STREAMING
+   detector — asserted while the serving process is verifiably still
+   running, which is exactly what the post-hoc report cannot do;
+6. both long-running processes must then stop cleanly on SIGTERM.
+
+Run by ci.sh (obs-live smoke stage); exits non-zero with a named failure.
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from fleet_smoke import (  # noqa: E402
+    fail,
+    free_port,
+    run_child,
+    wait_healthy,
+    write_train_data,
+    N_USERS,
+)
+
+
+def get_json(host, port, path, timeout=5):
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read()
+    conn.close()
+    return resp.status, json.loads(body)
+
+
+def main() -> None:
+    td = tempfile.mkdtemp(prefix="obs-live-smoke-")
+    telemetry = os.path.join(td, "telemetry")
+    train = os.path.join(td, "train.avro")
+    out = os.path.join(td, "out")
+    write_train_data(train)
+
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": os.pathsep.join(
+            [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+            + ([os.environ["PYTHONPATH"]]
+               if os.environ.get("PYTHONPATH") else [])),
+    }
+    py = sys.executable
+
+    # ---- process 1: training driver (runs to completion) -----------------
+    run_child([
+        py, "-m", "photon_tpu.cli.game_training_driver",
+        "--train-data", train,
+        "--output-dir", out,
+        "--task", "LOGISTIC_REGRESSION",
+        "--feature-shard", "global:features",
+        "--coordinate",
+        "fixed:type=fixed,shard=global,reg=L2,max_iter=10,reg_weights=1",
+        "--coordinate",
+        "perUser:type=random,re_type=userId,shard=global,reg=L2,"
+        "max_iter=10,reg_weights=1",
+        "--devices", "1",
+        "--backend-policy", "cpu-only",
+        "--telemetry-dir", telemetry,
+    ], env, name="training driver")
+    print("obs_live_smoke: training process done")
+
+    # ---- process 2: serving driver, kept alive ---------------------------
+    host, sport = "127.0.0.1", free_port()
+    serving = subprocess.Popen([
+        py, "-m", "photon_tpu.cli.serving_driver",
+        "--model-dir", os.path.join(out, "best"),
+        "--host", host, "--port", str(sport),
+        "--max-batch", "8", "--max-wait-ms", "1",
+        "--cache-entities", "16", "--max-row-nnz", "16",
+        "--output-dir", os.path.join(td, "serve_logs"),
+        "--metrics-interval", "0.3",
+        "--backend-policy", "cpu-only",
+        "--telemetry-dir", telemetry,
+    ], env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    # ---- process 3: obs driver, tailing the run root ---------------------
+    # Watching td (not just td/telemetry) mirrors how the post-hoc report
+    # CLI is pointed at the run root: discovery is recursive, so the
+    # registry shards under telemetry/ AND the serving driver's
+    # serving-metrics.jsonl under serve_logs/ are both in view.
+    oport = free_port()
+    obs = subprocess.Popen([
+        py, "-m", "photon_tpu.cli.obs_driver",
+        "--telemetry-dir", td,
+        "--host", host, "--port", str(oport),
+        "--interval", "0.3",
+        "--output-dir", os.path.join(td, "obs_logs"),
+    ], env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+    def stop(proc, name, timeout=60):
+        if proc.poll() is not None:
+            return
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            fail(f"{name} ignored SIGTERM for {timeout}s")
+
+    try:
+        wait_healthy(host, sport)
+        print(f"obs_live_smoke: serving healthy on :{sport}")
+
+        # Traffic, so the serving flush loop has something to re-export
+        # and a latency history to write.
+        conn = http.client.HTTPConnection(host, sport, timeout=30)
+        for i in range(16):
+            conn.request("POST", "/score", body=json.dumps({
+                "features": [{"name": "g", "term": "0", "value": 1.0}],
+                "entities": {"userId": f"user{i % N_USERS}"},
+            }).encode(), headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                fail(f"/score returned {resp.status}")
+        conn.close()
+
+        # The obs /healthz contract: 503 while warming, 200 after the
+        # first tick.
+        deadline = time.monotonic() + 120
+        while time.monotonic() - deadline < 0:
+            try:
+                status, _ = get_json(host, oport, "/healthz")
+                if status == 200:
+                    break
+            except OSError:
+                pass
+            time.sleep(0.2)
+        else:
+            fail("obs driver never reached a first tick")
+
+        # -- all roles visible on /fleet WHILE the fleet is live -----------
+        # training's shard landed at its exit; serving's comes from the
+        # live flush-loop re-export — the serving process must still be
+        # running when we see it.
+        roles = set()
+        tailed = []
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            _, body = get_json(host, oport, "/fleet")
+            roles = set(body.get("roles") or [])
+            tailed = body.get("sources", {}).get("metrics_jsonl") or []
+            if {"training", "serving"} <= roles and tailed:
+                break
+            time.sleep(0.3)
+        if not {"training", "serving"} <= roles:
+            fail(f"/fleet roles while live: {sorted(roles)} "
+                 "(need training + serving)")
+        if not tailed:
+            fail("no metrics JSONL being tailed")
+        if serving.poll() is not None:
+            fail("serving process died before the live-roles assertion")
+        if body.get("n_live_anomalies"):
+            fail(f"clean run flagged anomalies: "
+                 f"{body['live_anomalies_this_tick']}")
+        print(f"obs_live_smoke: /fleet live with roles {sorted(roles)}")
+
+        md = None
+        conn = http.client.HTTPConnection(host, oport, timeout=5)
+        conn.request("GET", "/fleet?format=md")
+        resp = conn.getresponse()
+        md = resp.read().decode("utf-8", "replace")
+        conn.close()
+        if "# Live fleet view" not in md:
+            fail("markdown rendering missing from /fleet?format=md")
+
+        # -- inject a latency level shift, flag it BEFORE anyone exits -----
+        # A separate metrics file keeps the injection deterministic (no
+        # race against the live serving writer): 20 clean rows give the
+        # detector history, then a sustained 10x shift.
+        injected = os.path.join(telemetry, "metrics.injected.1.jsonl")
+        with open(injected, "w") as f:
+            for _ in range(20):
+                f.write(json.dumps({"latency": {"p95_ms": 5.0}}) + "\n")
+        time.sleep(1.0)  # let the tailer consume the clean history first
+        with open(injected, "a") as f:
+            for _ in range(6):
+                f.write(json.dumps({"latency": {"p95_ms": 50.0}}) + "\n")
+        n_live = 0
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            _, body = get_json(host, oport, "/fleet")
+            n_live = body.get("n_live_anomalies", 0)
+            if n_live:
+                break
+            time.sleep(0.2)
+        if not n_live:
+            fail("streaming detector never flagged the injected shift")
+        streams = [s for s in body.get("streams", [])
+                   if s["n_anomalies"]]
+        if not any(s["file"].endswith("metrics.injected.1.jsonl")
+                   and "latency" in s["metric"] for s in streams):
+            fail(f"anomalies on the wrong stream: "
+                 f"{[(s['file'], s['metric']) for s in streams]}")
+        if serving.poll() is not None or obs.poll() is not None:
+            fail("a fleet process exited before the live-shift assertion")
+        print(f"obs_live_smoke: injected shift flagged live "
+              f"({n_live} anomalous point(s)) with the fleet still up")
+    finally:
+        stop(serving, "serving process")
+        stop(obs, "obs driver")
+    if serving.returncode != 0:
+        tail = serving.stdout.read().decode("utf-8", "replace")[-3000:]
+        fail(f"serving process exited {serving.returncode}:\n{tail}")
+    if obs.returncode != 0:
+        tail = obs.stdout.read().decode("utf-8", "replace")[-3000:]
+        fail(f"obs driver exited {obs.returncode}:\n{tail}")
+    # The observer leaves its own shards behind for the post-hoc report
+    # (written to its --telemetry-dir, the run root it was watching).
+    names = os.listdir(td)
+    if not any(n.startswith("registry.obs.") for n in names):
+        fail(f"obs driver left no registry shard: {sorted(names)}")
+    print("obs_live_smoke: clean SIGTERM stops, obs shards on disk")
+    print("obs_live_smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,213 @@
+"""Streaming fleet view (photon_tpu/obs/live.py — ISSUE 18).
+
+The live-edge contract: the streaming median/MAD detector flags exactly
+the points the batch detector flags (same indices, same rounded rows);
+the JSONL tailer consumes only complete lines and never re-reads; shard
+re-merges stay idempotent; and ``GET /fleet`` serves the refreshed state
+(JSON and rendered markdown) while the sources are still growing.
+"""
+import json
+import os
+import random
+import time
+import urllib.request
+
+from photon_tpu.obs import fleet
+from photon_tpu.obs.analysis.report import detect_level_shifts
+from photon_tpu.obs.live import (
+    LiveFleetServer,
+    LiveFleetWatcher,
+    StreamingDetector,
+)
+from photon_tpu.obs.metrics import MetricsRegistry
+
+
+def _write_rows(path, values, mode="a"):
+    with open(path, mode) as f:
+        for v in values:
+            f.write(json.dumps({"latency": {"p95_ms": v}}) + "\n")
+
+
+# ------------------------------------------------------------- detector
+
+
+def test_streaming_detector_matches_batch_exactly():
+    rng = random.Random(13)
+    for trial in range(50):
+        n = rng.randrange(3, 90)
+        vals = [20 + rng.random() for _ in range(n)]
+        if n > 15 and trial % 2:
+            shift_at = rng.randrange(10, n)
+            for i in range(shift_at, n):
+                vals[i] += rng.choice([40.0, 200.0])
+        batch = detect_level_shifts(vals)
+        det = StreamingDetector()
+        streamed = []
+        for v in vals:
+            streamed.extend(det.push(v))
+        assert streamed == batch, f"trial {trial}: {streamed} != {batch}"
+        assert det.anomalies == batch
+
+
+def test_streaming_detector_flags_run_not_lone_spike():
+    det = StreamingDetector(min_history=4, min_run=2)
+    for _ in range(10):
+        assert det.push(10.0) == []
+    # A lone spike buffers but does not flag...
+    assert det.push(500.0) == []
+    # ...until a second consecutive breach completes the run — then BOTH
+    # points flag at once, the same indices the batch pass would emit.
+    flagged = det.push(500.0)
+    assert [f["index"] for f in flagged] == [10, 11]
+    # And each further point of the sustained shift flags incrementally.
+    assert [f["index"] for f in det.push(500.0)] == [12]
+
+
+def test_streaming_detector_flags_across_push_boundary():
+    """The run buffer must survive between ticks: first breach arrives in
+    one tick, second in the next."""
+    det = StreamingDetector(min_history=4, min_run=2)
+    for _ in range(8):
+        det.push(5.0)
+    assert det.push(99.0) == []          # tick N: run of one, quiet
+    flagged = det.push(99.0)             # tick N+1: run completes
+    assert [f["index"] for f in flagged] == [8, 9]
+
+
+# ------------------------------------------------------------ the tailer
+
+
+def test_watcher_tails_only_complete_lines(tmp_path):
+    d = str(tmp_path)
+    mpath = os.path.join(d, "metrics.serving.1.jsonl")
+    _write_rows(mpath, [5.0] * 12, mode="w")
+    w = LiveFleetWatcher(d, min_history=4)
+    s = w.tick()
+    assert s["detector"]["new_points_this_tick"] == 12
+    assert s["n_live_anomalies"] == 0
+    # A torn tail (no newline) must wait; completing it later must not
+    # re-read the rows before it.
+    with open(mpath, "a") as f:
+        f.write(json.dumps({"latency": {"p95_ms": 5.0}}) + "\n")
+        f.write('{"latency": {"p95_ms"')
+    s = w.tick()
+    assert s["detector"]["new_points_this_tick"] == 1
+    with open(mpath, "a") as f:
+        f.write(': 5.0}}\n')
+    s = w.tick()
+    assert s["detector"]["new_points_this_tick"] == 1
+
+
+def test_watcher_flags_injected_shift_between_ticks(tmp_path):
+    d = str(tmp_path)
+    mpath = os.path.join(d, "metrics.serving.7.jsonl")
+    _write_rows(mpath, [8.0 + (i % 3) * 0.2 for i in range(20)], mode="w")
+    w = LiveFleetWatcher(d)
+    assert w.tick()["n_live_anomalies"] == 0
+    _write_rows(mpath, [120.0] * 4)
+    s = w.tick()
+    assert s["n_live_anomalies"] >= 2
+    anoms = s["live_anomalies_this_tick"]
+    assert {a["metric"] for a in anoms} == {"latency.p95_ms"}
+    assert all(a["file"] == "metrics.serving.7.jsonl" for a in anoms)
+    # The detector state carries anomaly history for /fleet's stream rows.
+    stream = [r for r in s["streams"] if r["metric"] == "latency.p95_ms"][0]
+    assert stream["n_anomalies"] == s["n_live_anomalies"]
+
+
+def test_watcher_shard_remerge_is_idempotent(tmp_path):
+    d = str(tmp_path)
+    reg = MetricsRegistry()
+    reg.counter("reqs", "t").inc(4)
+    shard = os.path.join(d, "registry.serving.9.json")
+    fleet.write_registry_shard(shard, registries=(reg,), role="serving")
+    w = LiveFleetWatcher(d)
+    assert w.tick()["registry"]["reqs"] == 4
+    # Live re-export (the serving flush loop does this every interval):
+    # the same shard_id folds as a delta, counts must not double.
+    reg.counter("reqs", "t").inc(1)
+    fleet.write_registry_shard(shard, registries=(reg,), role="serving")
+    s = w.tick()
+    assert s["registry"]["reqs"] == 5
+    assert s["roles"] == ["serving"]
+
+
+def test_watcher_survives_bad_artifacts(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "registry.broken.1.json"), "w") as f:
+        f.write("{not json")
+    _write_rows(os.path.join(d, "metrics.serving.4.jsonl"),
+                [3.0] * 6, mode="w")
+    w = LiveFleetWatcher(d)
+    s = w.tick()  # must not raise, and the healthy sources still fold
+    assert s["ticks"] == 1
+    assert s["detector"]["new_points_this_tick"] == 6
+    assert s["shard_warnings"]  # the corrupt shard is loud, not silent
+
+
+# --------------------------------------------------------------- /fleet
+
+
+def test_fleet_endpoint_serves_live_state(tmp_path):
+    d = str(tmp_path)
+    _write_rows(os.path.join(d, "metrics.serving.3.jsonl"),
+                [5.0] * 16, mode="w")
+    reg = MetricsRegistry()
+    reg.counter("reqs", "t").inc(2)
+    fleet.write_registry_shard(os.path.join(d, "registry.serving.3.json"),
+                               registries=(reg,), role="serving")
+    srv = LiveFleetServer(d, port=0, interval_s=0.2)
+    srv.start()
+    try:
+        deadline = time.time() + 10
+        while not srv.watcher.ticks and time.time() < deadline:
+            time.sleep(0.02)
+        host, port = srv.address
+        body = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/fleet", timeout=10).read())
+        assert body["schema"] == "photon-fleet-live/1"
+        assert body["roles"] == ["serving"]
+        assert body["report"]["schema"].startswith("photon-fleet-report")
+        md = urllib.request.urlopen(
+            f"http://{host}:{port}/fleet?format=md", timeout=10
+        ).read().decode()
+        assert "# Live fleet view" in md
+        hz = json.loads(urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=10).read())
+        assert hz["status"] == "ok" and hz["ticks"] >= 1
+        prom = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics?prom=1", timeout=10
+        ).read().decode()
+        assert "photon_reqs" in prom
+        # The injected shift shows up on /fleet within a few intervals,
+        # while the source file keeps growing.
+        _write_rows(os.path.join(d, "metrics.serving.3.jsonl"),
+                    [300.0] * 4)
+        deadline = time.time() + 10
+        n = 0
+        while time.time() < deadline:
+            body = json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/fleet", timeout=10).read())
+            n = body["n_live_anomalies"]
+            if n:
+                break
+            time.sleep(0.05)
+        assert n >= 2
+    finally:
+        srv.shutdown()
+
+
+def test_obs_driver_smoke_entry(tmp_path):
+    from photon_tpu.cli import obs_driver
+
+    d = str(tmp_path / "t")
+    os.makedirs(d)
+    _write_rows(os.path.join(d, "metrics.serving.2.jsonl"),
+                [4.0] * 10, mode="w")
+    out = obs_driver.run(["--telemetry-dir", d, "--port", "0"],
+                         serve_forever=False)
+    assert out["telemetry_dir"] == os.path.abspath(d)
+    assert out["n_live_anomalies"] == 0
+    # The driver contributes its own shards to the dir it watches.
+    names = sorted(os.listdir(d))
+    assert any(n.startswith("registry.obs.") for n in names)

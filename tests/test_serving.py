@@ -1294,3 +1294,121 @@ def test_sigterm_drain_finishes_inflight_and_flushes(trained, tmp_path):
         rows = [json.loads(line) for line in f if line.strip()]
     assert rows, "shutdown must flush a final metrics snapshot"
     assert rows[-1]["requests"] >= 4
+
+
+# --------------------------------------------- latency waterfall (ISSUE 18)
+
+
+def _post_raw(host, port, path, payload, headers=()):
+    """Like _post but returns the response headers too — the timing
+    breakdown rides a header, not the JSON body."""
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("POST", path, body=json.dumps(payload).encode(),
+                 headers={"Content-Type": "application/json",
+                          **dict(headers)})
+    resp = conn.getresponse()
+    body = json.loads(resp.read())
+    hdrs = dict(resp.getheaders())
+    conn.close()
+    return resp.status, body, hdrs
+
+
+def test_timing_header_returns_stage_waterfall(trained):
+    """ISSUE 18: opt-in X-Photon-Timing returns a Server-Timing-style
+    per-stage breakdown, the per-stage labeled histogram fills on every
+    success, and the stages sum to (at most) the measured total."""
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=2.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    rec = next(iter(read_records(str(d / "val.avro"))))
+    try:
+        # Without the opt-in header, no timing header comes back.
+        status, _, hdrs = _post_raw(host, port, "/score", _payload(rec))
+        assert status == 200
+        assert "X-Photon-Timing" not in hdrs
+        status, _, hdrs = _post_raw(host, port, "/score", _payload(rec),
+                                    headers={"X-Photon-Timing": "1"})
+        assert status == 200
+        breakdown = hdrs["X-Photon-Timing"]
+        parts = {}
+        for item in breakdown.split(","):
+            name, _, dur = item.strip().partition(";dur=")
+            parts[name] = float(dur)
+        for stage in ("admission", "queue_wait", "batch_assembly",
+                      "store_resolve", "kernel", "response", "total"):
+            assert stage in parts, (stage, breakdown)
+            assert parts[stage] >= 0.0
+        staged = sum(v for k, v in parts.items() if k != "total")
+        assert staged == pytest.approx(parts["total"], abs=0.5)
+        # The same stages land in the registry's labeled histogram —
+        # p95 queue-wait vs p95 kernel is one scrape.
+        hist = server.metrics.histogram("serve_stage_latency_seconds")
+        for stage in ("admission", "queue_wait", "batch_assembly",
+                      "store_resolve", "kernel", "response"):
+            assert hist.child(stage=stage).snapshot()["count"] >= 2, stage
+        prom = server.metrics.to_prometheus()
+        assert 'stage="queue_wait"' in prom and 'stage="kernel"' in prom
+    finally:
+        server.shutdown()
+
+
+def test_tail_sampler_promotes_through_real_request_path(trained):
+    """ISSUE 18 satellite: no promoted-span loss across the batcher
+    thread boundary on the REAL server path — a promoted request's span
+    set must include both the server-side request span and the
+    queue-wait span completed on the batcher worker thread."""
+    from photon_tpu.obs import (
+        TailSampler,
+        install_tail_sampler,
+        tracing,
+        uninstall_tail_sampler,
+    )
+
+    d, (m1, _), _ = trained
+    registry = ModelRegistry(
+        m1, ServingConfig(max_batch=8, cache_entities=16, max_row_nnz=32))
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=1.0)
+    server = ScoringServer(registry, batcher, port=0)
+    server.start()
+    host, port = server.address
+    recs = list(read_records(str(d / "val.avro")))[:8]
+    sampler = TailSampler(min_history=4, quantile=0.5)
+    install_tail_sampler(sampler)
+    try:
+        with tracing() as col:
+            for i in range(30):
+                status, _ = _post(host, port, "/score",
+                                  _payload(recs[i % len(recs)]))
+                assert status == 200
+        snap = sampler.snapshot()
+        assert snap["inflight"] == 0
+        assert snap["promoted"] >= 1
+        # Not everything promotes: the boring half was discarded.
+        assert snap["discarded"] >= 1
+        marks = [e for e in col.events
+                 if e["name"] == "photon.trace.tail_promoted"]
+        assert len(marks) == snap["promoted"]
+        tid = marks[-1]["args"]["trace_id"]
+
+        def spans_of(tid):
+            out = []
+            for e in col.events:
+                if e["ph"] != "X":
+                    continue
+                a = e.get("args", {})
+                if a.get("trace_id") == tid or tid in (
+                        a.get("trace_ids") or ()):
+                    out.append(e["name"])
+            return sorted(set(out))
+
+        names = spans_of(tid)
+        assert "serve.request" in names            # server thread
+        assert "serve.queue_wait" in names         # batcher thread
+        assert "serve.score" in names or "serve.batch" in names
+    finally:
+        uninstall_tail_sampler()
+        server.shutdown()

@@ -1,0 +1,326 @@
+"""Tail-based trace sampling (photon_tpu/obs/trace.py — ISSUE 18).
+
+Coverage per the satellite checklist: the in-flight ring buffer stays
+bounded under concurrent requests; promotion fires on a rolling-threshold
+breach and on error (and NOT on a uniform-latency workload); spans
+completed on a different thread than the request edge — the batcher
+boundary — survive promotion intact, including shared batch-level spans
+emitted exactly once; and promoted spans still honor the collector's
+trace-size bound. Plus the per-stage labeled-histogram waterfall these
+spans feed (docs/serving.md §"Latency waterfall").
+"""
+import json
+import threading
+
+import pytest
+
+from photon_tpu.obs import (
+    MetricsRegistry,
+    TailSampler,
+    install_tail_sampler,
+    new_trace_id,
+    tail_sampler,
+    trace_context,
+    trace_span,
+    tracing,
+    uninstall_tail_sampler,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_sampler():
+    uninstall_tail_sampler()
+    yield
+    uninstall_tail_sampler()
+
+
+def _request(sampler, col, duration_s, error=False, n_spans=2):
+    """One synthetic request: begin → emit spans under its trace id →
+    finish with a verdict. Returns the trace id."""
+    tid = new_trace_id()
+    sampler.begin(tid)
+    with trace_context(tid):
+        for i in range(n_spans):
+            with trace_span(f"serve.stage{i}", cat="serving"):
+                pass
+    return tid, sampler.finish(tid, duration_s, error=error)
+
+
+def _span_names(col, tid):
+    return sorted(e["name"] for e in col.events
+                  if e.get("args", {}).get("trace_id") == tid
+                  and e["ph"] == "X")
+
+
+# ------------------------------------------------------------ promotion
+
+
+def test_uniform_latency_workload_promotes_nothing():
+    s = TailSampler(min_history=4, quantile=0.5)
+    install_tail_sampler(s)
+    with tracing() as col:
+        for _ in range(20):
+            _, promoted = _request(s, col, 0.010)
+            assert not promoted
+    assert s.promoted == 0 and s.discarded == 20
+    # Every buffered span was diverted, none leaked into the collector.
+    assert not [e for e in col.events
+                if e["ph"] == "X" and e["name"].startswith("serve.")]
+
+
+def test_threshold_breach_promotes_full_span_set():
+    s = TailSampler(min_history=4, quantile=0.5)
+    install_tail_sampler(s)
+    with tracing() as col:
+        for _ in range(8):
+            _request(s, col, 0.010)
+        tid, promoted = _request(s, col, 0.500, n_spans=3)
+    assert promoted and s.promoted == 1
+    assert _span_names(col, tid) == ["serve.stage0", "serve.stage1",
+                                     "serve.stage2"]
+    marks = [e for e in col.events
+             if e["name"] == "photon.trace.tail_promoted"]
+    assert len(marks) == 1
+    assert marks[0]["args"]["trace_id"] == tid
+    assert marks[0]["args"]["reason"] == "latency"
+    assert marks[0]["args"]["spans"] == 3
+
+
+def test_error_promotes_regardless_of_latency():
+    s = TailSampler(min_history=4, quantile=0.5)
+    install_tail_sampler(s)
+    with tracing() as col:
+        # No history at all: a latency verdict is impossible, the error
+        # verdict must not be.
+        tid, promoted = _request(s, col, 0.001, error=True)
+    assert promoted and s.promoted_error == 1
+    assert _span_names(col, tid) == ["serve.stage0", "serve.stage1"]
+    mark = [e for e in col.events
+            if e["name"] == "photon.trace.tail_promoted"][0]
+    assert mark["args"]["reason"] == "error"
+
+
+def test_threshold_needs_min_history():
+    s = TailSampler(min_history=10, quantile=0.5)
+    assert s.threshold_s() is None
+    for _ in range(9):
+        s.finish(new_trace_id(), 0.010)
+    assert s.threshold_s() is None
+    s.finish(new_trace_id(), 0.010)
+    assert s.threshold_s() == pytest.approx(0.010)
+
+
+# ------------------------------------------------------------ the ring
+
+
+def test_ring_buffer_bound_and_fifo_eviction():
+    s = TailSampler(capacity=8, min_history=4)
+    install_tail_sampler(s)
+    with tracing():
+        tids = []
+        for _ in range(30):
+            tid = new_trace_id()
+            s.begin(tid)
+            tids.append(tid)
+        assert s.snapshot()["inflight"] == 8
+        assert s.evicted == 22
+        # The survivors are the MOST RECENT begins (FIFO eviction), and
+        # an evicted request's finish is a no-op, not a promotion.
+        for _ in range(6):
+            s.finish(new_trace_id(), 0.010)
+        # An evicted request's finish feeds the window but can never
+        # promote (its spans are gone) — a surviving one still can.
+        assert not s.finish(tids[0], 0.010)
+        assert s.finish(tids[-1], 99.0)
+
+
+def test_ring_stays_bounded_under_concurrent_requests():
+    s = TailSampler(capacity=16, min_history=4, quantile=0.5)
+    install_tail_sampler(s)
+    errors = []
+
+    def client(wid):
+        try:
+            for i in range(50):
+                tid = new_trace_id()
+                s.begin(tid)
+                with trace_context(tid):
+                    with trace_span("serve.request", cat="serving"):
+                        pass
+                s.finish(tid, 0.001 * ((wid + i) % 7))
+        except Exception as e:  # noqa: BLE001 - surfaced after join
+            errors.append(e)
+
+    with tracing():
+        threads = [threading.Thread(target=client, args=(w,))
+                   for w in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors
+    snap = s.snapshot()
+    assert snap["inflight"] == 0
+    assert snap["promoted"] + snap["discarded"] + snap["evicted"] == 400
+
+
+def test_span_overflow_counted_not_unbounded():
+    s = TailSampler(min_history=2, max_spans_per_request=4)
+    install_tail_sampler(s)
+    with tracing():
+        tid = new_trace_id()
+        s.begin(tid)
+        with trace_context(tid):
+            for i in range(10):
+                with trace_span(f"serve.s{i}", cat="serving"):
+                    pass
+        s.finish(tid, 1.0, error=True)
+    assert s.span_overflow == 6
+    assert s.promoted == 1
+
+
+# ----------------------------------------------- thread boundary + batch
+
+
+def test_promoted_spans_survive_batcher_thread_boundary():
+    """Spans completed on a WORKER thread (the micro-batcher) under the
+    request's trace id must ride the same promotion as the request
+    edge's own spans."""
+    s = TailSampler(min_history=4, quantile=0.5)
+    install_tail_sampler(s)
+    with tracing() as col:
+        for _ in range(8):
+            _request(s, col, 0.010)
+        tid = new_trace_id()
+        s.begin(tid)
+
+        def batcher_side():
+            # No ambient trace_context on this thread — the explicit
+            # trace_id arg is the propagation, exactly like
+            # MicroBatcher's queue-wait/score spans.
+            with trace_span("serve.queue_wait", cat="serving",
+                            trace_id=tid):
+                pass
+
+        t = threading.Thread(target=batcher_side)
+        t.start()
+        t.join()
+        with trace_context(tid):
+            with trace_span("serve.request", cat="serving"):
+                pass
+        assert s.finish(tid, 0.500)
+    assert _span_names(col, tid) == ["serve.queue_wait", "serve.request"]
+
+
+def test_shared_batch_span_promoted_exactly_once():
+    """A batch-level span carries trace_ids of every member; when two
+    members both promote, the shared span must emit once."""
+    s = TailSampler(min_history=4, quantile=0.5)
+    install_tail_sampler(s)
+    with tracing() as col:
+        for _ in range(8):
+            _request(s, col, 0.010)
+        a, b = new_trace_id(), new_trace_id()
+        s.begin(a)
+        s.begin(b)
+        with trace_span("serve.batch", cat="serving", rows=2,
+                        trace_ids=[a, b]):
+            pass
+        assert s.finish(a, 0.400)
+        assert s.finish(b, 0.500)
+    batch = [e for e in col.events if e["name"] == "serve.batch"]
+    assert len(batch) == 1
+    assert sorted(batch[0]["args"]["trace_ids"]) == sorted([a, b])
+
+
+# ------------------------------------------------------------- size bound
+
+
+def test_promotion_honors_collector_size_bound(monkeypatch):
+    monkeypatch.setenv("PHOTON_TRACE_MAX_BYTES", "2000")
+    s = TailSampler(min_history=4, quantile=0.5)
+    install_tail_sampler(s)
+    with tracing() as col:
+        for i in range(40):
+            # Escalating durations: each breaches the rolling threshold,
+            # so promotion pressure keeps hitting the byte bound.
+            _request(s, col, 0.010 * (i + 1), n_spans=3)
+    assert s.promoted > 5
+    assert col._approx_bytes <= 2000
+    assert col.dropped > 0
+
+
+def test_env_install_and_explicit_precedence(monkeypatch):
+    from photon_tpu.obs import start_tracing, stop_tracing
+
+    monkeypatch.setenv("PHOTON_TRACE_TAIL", "1")
+    monkeypatch.setenv("PHOTON_TRACE_TAIL_QUANTILE", "0.75")
+    monkeypatch.setenv("PHOTON_TRACE_TAIL_WINDOW", "32")
+    start_tracing()
+    try:
+        s = tail_sampler()
+        assert s is not None
+        assert s.quantile == 0.75
+    finally:
+        stop_tracing()
+        uninstall_tail_sampler()
+    # Malformed knobs degrade to defaults, never kill tracing.
+    monkeypatch.setenv("PHOTON_TRACE_TAIL_QUANTILE", "banana")
+    start_tracing()
+    try:
+        assert tail_sampler().quantile == 0.95
+    finally:
+        stop_tracing()
+        uninstall_tail_sampler()
+    # An explicitly installed sampler wins over the env default.
+    mine = TailSampler(quantile=0.5)
+    install_tail_sampler(mine)
+    start_tracing()
+    try:
+        assert tail_sampler() is mine
+    finally:
+        stop_tracing()
+
+
+# --------------------------------------------- stage waterfall histogram
+
+
+def test_labeled_histogram_children_and_exposition():
+    reg = MetricsRegistry()
+    h = reg.histogram("serve_stage_latency_seconds", "waterfall")
+    for ms, stage in ((1, "queue_wait"), (2, "queue_wait"), (50, "kernel")):
+        h.observe(ms / 1e3, stage=stage)
+    snap = h.snapshot_value()
+    assert snap["queue_wait"]["count"] == 2
+    assert snap["kernel"]["count"] == 1
+    assert snap["kernel"]["p50_ms"] > snap["queue_wait"]["p50_ms"]
+    prom = reg.to_prometheus()
+    assert 'quantile="0.95",stage="queue_wait"' in prom
+    assert 'photon_serve_stage_latency_seconds_count{stage="kernel"} 1' \
+        in prom
+
+
+def test_labeled_histogram_merges_and_deltas_across_shards():
+    src = MetricsRegistry()
+    h = src.histogram("lat", "labeled")
+    h.observe(0.001, stage="kernel")
+    h.observe(0.002, stage="queue_wait")
+    agg = MetricsRegistry()
+    agg.merge(src.dump_state(), anchor=1.0, shard_id="s1")
+    first = agg.histogram("lat").snapshot_value()
+    assert first["kernel"]["count"] == 1
+    # Shard re-export after more samples: the delta fold must land ONLY
+    # the new observations (idempotent re-merge contract).
+    h.observe(0.003, stage="kernel")
+    agg.merge(src.dump_state(), anchor=2.0, shard_id="s1")
+    agg.merge(src.dump_state(), anchor=2.0, shard_id="s1")  # idempotent
+    merged = agg.histogram("lat").snapshot_value()
+    assert merged["kernel"]["count"] == 2
+    assert merged["queue_wait"]["count"] == 1
+
+
+def test_labeled_histogram_round_trips_registry_snapshot():
+    reg = MetricsRegistry()
+    reg.histogram("lat", "labeled").observe(0.004, stage="kernel")
+    snap = json.loads(json.dumps(reg.snapshot()))  # JSON-serializable
+    assert snap["lat"]["kernel"]["count"] == 1
