@@ -1404,6 +1404,353 @@ def bench_serve_replicated():
     return out
 
 
+def bench_serve_frontline():
+    """Same-box A/B of the two serving front ends (docs/serving.md
+    §"Front line"): the threaded single-process JSON server vs the
+    multi-process async front line (N jax-free workers, binary wire
+    encoding, one device-owning scorer over shared-memory rings), both
+    driven with identical Zipf-skewed closed-loop volleys at the PR 18
+    legs (s=0.0 uniform, s=1.2 hot-set). Then an OPEN-loop saturation
+    ramp against the front line: offered load rises until p99 (measured
+    from the request's SCHEDULED send time, so coordinated omission
+    can't flatter the tail) breaches the SLO — the last compliant step
+    is the knee, stamped as flat SLO-gateable keys. The histogram
+    autotuner runs live throughout; its final (batch, deadline) choice
+    lands in the artifact. On a box with fewer cores than processes the
+    A/B ratio compresses by construction — host_cpu_count is stamped so
+    the figure filters honestly (the game_scale_mesh convention)."""
+    import http.client
+    import tempfile
+    import threading
+
+    from photon_tpu.estimators.config import (
+        FixedEffectDataConfig,
+        GLMOptimizationConfiguration,
+        RandomEffectDataConfig,
+    )
+    from photon_tpu.estimators.game_estimator import GameEstimator
+    from photon_tpu.estimators.game_transformer import SCORE_KERNEL_NAME
+    from photon_tpu.index.index_map import (
+        DefaultIndexMap,
+        build_mmap_index,
+        feature_key,
+    )
+    from photon_tpu.io.data_reader import FeatureShardConfig
+    from photon_tpu.io.model_io import save_game_model
+    from photon_tpu.obs import retrace, suspend_tracing
+    from photon_tpu.optim import RegularizationContext, RegularizationType
+    from photon_tpu.serving import (
+        MicroBatcher,
+        ModelRegistry,
+        ScoringServer,
+        ServingConfig,
+        wire,
+    )
+    from photon_tpu.serving.autotune import BatchAutotuner
+    from photon_tpu.serving.frontline import FrontLine, pick_port
+    from photon_tpu.types import TaskType
+
+    n_users, rows_per_user, d_global, d_user = (
+        (48, 8, 128, 4) if SMOKE else (128, 8, 256, 4))
+    n_leg = 120 if SMOKE else 768
+    conc = 4 if SMOKE else 8
+    n_workers = 2
+    skews = (0.0, 1.2)
+    sat_slo_ms = float(os.environ.get("PHOTON_BENCH_SAT_SLO_MS", "150"))
+    bundle = _game_bundle(n_users, rows_per_user, d_global, d_user)
+    estimator = GameEstimator(
+        task=TaskType.LOGISTIC_REGRESSION,
+        coordinate_data_configs={
+            "fixed": FixedEffectDataConfig("global"),
+            "perUser": RandomEffectDataConfig(re_type="userId",
+                                              feature_shard="global"),
+        },
+        n_sweeps=1,
+    )
+    gcfg = {
+        "fixed": GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=10),
+        "perUser": GLMOptimizationConfiguration(
+            regularization=RegularizationContext(RegularizationType.L2),
+            reg_weight=1.0, max_iterations=10),
+    }
+    model = estimator.fit(bundle, None, [gcfg])[0].model
+
+    feats = bundle.features["global"]
+    dim = feats.dim
+    fidx, fval = np.asarray(feats.idx), np.asarray(feats.val)
+    users = bundle.id_tags["userId"]
+    payloads = []
+    by_user: dict = {}
+    for r in range(min(256, bundle.n_rows)):
+        by_user.setdefault(str(users[r]), []).append(len(payloads))
+        payloads.append(json.dumps({
+            "features": [
+                {"name": "c", "term": str(int(c)), "value": float(v)}
+                for c, v in zip(fidx[r], fval[r]) if c < dim
+            ],
+            "entities": {"userId": str(users[r])},
+        }).encode())
+    zipf_users = sorted(by_user)
+    rng = np.random.default_rng(23)
+
+    def zipf_indices(s: float, n: int) -> list:
+        w = 1.0 / np.power(np.arange(1, len(zipf_users) + 1), s)
+        ranks = rng.choice(len(zipf_users), size=n, p=w / w.sum())
+        return [by_user[zipf_users[k]][
+            int(rng.integers(len(by_user[zipf_users[k]])))]
+            for k in ranks]
+
+    out: dict = {
+        "serve_frontline_host_cpu_count": os.cpu_count(),
+        "serve_frontline_workers": n_workers,
+        "serve_frontline_saturation_slo_p99_ms": sat_slo_ms,
+    }
+
+    def closed_volley(fire, reqs, warm) -> dict:
+        """Closed-loop leg: conc threads, keep-alive connections, the
+        identical request list; returns rows/sec + client p50/p95/p99."""
+        for body in warm:
+            fire(None, body)
+        lat: list = []
+        lock = threading.Lock()
+        errors: list = []
+
+        def worker(wid: int) -> None:
+            try:
+                conn = fire("connect", None)
+                mine = []
+                for i in range(wid, len(reqs), conc):
+                    t0 = time.perf_counter()
+                    fire(conn, reqs[i])
+                    mine.append(time.perf_counter() - t0)
+                conn.close()
+                with lock:
+                    lat.extend(mine)
+            except Exception as e:  # noqa: BLE001 - re-raised after join
+                errors.append(e)
+
+        with suspend_tracing():
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=worker, args=(w,))
+                       for w in range(conc)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(f"frontline A/B worker failed: {errors[0]!r}")
+        lat.sort()
+        return {
+            "rows_per_sec": round(len(lat) / wall, 1),
+            "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+            "p95_ms": round(lat[min(len(lat) - 1,
+                                    int(0.95 * len(lat)))] * 1e3, 2),
+            "p99_ms": round(lat[min(len(lat) - 1,
+                                    int(0.99 * len(lat)))] * 1e3, 2),
+        }
+
+    with tempfile.TemporaryDirectory() as td:
+        mdir = os.path.join(td, "best")
+        imap = DefaultIndexMap(
+            [feature_key("c", str(j)) for j in range(dim)])
+        save_game_model(
+            mdir, model, {"global": imap},
+            shard_by_coordinate={"perUser": "global"},
+            shard_configs={"global": FeatureShardConfig(
+                ("features",), add_intercept=False)},
+        )
+        build_mmap_index(imap, os.path.join(td, "index", "global"))
+        cfg = ServingConfig(max_batch=32, max_wait_ms=1.0,
+                            cache_entities=max(64, n_users),
+                            max_row_nnz=32, max_queue=512)
+        registry = ModelRegistry(mdir, cfg)
+        batcher = MicroBatcher(max_batch=cfg.max_batch,
+                               max_wait_ms=cfg.max_wait_ms,
+                               max_queue=cfg.max_queue)
+        server = ScoringServer(registry, batcher, port=0)
+        server.start()
+        shost, sport = server.address
+
+        def fire_json(conn, body):
+            if conn is None:
+                conn = http.client.HTTPConnection(shost, sport, timeout=30)
+                conn.request("POST", "/score", body=body, headers={
+                    "Content-Type": "application/json"})
+                resp = conn.getresponse()
+                resp.read()
+                conn.close()
+                return None
+            if conn == "connect":
+                return http.client.HTTPConnection(shost, sport, timeout=30)
+            conn.request("POST", "/score", body=body, headers={
+                "Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"json leg returned {resp.status}")
+            return None
+
+        # ---- Leg A: the PR 15 threaded single-process JSON path.
+        for s in skews:
+            reqs = [payloads[i] for i in zipf_indices(s, n_leg)]
+            leg = closed_volley(fire_json, reqs, payloads[:8])
+            tag = f"{{s={s}}}"
+            for k, v in leg.items():
+                out[f"serve_frontline_json_{k}{tag}"] = v
+
+        # ---- Leg B: the front line — wire frames to async workers.
+        scorer = registry.current.scorer
+        frames = []
+        for r, body in enumerate(payloads):
+            p = scorer.parse_request(json.loads(body))
+            frames.append(wire.encode_score_request(
+                [wire.WireRow(shard_idx=p.shard_idx, shard_val=p.shard_val,
+                              offset=p.offset,
+                              entity_keys=p.entity_keys)],
+                req_id=r, store_generation=registry.store_generation))
+        tuner = BatchAutotuner(
+            batcher, server._stage_hist,
+            ladder_max=scorer._max_batch_cap,
+            cap_fn=lambda: registry.current.scorer._max_batch_cap,
+            tick_s=0.25, cooldown_s=2.0)
+        server.autotuner = tuner
+        fl = FrontLine(server, workers=n_workers, host="127.0.0.1",
+                       port=pick_port(), runtime_dir=os.path.join(td, "fl"),
+                       autotuner=tuner)
+        fl.start(ready_timeout_s=90.0)
+        fhost, fport = fl.address
+
+        def fire_wire(conn, body):
+            if conn is None:
+                conn = http.client.HTTPConnection(fhost, fport, timeout=30)
+                conn.request("POST", "/score", body=body, headers={
+                    "Content-Type": wire.WIRE_CONTENT_TYPE})
+                conn.getresponse().read()
+                conn.close()
+                return None
+            if conn == "connect":
+                return http.client.HTTPConnection(fhost, fport, timeout=30)
+            conn.request("POST", "/score", body=body, headers={
+                "Content-Type": wire.WIRE_CONTENT_TYPE})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"wire leg returned {resp.status}")
+            return None
+
+        try:
+            for body in frames[:8]:  # warm the worker/ring/scorer path
+                fire_wire(None, body)
+            retraces0 = retrace.retraces_after_warmup(SCORE_KERNEL_NAME)
+            for s in skews:
+                reqs = [frames[i] for i in zipf_indices(s, n_leg)]
+                leg = closed_volley(fire_wire, reqs, frames[:4])
+                tag = f"{{s={s}}}"
+                for k, v in leg.items():
+                    out[f"serve_frontline_wire_{k}{tag}"] = v
+                out[f"serve_frontline_ab_speedup{tag}"] = round(
+                    out[f"serve_frontline_wire_rows_per_sec{tag}"]
+                    / max(1e-9,
+                          out[f"serve_frontline_json_rows_per_sec{tag}"]),
+                    3)
+            out["serve_frontline_rows_per_sec"] = out[
+                "serve_frontline_wire_rows_per_sec{s=0.0}"]
+
+            # ---- Open-loop saturation ramp (ISSUE 19 satellite): fixed
+            # offered rates, latency measured from the SCHEDULED send
+            # time; ramp until p99 breaches the SLO or errors appear.
+            sat_frames = [frames[i] for i in zipf_indices(0.0, 256)]
+            step_s = 0.8 if SMOKE else 1.5
+            max_steps = 4 if SMOKE else 7
+            rate = max(20.0, 0.5 * out["serve_frontline_rows_per_sec"])
+            knee = None
+            ramp = []
+            for _step in range(max_steps):
+                n_sat = max(conc, int(rate * step_s))
+                sched = [i / rate for i in range(n_sat)]
+                slat: list = []
+                serrs: list = []
+                lock = threading.Lock()
+
+                def sat_worker(wid: int) -> None:
+                    try:
+                        conn = fire_wire("connect", None)
+                        mine = []
+                        for i in range(wid, n_sat, conc):
+                            delay = (sat_t0 + sched[i]
+                                     - time.perf_counter())
+                            if delay > 0:
+                                time.sleep(delay)
+                            fire_wire(conn, sat_frames[i % len(sat_frames)])
+                            mine.append(time.perf_counter()
+                                        - (sat_t0 + sched[i]))
+                        conn.close()
+                        with lock:
+                            slat.extend(mine)
+                    except Exception as e:  # noqa: BLE001 - breach signal
+                        serrs.append(e)
+
+                with suspend_tracing():
+                    sat_t0 = time.perf_counter()
+                    threads = [threading.Thread(target=sat_worker,
+                                                args=(w,))
+                               for w in range(conc)]
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    sat_wall = time.perf_counter() - sat_t0
+                if not slat:
+                    break
+                slat.sort()
+                p99 = slat[min(len(slat) - 1, int(0.99 * len(slat)))] * 1e3
+                achieved = round(len(slat) / sat_wall, 1)
+                step = {"offered_rps": round(rate, 1),
+                        "achieved_rps": achieved,
+                        "p99_ms": round(p99, 2),
+                        "errors": len(serrs)}
+                ramp.append(step)
+                if serrs or p99 > sat_slo_ms:
+                    break  # breached: the PREVIOUS step is the knee
+                knee = step
+                rate *= 1.35
+            out["serve_frontline_saturation_ramp"] = ramp
+            breached = bool(ramp) and (ramp[-1]["errors"] > 0
+                                       or ramp[-1]["p99_ms"] > sat_slo_ms)
+            out["serve_frontline_saturated"] = breached
+            if knee is not None:
+                out["serve_saturation_rows_per_sec"] = knee["achieved_rps"]
+                out["serve_saturation_knee_offered_rps"] = knee[
+                    "offered_rps"]
+                out["serve_saturation_knee_p99_ms"] = knee["p99_ms"]
+            else:
+                # Even the gentlest step breached: stamp the breach point
+                # so the gate sees a number, flagged as pre-knee.
+                out["serve_saturation_rows_per_sec"] = ramp[0][
+                    "achieved_rps"] if ramp else None
+                out["serve_saturation_knee_offered_rps"] = None
+                out["serve_saturation_knee_p99_ms"] = (
+                    ramp[0]["p99_ms"] if ramp else None)
+
+            out["serve_frontline_retraces_after_warmup"] = int(
+                retrace.retraces_after_warmup(SCORE_KERNEL_NAME)
+                - retraces0)
+            tsnap = tuner.snapshot()
+            out["serve_frontline_autotuned_max_batch"] = tsnap[
+                "current"]["max_batch"]
+            out["serve_frontline_autotuned_max_wait_ms"] = tsnap[
+                "current"]["max_wait_ms"]
+            out["serve_frontline_autotune_actions"] = len(
+                tsnap.get("actions") or ())
+        finally:
+            fl.stop()
+            server.shutdown()
+    return out
+
+
 def bench_online():
     """Online incremental learning round-trip (docs/online.md): train a
     small GAME model, serve it, then stream labeled events through the
@@ -3387,6 +3734,7 @@ def main():
         ("game", bench_game),
         ("serve", bench_serve),
         ("serve_replicated", bench_serve_replicated),
+        ("serve_frontline", bench_serve_frontline),
         ("online", bench_online),
         ("recovery", bench_recovery),
         ("control", bench_control),
@@ -3401,6 +3749,7 @@ def main():
             "game": "game_samples_per_sec",
             "serve": "serve_rows_per_sec",
             "serve_replicated": "serve_replica_scaling",
+            "serve_frontline": "serve_frontline_rows_per_sec",
             "online": "online_freshness_p50_ms",
             "recovery": "recovery_restart_to_first_step_seconds",
             "control": "control_time_to_mitigate_ms",

@@ -133,6 +133,22 @@ echo "== replica smoke (delta-log fan-out, router kill window, rejoin-and-conver
 # topology with >= 1 publish->apply cross-process trace join.
 python scripts/replica_smoke.py
 
+echo "== front-line smoke (multi-worker kill + scorer loss under live load; docs/serving.md §Front line) =="
+# The multi-process serving front line against REAL process boundaries
+# and REAL kills: one serving driver in --workers mode (device-owning
+# scorer + 2 jax-free async workers on a shared REUSEPORT port, wired
+# over shm rings), scored continuously by a live-load thread. One worker
+# is SIGKILLed — the survivor must keep serving through the window,
+# /healthz must report the dead worker as a degraded reason, and the
+# supervisor must restart it journaled. Then the SCORER is SIGKILLed
+# (device loss takes the device-owning process): the orphaned workers
+# must exit rather than squat the port, and a restarted driver over the
+# same output dir must journal the recovery and serve again. Then the
+# books: worker exits/joins across both scorer incarnations in the
+# recovery journal, and the fleet report rendering BOTH roles with a
+# registry shard per worker process.
+python scripts/frontline_smoke.py
+
 echo "== control smoke (canary promote/rollback + anomaly mitigation; docs/control.md) =="
 # The closed-loop control plane against REAL process boundaries: trainer,
 # online trainer publishing into the canary SIDE-CHANNEL log, a canary

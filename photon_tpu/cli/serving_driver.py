@@ -87,6 +87,26 @@ def build_arg_parser() -> argparse.ArgumentParser:
                         "jumps to the log's latest full-snapshot marker "
                         "via prepare_standby/swap instead of replaying "
                         "(0 disables snapshot catch-up)")
+    # Front-line mode (docs/serving.md §"Front line"): multi-process
+    # serving box — N accelerator-free async workers share --port via
+    # SO_REUSEPORT and feed THIS process (the single device owner) over
+    # lock-free shared-memory rings carrying binary wire frames. The
+    # in-process HTTP server stays up on an ephemeral port as the box's
+    # admin plane (/admin/swap, /admin/patch, /metrics).
+    p.add_argument("--workers", type=int, default=0,
+                   help="front-end worker processes; 0 = classic "
+                        "single-process threaded server")
+    p.add_argument("--ipc", choices=["auto", "shm", "socket"],
+                   default="auto",
+                   help="worker<->scorer transport: lock-free shared-"
+                        "memory rings or unix-socket fallback (auto "
+                        "probes /dev/shm)")
+    p.add_argument("--autotune", action="store_true",
+                   help="histogram-autotuned micro-batching: continuously "
+                        "re-choose (max_batch, max_wait_ms) from live "
+                        "serve_stage_latency_seconds deltas, damped with "
+                        "hysteresis + cooldown (docs/serving.md "
+                        "§'Autotuned batching')")
     p.add_argument("--metrics-interval", type=float, default=60.0,
                    help="seconds between JSONL metrics snapshots")
     p.add_argument("--slo-config",
@@ -260,7 +280,60 @@ def run(
         finish_trace(args.trace_out)
 
 
+def _build_frontline(args, server, public_port: int):
+    """Assemble (not start) the multi-process front line around a built
+    server: optional histogram autotuner + the worker supervisor."""
+    from photon_tpu.serving.autotune import BatchAutotuner
+    from photon_tpu.serving.frontline import FrontLine
+
+    tuner = None
+    if args.autotune:
+        scorer = server.registry.current.scorer
+        tuner = BatchAutotuner(
+            server.batcher,
+            server._stage_hist,
+            ladder_max=scorer._max_batch_cap,
+            # The cap moves with OOM downshifts and hot-swaps; resolve it
+            # through the registry at every tick, never cache it.
+            cap_fn=lambda: server.registry.current.scorer._max_batch_cap,
+            logger=server.logger,
+        )
+        server.autotuner = tuner
+    journal = None
+    if args.output_dir:
+        from photon_tpu.supervisor import RecoveryJournal
+
+        journal = RecoveryJournal(
+            os.path.join(args.output_dir, "recovery.jsonl"))
+    if args.output_dir:
+        runtime_dir = os.path.join(args.output_dir, "frontline")
+    else:
+        import tempfile
+
+        runtime_dir = tempfile.mkdtemp(prefix="photon-frontline-")
+    return FrontLine(
+        server,
+        workers=args.workers,
+        host=args.host,
+        port=public_port,
+        runtime_dir=runtime_dir,
+        transport=args.ipc,
+        autotuner=tuner,
+        telemetry_dir=getattr(args, "telemetry_dir", None),
+        journal=journal,
+        logger=server.logger,
+    )
+
+
 def _run(args, serve_forever: bool) -> dict:
+    frontline_port = None
+    if getattr(args, "workers", 0) > 0:
+        from photon_tpu.serving.frontline import pick_port
+
+        # Workers take the public port (SO_REUSEPORT); the in-process
+        # HTTP server drops to an ephemeral port as the admin plane.
+        frontline_port = args.port or pick_port(args.host)
+        args.port = 0
     server, plogger = build_server(args)
     v = server.registry.current
     summary = {
@@ -273,6 +346,17 @@ def _run(args, serve_forever: bool) -> dict:
 
     if server.replication is not None:
         summary["replica_id"] = server.replication.replica_id
+    fl = None
+    if frontline_port is not None:
+        fl = _build_frontline(args, server, frontline_port)
+        summary["address"] = [args.host, frontline_port]
+        summary["admin_address"] = list(server.address)
+        summary["frontline"] = {
+            "workers": args.workers,
+            "transport": fl.transport,
+            "runtime_dir": fl.runtime_dir,
+            "autotune": bool(args.autotune),
+        }
     if not serve_forever:
         server.shutdown()
         finish_telemetry(args, registries=(server.metrics,))
@@ -294,6 +378,14 @@ def _run(args, serve_forever: bool) -> dict:
     try:
         if server.replication is not None:
             server.replication.start()  # follow the log while serving
+        if fl is not None:
+            fl.start()
+            server.logger.info(
+                "front line: %d worker(s) on http://%s:%d (%s), admin "
+                "plane on http://%s:%d%s",
+                args.workers, args.host, frontline_port, fl.transport,
+                *server.address,
+                ", autotune on" if args.autotune else "")
         server.serve_forever()
     except KeyboardInterrupt:
         pass
@@ -302,6 +394,10 @@ def _run(args, serve_forever: bool) -> dict:
         # mid-teardown has no one left to serve it.
         if server.replication is not None:
             server.replication.stop()
+        # Workers first: they hold the public port and must stop taking
+        # traffic before the batcher they feed goes away.
+        if fl is not None:
+            fl.stop()
         server.shutdown()
         # Registry shard AFTER shutdown: the final flush's counters are
         # exactly what the fleet report should aggregate.

@@ -514,18 +514,24 @@ class TailSampler:
             return self._threshold_locked()
 
     def finish(self, trace_id: str, duration_s: float,
-               error: bool = False) -> bool:
+               error: bool = False, force: bool = False) -> bool:
         """Completion verdict for one request: promote its buffered spans
-        into the active collector (threshold breach or error) or discard
-        them. Always feeds the rolling window. Returns True iff
-        promoted."""
+        into the active collector (threshold breach, error, or ``force``)
+        or discard them. Always feeds the rolling window. Returns True
+        iff promoted.
+
+        ``force`` carries a promotion verdict made ELSEWHERE — on the
+        front line the scorer process judges its half of a request's
+        chain first and flags the response frame, and the worker forces
+        its half so the cross-process chain promotes as a unit
+        (docs/observability.md §"Tail sampling")."""
         with self._lock:
             spans = self._inflight.pop(trace_id, None)
             threshold = self._threshold_locked()
             self._durations.append(float(duration_s))
             # Strictly greater: a uniform-latency workload (everything ==
             # the p95) is the BORING case and must not promote 100%.
-            promote = bool(error) or (
+            promote = bool(error) or bool(force) or (
                 threshold is not None and duration_s > threshold)
             if not promote:
                 if spans is not None:

@@ -30,12 +30,14 @@ of the pool; 503 when no replica is reachable), ``GET /metrics`` (JSON,
 """
 from __future__ import annotations
 
+import http.client
 import json
 import math
 import random
 import threading
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Sequence
@@ -64,6 +66,8 @@ class _ReplicaState:
         self.model_version: Optional[int] = None
         self.last_check_ts: Optional[float] = None
         self.consecutive_failures = 0
+        # Keep-alive probe connection, owned by the health thread only.
+        self.conn: Optional[http.client.HTTPConnection] = None
 
     def snapshot(self) -> dict:
         return {
@@ -119,6 +123,9 @@ class RouterServer:
         self._upstream_err_c = self.metrics.counter(
             "router_upstream_errors_total",
             "connect failures / sheds per replica")
+        self._health_conn_c = self.metrics.counter(
+            "router_health_probes_total",
+            "health probes by transport (reused keep-alive vs new TCP)")
         self._latency = self.metrics.histogram(
             "router_request_latency_seconds",
             "end-to-end routed /score latency (successes)")
@@ -262,22 +269,61 @@ class RouterServer:
         for url, routable in states:
             self._drained_g.set(0.0 if routable else 1.0, replica=url)
 
+    def _health_fetch(self, r: _ReplicaState) -> tuple:
+        """``GET /healthz`` over the replica's cached keep-alive
+        connection; returns ``(status_code, body_bytes)``.
+
+        The sweep probes every replica every ``health_interval_s`` for
+        the router's whole life — a fresh TCP handshake per probe is
+        pure per-sweep overhead that, on a busy box, competes with
+        scoring traffic for accept cycles and keeps the
+        ``router_upstream_latency_seconds`` floor higher than it needs
+        to be. The connection lives on the replica state; concurrent
+        sweeps hand it off atomically. A REUSED socket that fails
+        mid-probe gets one fresh-connection retry (the upstream may have
+        idle-closed it between sweeps) before the failure counts; a
+        fresh socket failing is a real connect failure and raises.
+        """
+        last_exc: Optional[BaseException] = None
+        for _ in range(2):
+            with self._lock:
+                # Atomic take: tests drive check_replicas() concurrently
+                # with the health thread's initial sweep, and two probes
+                # sharing one socket would interleave their frames.
+                conn, r.conn = r.conn, None
+            reused = conn is not None
+            if conn is None:
+                u = urllib.parse.urlsplit(r.url)
+                conn = http.client.HTTPConnection(
+                    u.hostname, u.port, timeout=self.health_timeout_s)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                raw = resp.read()  # drain fully or the next probe desyncs
+            except _CONNECT_ERRORS + (http.client.HTTPException,) as e:
+                conn.close()
+                last_exc = e
+                if reused:
+                    continue  # retry once on a fresh socket
+                raise
+            if resp.will_close:
+                conn.close()
+            else:
+                with self._lock:
+                    if r.conn is None:
+                        r.conn = conn
+                    else:      # a concurrent probe already parked one
+                        conn.close()
+            self._health_conn_c.inc(
+                1, transport="reused" if reused else "new")
+            return resp.status, raw
+        raise last_exc  # fresh-socket retry also failed
+
     def _check_one(self, r: _ReplicaState) -> None:
         try:
-            with urllib.request.urlopen(
-                    r.url + "/healthz",
-                    timeout=self.health_timeout_s) as resp:
-                raw = resp.read()
-            code = resp.status
-        except urllib.error.HTTPError as e:
-            # An HTTP error IS an answer: /healthz replies 503 with a
-            # body when unhealthy — read it rather than marking unreachable.
-            try:
-                raw = e.read()
-            except Exception:  # noqa: BLE001 - body is best-effort
-                raw = b""
-            code = e.code
-        except _CONNECT_ERRORS + (urllib.error.URLError,):
+            code, raw = self._health_fetch(r)
+        except _CONNECT_ERRORS + (http.client.HTTPException,
+                                  urllib.error.URLError):
             with self._lock:
                 r.reachable = False
                 r.status = "unreachable"
@@ -526,3 +572,7 @@ class RouterServer:
         if self._serve_thread is not None:
             self._serve_thread.join(timeout=5.0)
         self._health_thread.join(timeout=5.0)
+        for r in self._replicas:  # drop cached keep-alive probe sockets
+            if r.conn is not None:
+                r.conn.close()
+                r.conn = None

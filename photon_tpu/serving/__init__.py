@@ -19,28 +19,56 @@ batcher, a circuit breaker that degrades a sick coefficient store to
 fixed-effect-only scoring, and worker-crash detection surfaced through
 ``/healthz`` — all exercised by the chaos suite (``pytest -m chaos``).
 
+Front line (PR 19, docs/serving.md §"Front line"): ``wire`` (versioned
+binary row encoding), ``ipc`` (lock-free shm ring + socket fallback),
+``async_frontend`` (accelerator-free asyncio worker processes),
+``frontline`` (scorer-side IPC service + worker supervisor) and
+``autotune`` (histogram-driven micro-batch tuning) rebuild the serving
+box as a multi-process pipeline; the threaded server above remains the
+single-process mode and the bench's A/B baseline.
+
 CLI entry point: ``photon_tpu/cli/serving_driver.py``.
+
+NOTE: exports resolve lazily (PEP 562) so that accelerator-FREE users of
+this package — front-end workers importing ``wire``/``ipc``/
+``coefficient_store`` — never drag in jax through the registry/scorer
+modules.
 """
-from photon_tpu.serving.batcher import (
-    DeadlineExceeded,
-    MicroBatcher,
-    Overloaded,
-    ScoreResult,
-)
-from photon_tpu.serving.circuit import CircuitBreaker
-from photon_tpu.serving.coefficient_store import (
-    CoefficientStore,
-    DeviceCoefficientCache,
-)
-from photon_tpu.serving.registry import (
-    ModelRegistry,
-    ModelVersion,
-    ServingConfig,
-)
-from photon_tpu.serving.scorer import ParsedRow, RowScorer
-from photon_tpu.serving.server import ScoringServer
+_EXPORTS = {
+    "DeadlineExceeded": "photon_tpu.serving.batcher",
+    "MicroBatcher": "photon_tpu.serving.batcher",
+    "Overloaded": "photon_tpu.serving.batcher",
+    "ScoreResult": "photon_tpu.serving.batcher",
+    "CircuitBreaker": "photon_tpu.serving.circuit",
+    "CoefficientStore": "photon_tpu.serving.coefficient_store",
+    "DeviceCoefficientCache": "photon_tpu.serving.coefficient_store",
+    "ModelRegistry": "photon_tpu.serving.registry",
+    "ModelVersion": "photon_tpu.serving.registry",
+    "ServingConfig": "photon_tpu.serving.registry",
+    "ParsedRow": "photon_tpu.serving.scorer",
+    "RowScorer": "photon_tpu.serving.scorer",
+    "ScoringServer": "photon_tpu.serving.server",
+    "BatchAutotuner": "photon_tpu.serving.autotune",
+    "FrontLine": "photon_tpu.serving.frontline",
+}
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))
+
 
 __all__ = [
+    "BatchAutotuner",
+    "FrontLine",
     "CircuitBreaker",
     "CoefficientStore",
     "DeadlineExceeded",

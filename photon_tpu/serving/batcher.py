@@ -269,16 +269,20 @@ class MicroBatcher:
             )
 
     def reconfigure(self, max_batch: Optional[int] = None,
-                    max_queue: Optional[int] = None) -> dict:
-        """Hot-tune batch/queue limits (the control plane's autoscaling
-        lever, ``POST /admin/tune``).
+                    max_queue: Optional[int] = None,
+                    max_wait_ms: Optional[float] = None) -> dict:
+        """Hot-tune batch/queue/deadline limits (the control plane's
+        damped autoscaling lever, ``POST /admin/tune`` — and the
+        histogram autotuner's actuation surface, docs/serving.md
+        §"Autotuned batching").
 
-        The worker reads ``self.max_batch`` fresh at every assembly round
-        and ``Queue.maxsize`` is consulted under the queue's own mutex on
-        each ``put_nowait``, so both changes take effect at the next
-        admission/dispatch without pausing the worker. Shrinking
-        ``max_queue`` below the current depth never drops queued waiters —
-        the bound only gates NEW admissions. Returns the active config."""
+        The worker reads ``self.max_batch`` / ``self.max_wait_s`` fresh
+        at every assembly round and ``Queue.maxsize`` is consulted under
+        the queue's own mutex on each ``put_nowait``, so all changes take
+        effect at the next admission/dispatch without pausing the worker.
+        Shrinking ``max_queue`` below the current depth never drops
+        queued waiters — the bound only gates NEW admissions. Returns the
+        active config."""
         with self._submit_lock:
             if max_batch is not None:
                 if int(max_batch) < 1:
@@ -291,8 +295,14 @@ class MicroBatcher:
                         f"max_queue must be >= 1, got {max_queue}")
                 self.max_queue = int(max_queue)
                 self._q.maxsize = self.max_queue
+            if max_wait_ms is not None:
+                if float(max_wait_ms) < 0:
+                    raise ValueError(
+                        f"max_wait_ms must be >= 0, got {max_wait_ms}")
+                self.max_wait_s = float(max_wait_ms) / 1e3
             return {"max_batch": self.max_batch,
-                    "max_queue": self.max_queue}
+                    "max_queue": self.max_queue,
+                    "max_wait_ms": round(self.max_wait_s * 1e3, 4)}
 
     def snapshot(self) -> dict:
         s = dict(self.stats)

@@ -29,11 +29,26 @@ import time
 from collections import OrderedDict
 from typing import Optional
 
-import jax.numpy as jnp
 import numpy as np
 
 from photon_tpu.faults import fault_point
 from photon_tpu.serving.circuit import CircuitBreaker
+
+
+class _LazyJnp:
+    """Defer the jax import to first DEVICE use: the host-side
+    ``CoefficientStore`` is mmap-loaded read-only by accelerator-free
+    front-end workers (docs/serving.md §"Front line"), which must never
+    pay for — or depend on — an accelerator runtime just to resolve
+    entity keys. Only ``DeviceCoefficientCache`` touches the device."""
+
+    def __getattr__(self, name):
+        import jax.numpy as jnp
+
+        return getattr(jnp, name)
+
+
+jnp = _LazyJnp()
 
 _META = "store-meta.json"
 

@@ -139,6 +139,10 @@ def _build_version(
 class ModelRegistry:
     """Holds the current ModelVersion; ``swap`` replaces it atomically."""
 
+    # Class-level default so partially constructed registries (tests build
+    # bare instances via __new__ to isolate apply_delta) can still bump it.
+    _store_generation = 0
+
     def __init__(
         self,
         model_dir: str,
@@ -168,6 +172,13 @@ class ModelRegistry:
             "patched_entities_total": 0,
             "last_event_horizon": None,
         }
+        # Coefficient-visibility generation (docs/serving.md §"Front
+        # line"): bumped on every swap AND every applied delta. Front-end
+        # workers stamp the generation of their read-only store export on
+        # each wire frame; the scorer only honors worker-verified entity
+        # misses when the generations still match, so worker store
+        # staleness can never change a score.
+        self._store_generation = 0
         self.swap(model_dir)
 
     @property
@@ -261,6 +272,7 @@ class ModelRegistry:
                 hot = self._current is not None
                 self._current = version
                 self._next_version += 1
+                self._store_generation += 1
             if hot:
                 # Swap→first-score clock (docs/robustness.md §recovery
                 # time): armed at the pointer move, closed by the first
@@ -310,6 +322,7 @@ class ModelRegistry:
                 if event_horizon is not None:
                     st["last_event_horizon"] = int(event_horizon)
                 patch_seq = st["patch_seq"]
+                self._store_generation += 1
         from photon_tpu.obs import instant
 
         instant("serving.delta_applied", cat="serving", patch_seq=patch_seq,
@@ -320,6 +333,57 @@ class ModelRegistry:
             "patched": total,
             "coordinates": applied,
         }
+
+    @property
+    def store_generation(self) -> int:
+        with self._lock:
+            return self._store_generation
+
+    def export_frontline(self, runtime_dir: str) -> dict:
+        """Write everything an accelerator-free front-end worker needs to
+        parse + pre-resolve requests (docs/serving.md §"Front line"): the
+        per-RE-coordinate ``CoefficientStore`` saved in its mmap-able flat
+        layout, plus a ``frontline.json`` manifest carrying the parse
+        config (feature bags, intercepts, row width), index-map locations,
+        and the store generation at export time. Returns the manifest."""
+        v = self.current
+        scorer = v.scorer
+        os.makedirs(runtime_dir, exist_ok=True)
+        index_root = self._index_dir or default_index_root(v.model_dir)
+        res = {}
+        for cid, _shard in scorer.re_parts:
+            store_dir = os.path.join(runtime_dir, "stores", cid)
+            scorer._caches[cid].store.save(store_dir)
+            res[cid] = {
+                "re_type": scorer._re_types[cid],
+                "feature_shard": scorer.data_configs[cid].feature_shard,
+                "store_dir": store_dir,
+            }
+        shards = {}
+        for s in scorer._shards_used:
+            cfg = scorer.shard_configs[s]
+            shards[s] = {
+                "feature_bags": list(cfg.feature_bags),
+                "add_intercept": bool(cfg.add_intercept),
+                "dim": len(scorer.index_maps[s]),
+                "intercept_index": scorer._intercepts.get(s),
+                "index_dir": os.path.join(index_root, s),
+            }
+        manifest = {
+            "generation": self.store_generation,
+            "model_version": v.version,
+            "model_dir": v.model_dir,
+            "max_row_nnz": int(self.config.max_row_nnz),
+            "request_timeout_s": float(self.config.request_timeout_s),
+            "shards": shards,
+            "re_coordinates": res,
+        }
+        path = os.path.join(runtime_dir, "frontline.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2)
+        os.replace(tmp, path)
+        return manifest
 
     def freshness_snapshot(self) -> dict:
         """Serving freshness for /healthz and /metrics (measurable without

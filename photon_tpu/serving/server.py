@@ -172,6 +172,11 @@ class ScoringServer:
         # on /healthz and the metrics snapshot — the staleness signal the
         # router weights traffic by.
         self.replication = None
+        # Histogram batch autotuner (docs/serving.md §"Autotuned
+        # batching"), attached by the front-line driver; /admin/tune
+        # reports its current choice so operators see what the loop is
+        # doing through the same surface they'd override it on.
+        self.autotuner = None
         # Live fleet view: when set (serving driver, --telemetry-dir),
         # every metrics flush also exports the registry shard here so the
         # obs driver can aggregate this process BEFORE it exits.
@@ -525,15 +530,19 @@ class ScoringServer:
                             "request body must be a JSON object")
                     max_batch = payload.get("max_batch")
                     max_queue = payload.get("max_queue")
-                    if max_batch is None and max_queue is None:
+                    max_wait_ms = payload.get("max_wait_ms")
+                    if (max_batch is None and max_queue is None
+                            and max_wait_ms is None):
                         raise RequestError(
-                            "max_batch or max_queue required")
+                            "max_batch, max_queue, or max_wait_ms required")
                     try:
                         cfg = server.batcher.reconfigure(
                             max_batch=(None if max_batch is None
                                        else int(max_batch)),
                             max_queue=(None if max_queue is None
                                        else int(max_queue)),
+                            max_wait_ms=(None if max_wait_ms is None
+                                         else float(max_wait_ms)),
                         )
                     except (TypeError, ValueError) as e:
                         raise RequestError(str(e)) from None
@@ -548,9 +557,17 @@ class ScoringServer:
                 instant("serving.batcher_tuned", cat="serving", **cfg)
                 if server.logger is not None:
                     server.logger.info(
-                        "batcher tuned: max_batch=%d max_queue=%d",
-                        cfg["max_batch"], cfg["max_queue"])
-                self._reply(200, cfg)
+                        "batcher tuned: max_batch=%d max_queue=%d "
+                        "max_wait_ms=%.3f", cfg["max_batch"],
+                        cfg["max_queue"], cfg["max_wait_ms"])
+                # One actuation surface for the whole box: manual tunes
+                # and the histogram autotuner act on the same batcher, so
+                # the reply always reports the tuner's current choice.
+                out = dict(cfg)
+                out["autotune"] = (
+                    server.autotuner.snapshot()
+                    if server.autotuner is not None else {"enabled": False})
+                self._reply(200, out)
 
             def _memory_shed(self):
                 """Proactive device-memory shed (control plane's answer to

@@ -1,0 +1,445 @@
+"""Multi-process serving front line (photon_tpu/serving/frontline.py,
+async_frontend.py, autotune.py — docs/serving.md §"Front line").
+
+Coverage per ISSUE 19: JSON/wire score parity between the worker path and
+the in-process scorer, the cross-process stage waterfall summing to the
+request total (X-Photon-Timing), /admin/tune proxied from any worker to
+the scorer's batcher (one actuation surface), worker-death supervision
+with journaled restart while surviving workers keep the port, exactly-
+once cross-process tail-sampling promotion, zero scoring-kernel retraces
+through the front line after warmup, and the histogram autotuner's
+damped (hysteresis + min_run + cooldown) lever discipline driven by
+synthetic stage-latency states.
+"""
+import http.client
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu.estimators.game_transformer import SCORE_KERNEL_STATS
+from photon_tpu.io.avro import read_records
+from photon_tpu.obs.metrics import MetricsRegistry
+from photon_tpu.obs.trace import (
+    TailSampler,
+    install_tail_sampler,
+    uninstall_tail_sampler,
+)
+from photon_tpu.serving import (
+    MicroBatcher,
+    ModelRegistry,
+    ScoringServer,
+    ServingConfig,
+    wire,
+)
+from photon_tpu.serving.autotune import BatchAutotuner, _pow2_ladder
+from photon_tpu.serving.frontline import FrontLine, pick_port
+from tests.test_serving import _payload, _post, _get, trained  # noqa: F401
+
+pytestmark = pytest.mark.slow
+
+
+# ------------------------------------------------------------------ helpers
+
+
+def _post_raw(host, port, path, body, headers=None):
+    conn = http.client.HTTPConnection(host, port, timeout=30)
+    conn.request("POST", path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    out_headers = dict(resp.getheaders())
+    conn.close()
+    return resp.status, data, out_headers
+
+
+def _post_json(host, port, path, payload, headers=None):
+    h = {"Content-Type": "application/json", **(headers or {})}
+    status, data, out_headers = _post_raw(
+        host, port, path, json.dumps(payload).encode(), h)
+    return status, json.loads(data), out_headers
+
+
+@pytest.fixture(scope="module")
+def flbox(trained, tmp_path_factory):  # noqa: F811 - pytest fixture reuse
+    """One front-line box: this process owns the device + batcher (the
+    scorer side), two spawned jax-free workers own the public port."""
+    d, (m1, _), _ = trained
+    runtime = tmp_path_factory.mktemp("flruntime")
+    config = ServingConfig(
+        max_batch=8, max_wait_ms=1.0, cache_entities=32, max_row_nnz=64,
+        max_queue=64, request_timeout_s=10.0)
+    registry = ModelRegistry(m1, config)
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=1.0, max_queue=64)
+    server = ScoringServer(registry, batcher, port=0,
+                           metrics_interval_s=3600)
+    server.start()
+    # Manual-tick tuner (tick_s is the background cadence; tests drive
+    # tick() directly) — attached so /admin/tune reports it everywhere.
+    tuner = BatchAutotuner(batcher, server._stage_hist, ladder_max=8,
+                           tick_s=3600.0)
+    server.autotuner = tuner
+    port = pick_port()
+    os.environ["PHOTON_TRACE_TAIL"] = "1"
+    fl = FrontLine(
+        server, workers=2, host="127.0.0.1", port=port,
+        runtime_dir=str(runtime), autotuner=tuner,
+        telemetry_dir=str(runtime / "telemetry"))
+    try:
+        fl.start(ready_timeout_s=90.0)
+    except Exception:
+        server.shutdown()
+        os.environ.pop("PHOTON_TRACE_TAIL", None)
+        raise
+    yield fl, server, d
+    os.environ.pop("PHOTON_TRACE_TAIL", None)
+    fl.stop()
+    server.shutdown()
+
+
+# ------------------------------------------------------- end-to-end scoring
+
+
+def test_frontline_json_parity_and_zero_retrace(flbox):
+    """Scores through worker->ring->scorer match the in-process batcher
+    path bit-for-bit intent (same ParsedRow, same kernel) and serving
+    through the front line never retraces a warmed kernel."""
+    fl, server, d = flbox
+    host, port = fl.address
+    recs = read_records(str(d / "val.avro"))[:10]
+    version = server.registry.current
+    expected = []
+    for rec in recs:
+        row = version.scorer.parse_request(_payload(rec))
+        expected.append(float(server.batcher.submit(version, row)
+                              .result(timeout=10)))
+    traces0 = SCORE_KERNEL_STATS["traces"]
+    for rec, exp in zip(recs, expected):
+        status, body, headers = _post_json(host, port, "/score",
+                                           _payload(rec))
+        assert status == 200, body
+        assert body["score"] == pytest.approx(exp, abs=1e-6)
+        assert body["model_version"] == version.version
+        assert body["uid"] == rec["uid"]
+        assert "X-Photon-Worker" in headers
+    assert SCORE_KERNEL_STATS["traces"] == traces0  # zero retraces
+
+
+def test_frontline_bad_request_and_unknown_route(flbox):
+    fl, _, _ = flbox
+    host, port = fl.address
+    status, body, _ = _post_json(host, port, "/score", {"features": "nope"})
+    assert status == 400 and "error" in body
+    status, body = _get(host, port, "/nope")
+    assert status == 404
+
+
+def test_frontline_waterfall_sums_to_total(flbox):
+    """Satellite: per-stage durations on a worker->scorer->worker request
+    sum to the request total within rounding — the cross-process stage
+    set tiles the request, no gap and no double-count."""
+    fl, _, d = flbox
+    host, port = fl.address
+    rec = read_records(str(d / "val.avro"))[0]
+    status, body, headers = _post_json(
+        host, port, "/score", _payload(rec),
+        headers={"X-Photon-Timing": "1"})
+    assert status == 200, body
+    timing = headers.get("X-Photon-Timing")
+    assert timing, "timing opt-in header missing on the worker path"
+    stages = {}
+    for part in timing.split(","):
+        name, _, dur = part.strip().partition(";dur=")
+        stages[name] = float(dur)
+    total = stages.pop("total")
+    # Worker-side stages AND scorer-side stages, each exactly once.
+    for st in ("admission", "parse", "ipc", "response", "queue_wait",
+               "kernel"):
+        assert st in stages, f"stage {st!r} missing from {sorted(stages)}"
+    assert sum(stages.values()) == pytest.approx(total, abs=0.05), (
+        f"stages {stages} do not tile total {total}ms")
+
+
+def test_frontline_wire_roundtrip(flbox):
+    """The binary edge: POST a pre-encoded wire frame, get a wire frame
+    back, scores matching the JSON path."""
+    fl, server, d = flbox
+    host, port = fl.address
+    rec = read_records(str(d / "val.avro"))[1]
+    version = server.registry.current
+    parsed = version.scorer.parse_request(_payload(rec))
+    expected = float(server.batcher.submit(version, parsed)
+                     .result(timeout=10))
+    wrow = wire.WireRow(
+        shard_idx=parsed.shard_idx, shard_val=parsed.shard_val,
+        offset=parsed.offset, entity_keys=parsed.entity_keys)
+    frame = wire.encode_score_request(
+        [wrow], req_id=7, trace_id="t-wire-test",
+        store_generation=server.registry.store_generation)
+    status, data, headers = _post_raw(
+        host, port, "/score", frame,
+        {"Content-Type": wire.WIRE_CONTENT_TYPE})
+    assert status == 200
+    assert headers.get("Content-Type") == wire.WIRE_CONTENT_TYPE
+    resp = wire.decode_score_response(data)
+    assert resp.req_id == 7  # the CLIENT's id, not the worker's IPC id
+    assert resp.status == wire.STATUS_OK
+    assert resp.model_version == version.version
+    assert len(resp.scores) == 1
+    assert float(resp.scores[0]) == pytest.approx(expected, abs=1e-6)
+    assert "kernel" in resp.stages and "ipc" in resp.stages
+
+
+def test_admin_tune_proxy_single_surface(flbox):
+    """Satellite: /admin/tune on a WORKER proxies to the scorer's batcher
+    and reports the autotuner's current choice — one actuation surface
+    for the whole box."""
+    fl, server, _ = flbox
+    host, port = fl.address
+    before = server.batcher.max_wait_s
+    try:
+        status, body, _ = _post_json(host, port, "/admin/tune",
+                                     {"max_wait_ms": 1.5})
+        assert status == 200, body
+        assert body["max_wait_ms"] == 1.5
+        assert server.batcher.max_wait_s == pytest.approx(1.5e-3)
+        assert body["autotune"]["enabled"] is True
+        assert body["autotune"]["current"]["max_wait_ms"] == 1.5
+        assert "proxied_by_worker" in body
+        # The scorer's own admin plane reports the same tuner state.
+        ahost, aport = server.address
+        status, body = _post(ahost, aport, "/admin/tune",
+                             {"max_batch": 8})
+        assert status == 200
+        assert body["autotune"]["enabled"] is True
+        # Bad values reject without changing anything, through the proxy.
+        status, body, _ = _post_json(host, port, "/admin/tune",
+                                     {"max_wait_ms": -1})
+        assert status == 400
+    finally:
+        server.batcher.reconfigure(max_wait_ms=before * 1e3)
+
+
+def test_frontline_healthz_reports_workers(flbox):
+    fl, server, _ = flbox
+    host, port = fl.address
+    status, body = _get(host, port, "/healthz")
+    assert status == 200
+    assert body["role"] == "frontend"
+    assert body["model_version"] == server.registry.current.version
+    workers = {w["worker_id"]: w for w in body["workers"]}
+    assert set(workers) == {0, 1}
+    assert body["batcher"]["healthy"] is True
+    assert "store_generation" in body
+
+
+def test_frontline_worker_death_restart_and_survival(flbox):
+    """SIGKILL one worker under load: the survivor keeps answering on the
+    shared port, the supervisor restarts the dead one (journaled in the
+    worker table), and scoring never breaks."""
+    fl, server, d = flbox
+    host, port = fl.address
+    rec = read_records(str(d / "val.avro"))[2]
+    victim = fl._links[0]
+    restarts0 = len(victim.restarts)
+    os.kill(victim.pid, signal.SIGKILL)
+    deadline = time.monotonic() + 30.0
+    # Survivors keep the port the whole time (SO_REUSEPORT: the kernel
+    # only routes NEW connections to live listeners).
+    ok = 0
+    while time.monotonic() < deadline:
+        try:
+            status, body, _ = _post_json(host, port, "/score",
+                                         _payload(rec))
+        except OSError:
+            # Connections parked in the victim's accept queue get RST
+            # when it dies; real clients retry. Only persistent failure
+            # (ok never reaching 3) fails the test.
+            status = None
+        if status == 200:
+            ok += 1
+        snap = fl.workers_snapshot()[0]
+        if (snap["restarts"] > restarts0 and snap["state"] == "live"
+                and ok >= 3):
+            break
+        time.sleep(0.2)
+    snap = fl.workers_snapshot()[0]
+    assert snap["restarts"] > restarts0, "supervisor never restarted it"
+    assert snap["state"] == "live"
+    assert ok >= 3
+    # Worker table on disk reflects the topology (the chaos drill's input).
+    table = json.load(open(os.path.join(fl.runtime_dir,
+                                        "frontline-workers.json")))
+    assert {w["worker_id"] for w in table["workers"]} == {0, 1}
+    # The restarted worker serves too (eventually hit via REUSEPORT).
+    status, body, _ = _post_json(host, port, "/score", _payload(rec))
+    assert status == 200
+
+
+# ------------------------------------------------------------ tail sampling
+
+
+class _ForcePromote(TailSampler):
+    """Deterministic promotion for the exactly-once test."""
+
+    def finish(self, trace_id, duration_s, error=False, force=False):
+        return super().finish(trace_id, duration_s, error=error,
+                              force=True)
+
+
+def test_tail_sampler_force_promotes_once():
+    """The force= verdict (new in PR 19) promotes regardless of threshold
+    history, and a chain can only promote ONCE — the second finish for
+    the same trace id is a no-op."""
+    s = TailSampler(min_history=10_000)  # latency never promotes
+    s.begin("t1")
+    assert s.finish("t1", 0.001, force=True) is True
+    assert s.promoted == 1
+    assert s.finish("t1", 0.001, force=True) is False  # already judged
+    assert s.promoted == 1
+    s.begin("t2")
+    assert s.finish("t2", 0.001) is False  # no force, no threshold: discard
+    assert s.discarded == 1
+
+
+def test_frontline_tail_promotion_exactly_once(flbox):
+    """Cross-process chain: the scorer judges first and flags the
+    response frame; the worker forwards the verdict (flag visible to the
+    wire client) instead of re-judging. Scorer-side promotion count
+    moves by exactly the number of requests."""
+    fl, server, d = flbox
+    host, port = fl.address
+    sampler = _ForcePromote(min_history=10_000)
+    install_tail_sampler(sampler)
+    try:
+        version = server.registry.current
+        parsed = version.scorer.parse_request(
+            _payload(read_records(str(d / "val.avro"))[3]))
+        wrow = wire.WireRow(
+            shard_idx=parsed.shard_idx, shard_val=parsed.shard_val,
+            offset=parsed.offset, entity_keys=parsed.entity_keys)
+        n = 5
+        for i in range(n):
+            frame = wire.encode_score_request(
+                [wrow], req_id=100 + i, trace_id=f"t-tail-{i}")
+            status, data, _ = _post_raw(
+                host, port, "/score", frame,
+                {"Content-Type": wire.WIRE_CONTENT_TYPE})
+            assert status == 200
+            resp = wire.decode_score_response(data)
+            assert resp.trace_promoted, (
+                "worker dropped the scorer's promotion verdict")
+        assert sampler.promoted == n  # exactly once per request chain
+        assert sampler.promoted_error == 0
+    finally:
+        uninstall_tail_sampler()
+
+
+# ------------------------------------------------------- autotuner (units)
+
+
+def _mk_tuner(**kw):
+    reg = MetricsRegistry()
+    hist = reg.histogram("serve_stage_latency_seconds", "")
+    batcher = MicroBatcher(max_batch=8, max_wait_ms=2.0, max_queue=16,
+                           start=False)
+    defaults = dict(ladder_max=32, min_run=2, cooldown_s=10.0,
+                    min_samples=4)
+    defaults.update(kw)
+    return BatchAutotuner(batcher, hist, **defaults), hist, batcher
+
+
+def _observe_kernel(hist, ms, n=8):
+    for _ in range(n):
+        hist.observe(ms / 1e3, stage="kernel")
+
+
+def test_autotune_scales_up_under_queue_pressure_with_min_run():
+    tuner, hist, b = _mk_tuner()
+    for _ in range(10):  # queue_frac = 10/16 > queue_high
+        b.submit(object(), object())
+    _observe_kernel(hist, 2.0)
+    assert tuner.tick(now=0.0) is None  # streak 1 < min_run: damped
+    assert b.max_batch == 8
+    _observe_kernel(hist, 2.0)
+    action = tuner.tick(now=1.0)
+    assert action is not None and action["lever"] == "batch"
+    assert action["direction"] == "up"
+    assert b.max_batch == 16  # one ladder rung, not a jump to the top
+    assert tuner.snapshot()["current"]["max_batch"] == 16
+
+
+def test_autotune_cooldown_freezes_lever():
+    # Kernel ~4ms keeps the WAIT lever neutral (target 0.5*p50 ~= the
+    # 2ms deadline) so this test isolates the batch lever's cooldown.
+    tuner, hist, b = _mk_tuner()
+    for _ in range(10):
+        b.submit(object(), object())
+    _observe_kernel(hist, 4.0)
+    tuner.tick(now=0.0)
+    _observe_kernel(hist, 4.0)
+    assert tuner.tick(now=1.0) is not None  # up: 8 -> 16 at now=1
+    # Pressure persists, min_run re-satisfied — but the lever is frozen
+    # until now=11 (cooldown shared by both directions: no flap).
+    for now in (2.0, 3.0, 4.0):
+        _observe_kernel(hist, 4.0)
+        assert tuner.tick(now=now) is None
+    assert b.max_batch == 16
+    assert tuner.snapshot()["suppressed"]["cooldown"] > 0
+    _observe_kernel(hist, 4.0)
+    action = tuner.tick(now=12.0)  # cooldown expired: next rung
+    assert action is not None and b.max_batch == 32
+
+
+def test_autotune_scales_down_on_empty_batches():
+    tuner, hist, b = _mk_tuner()
+    # Quiet queue + mostly-empty batches: 10 batches of ~1 row at cap 8.
+    for _ in range(2):
+        b.stats["batches"] += 10
+        b.stats["rows"] += 12
+        _observe_kernel(hist, 2.0)
+        action = tuner.tick(now=tuner._ticks * 1.0)
+    assert action is not None and action["direction"] == "down"
+    assert b.max_batch == 4
+
+
+def test_autotune_wait_tracks_kernel_p50():
+    tuner, hist, b = _mk_tuner()
+    # Busy box (non-idle), healthy fill so the batch lever holds, kernel
+    # p50 ~1ms -> target wait ~0.5ms, well below the current 2ms.
+    actions = []
+    for now in (0.0, 1.0, 2.0):
+        b.stats["batches"] += 10
+        b.stats["rows"] += 60  # fill 0.75: batch lever wants nothing
+        _observe_kernel(hist, 1.0, n=12)
+        action = tuner.tick(now=now)
+        if action is not None:
+            actions.append(action)
+    assert len(actions) == 1  # min_run delays it; cooldown stops a repeat
+    assert actions[0]["lever"] == "wait"
+    assert actions[0]["direction"] == "down"
+    # Landed on ~half the observed kernel p50, clamped to the floor.
+    assert 0.25 <= b.max_wait_s * 1e3 < 1.0
+
+
+def test_autotune_respects_warmed_ladder_cap():
+    """cap_fn (the OOM downshift cap) bounds the ladder: at the cap, up
+    pressure is a no-op — the tuner never proposes an unwarmed shape."""
+    tuner, hist, b = _mk_tuner(cap_fn=lambda: 8)
+    assert _pow2_ladder(8) == [1, 2, 4, 8]
+    for _ in range(10):
+        b.submit(object(), object())
+    for now in (0.0, 1.0, 2.0, 3.0):
+        _observe_kernel(hist, 4.0)  # wait-neutral (see cooldown test)
+        assert tuner.tick(now=now) is None
+    assert b.max_batch == 8  # pinned at the cap, no retrace-risking jump
+
+
+def test_autotune_idle_holds():
+    tuner, hist, b = _mk_tuner()
+    for now in (0.0, 1.0, 2.0, 3.0):
+        assert tuner.tick(now=now) is None
+    assert b.max_batch == 8 and b.max_wait_s == pytest.approx(2e-3)
+    assert tuner.snapshot()["suppressed"]["idle"] == 4

@@ -10,6 +10,7 @@ trace-id forwarding — all on stub replicas, no accelerator needed.
 """
 import http.client
 import json
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -690,8 +691,22 @@ class _StubReplica:
                     self.headers.get("X-Photon-Trace-Id"))
                 self._reply(200, {"score": 1.0, "replica": stub.name})
 
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        conns: set = set()
+        conns_lock = threading.Lock()
+
+        class Srv(ThreadingHTTPServer):
+            # Track accepted sockets so close() can sever live
+            # keep-alive connections — a killed process drops its
+            # sockets, and the router's reused-probe tests need the
+            # stub to die like one.
+            def process_request(self, request, client_address):
+                with conns_lock:
+                    conns.add(request)
+                super().process_request(request, client_address)
+
+        self.httpd = Srv(("127.0.0.1", 0), Handler)
         self.httpd.daemon_threads = True
+        self._conns, self._conns_lock = conns, conns_lock
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -703,6 +718,13 @@ class _StubReplica:
 
     def close(self):
         self.httpd.shutdown()
+        with self._conns_lock:
+            for s in self._conns:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass  # already closed by the handler
+            self._conns.clear()
         self.httpd.server_close()
 
 
@@ -1088,3 +1110,49 @@ def test_healthz_degrades_on_dead_tailer():
     assert reasons(_FakeTailer(started=True, running=True,
                                error="ValueError: poisoned")) == \
         ["replication_error"]
+
+
+def test_router_health_sweep_reuses_keepalive_connections():
+    """PR 19: the health sweep holds ONE keep-alive connection per
+    replica instead of a fresh TCP handshake per probe; a socket the
+    upstream idle-closed between sweeps gets one silent fresh-socket
+    retry, and a genuinely dead replica is still marked unreachable."""
+    a, b = _StubReplica("a"), _StubReplica("b")
+    router = _router([a, b])
+    try:
+        probes = router._health_conn_c
+        # _router() sweeps once AND the health thread sweeps at startup;
+        # wait for both (4 probes total) so deltas below are exact.
+        deadline = time.monotonic() + 5.0
+        while (probes.value(transport="new")
+               + probes.value(transport="reused")) < 4:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        new0 = probes.value(transport="new")
+        router.check_replicas()
+        router.check_replicas()
+        assert probes.value(transport="new") == new0  # zero handshakes
+        assert probes.value(transport="reused") >= 4
+        for r in router._replicas:
+            assert r.conn is not None and r.status == "ok"
+
+        # The upstream idle-closing a cached socket must cost nothing:
+        # probe retries once on a fresh connection, replica stays ok.
+        # (Kill the raw socket, not the HTTPConnection — http.client
+        # auto_open would silently reconnect a cleanly-closed one.)
+        router._replicas[0].conn.sock.close()
+        router.check_replicas()
+        assert router._replicas[0].status == "ok"
+        assert router._replicas[0].consecutive_failures == 0
+        assert probes.value(transport="new") == new0 + 1  # one re-handshake
+
+        # A dead replica (connection refused on the fresh socket too) is
+        # still marked unreachable, and no stale conn is cached for it.
+        a.close()
+        router.check_replicas()
+        assert router._replicas[0].status == "unreachable"
+        assert router._replicas[0].conn is None
+        assert router._replicas[1].status == "ok"
+    finally:
+        router.shutdown()
+        b.close()

@@ -1,0 +1,214 @@
+"""Binary wire format + IPC transports (photon_tpu/serving/wire.py, ipc.py).
+
+Pure host-side tests — no jax, no model: the wire and transport layers
+are deliberately accelerator-free so front-end workers never pay for an
+accelerator runtime. Coverage per ISSUE 19: versioned refusal (bad
+magic / version / truncation), exact array roundtrips including entity
+flags and degraded bitmasks, SPSC ring wrap-around + backpressure, and
+send/recv parity between the shm ring and the socket fallback.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu.serving import ipc, wire
+
+
+def _rows(n=3, k=8, shards=("global",), res=("perUser", "perItem")):
+    rng = np.random.default_rng(7)
+    rows = []
+    for i in range(n):
+        rows.append(wire.WireRow(
+            shard_idx={s: rng.integers(0, 100, k).astype(np.int32)
+                       for s in shards},
+            shard_val={s: rng.normal(size=k).astype(np.float32)
+                       for s in shards},
+            offset=float(i) * 0.5,
+            entity_keys={
+                "perUser": f"user{i}" if i % 3 != 2 else None,
+                "perItem": f"ítem-{i}",  # non-ASCII on purpose
+            },
+            known_miss=frozenset({"perItem"} if i == 1 else ()),
+        ))
+    return rows
+
+
+# ------------------------------------------------------------------ frames
+
+
+def test_score_request_roundtrip():
+    rows = _rows()
+    buf = wire.encode_score_request(
+        rows, req_id=42, trace_id="t-abc", deadline_ms=125.0,
+        store_generation=7)
+    req = wire.decode_score_request(buf)
+    assert (req.req_id, req.trace_id) == (42, "t-abc")
+    assert req.deadline_ms == pytest.approx(125.0)
+    assert req.store_generation == 7
+    assert len(req.rows) == len(rows)
+    for a, b in zip(rows, req.rows):
+        for s in a.shard_idx:
+            np.testing.assert_array_equal(a.shard_idx[s], b.shard_idx[s])
+            np.testing.assert_array_equal(a.shard_val[s], b.shard_val[s])
+        assert b.offset == pytest.approx(a.offset)
+        assert dict(b.entity_keys) == dict(a.entity_keys)
+        assert b.known_miss == a.known_miss
+
+
+def test_score_response_roundtrip():
+    scores = np.asarray([0.25, -1.5, 3.0], np.float32)
+    stages = {"queue_wait": 0.001234, "kernel": 0.000789}
+    buf = wire.encode_score_response(
+        9, model_version=3, scores=scores,
+        degraded=[(), ("perUser",), ("perUser", "perItem")],
+        stages=stages, flags=wire.RESP_FLAG_TRACE_PROMOTED)
+    resp = wire.decode_score_response(buf)
+    assert resp.req_id == 9 and resp.status == wire.STATUS_OK
+    assert resp.model_version == 3
+    assert resp.trace_promoted
+    np.testing.assert_array_equal(resp.scores, scores)
+    assert list(resp.degraded) == [(), ("perUser",), ("perItem", "perUser")]
+    for k, v in stages.items():
+        assert resp.stages[k] == pytest.approx(v, rel=0, abs=1e-12)
+
+
+def test_error_response_roundtrip():
+    buf = wire.encode_score_response(
+        5, status=wire.STATUS_OVERLOADED, error="queue full",
+        retry_after_s=1.0)
+    resp = wire.decode_score_response(buf)
+    assert resp.status == wire.STATUS_OVERLOADED
+    assert resp.error == "queue full"
+    assert resp.retry_after_s == pytest.approx(1.0)
+    assert len(resp.scores) == 0
+
+
+def test_control_roundtrip():
+    buf = wire.encode_control(wire.KIND_CTL_REQ, 11, {"op": "tune",
+                                                      "max_batch": 8})
+    kind, req_id, payload = wire.decode_control(buf)
+    assert (kind, req_id) == (wire.KIND_CTL_REQ, 11)
+    assert payload == {"op": "tune", "max_batch": 8}
+
+
+def test_versioned_refusal():
+    rows = _rows(1)
+    buf = bytearray(wire.encode_score_request(rows))
+    with pytest.raises(wire.WireError, match="magic"):
+        wire.frame_kind(b"XXXX" + bytes(buf[4:]))
+    bad_version = bytearray(buf)
+    bad_version[4] = 99
+    with pytest.raises(wire.WireError, match="version"):
+        wire.frame_kind(bytes(bad_version))
+    with pytest.raises(wire.WireError, match="truncated"):
+        wire.decode_score_request(bytes(buf[: len(buf) // 2]))
+    with pytest.raises(wire.WireError, match="shorter than header"):
+        wire.frame_kind(b"PhW1")
+    # Kind mismatch is refused too (a response fed to the request decoder).
+    resp = wire.encode_score_response(1, scores=np.zeros(1, np.float32))
+    with pytest.raises(wire.WireError, match="expected score request"):
+        wire.decode_score_request(resp)
+    assert wire.is_wire(bytes(buf)) and not wire.is_wire(b'{"rows": []}')
+
+
+# --------------------------------------------------------------- transports
+
+
+def _exercise_channel(a, b):
+    """Producer side `a`, consumer side `b`: frames arrive intact and in
+    order, including sizes that force ring wrap-around."""
+    frames = [os.urandom(n) for n in (1, 7, 1024, 3000, 65536, 2)]
+    got = []
+
+    def consume():
+        for _ in frames:
+            got.append(b.recv(timeout=5.0))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for f in frames:
+        a.send(f)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert got == frames
+
+
+def test_shm_ring_roundtrip_and_wraparound():
+    if not ipc.shm_available():
+        pytest.skip("no POSIX shared memory on this box")
+    token = f"t{os.getpid()}"
+    scorer = ipc.create_worker_rings(token, 0, capacity=1 << 17)
+    worker = ipc.attach_worker_rings(token, 0)
+    try:
+        _exercise_channel(worker, scorer)   # request direction
+        _exercise_channel(scorer, worker)   # response direction
+    finally:
+        worker.close()
+        scorer.close()
+
+
+def test_shm_ring_backpressure():
+    if not ipc.shm_available():
+        pytest.skip("no POSIX shared memory on this box")
+    ring = ipc.ShmRing.create(f"phbp{os.getpid()}", capacity=4096)
+    try:
+        with pytest.raises(ValueError, match="exceeds ring capacity"):
+            ring.send(b"x" * 8192)
+        ring.send(b"a" * 3000)
+        t0 = time.monotonic()
+        with pytest.raises(ipc.RingFull):
+            ring.send(b"b" * 3000, timeout=0.2)
+        assert time.monotonic() - t0 >= 0.15
+        # Draining frees the space.
+        assert ring.recv(timeout=1.0) == b"a" * 3000
+        ring.send(b"b" * 3000, timeout=0.5)
+        assert ring.recv(timeout=1.0) == b"b" * 3000
+    finally:
+        ring.close()
+
+
+def test_socket_channel_parity(tmp_path):
+    path = str(tmp_path / "ipc.sock")
+    listener = ipc.SocketListener(path)
+    accepted = []
+    t = threading.Thread(target=lambda: accepted.append(listener.accept()))
+    t.start()
+    client = ipc.SocketChannel.connect(path)
+    t.join(timeout=5)
+    server = accepted[0]
+    try:
+        _exercise_channel(client, server)
+        _exercise_channel(server, client)
+        # recv timeout on an idle channel returns None, not an error.
+        assert server.recv(timeout=0.05) is None
+    finally:
+        client.close()
+        server.close()
+        listener.close()
+
+
+def test_wire_frames_over_ring():
+    """End-to-end: encoded frames survive the ring byte-exact."""
+    if not ipc.shm_available():
+        pytest.skip("no POSIX shared memory on this box")
+    token = f"w{os.getpid()}"
+    scorer = ipc.create_worker_rings(token, 1, capacity=1 << 17)
+    worker = ipc.attach_worker_rings(token, 1)
+    try:
+        req = wire.encode_score_request(_rows(), req_id=3, trace_id="tt")
+        worker.send(req)
+        seen = scorer.recv(timeout=2.0)
+        decoded = wire.decode_score_request(seen)
+        assert decoded.req_id == 3 and len(decoded.rows) == 3
+        resp = wire.encode_score_response(
+            3, scores=np.ones(3, np.float32), stages={"kernel": 1e-4})
+        scorer.send(resp)
+        back = wire.decode_score_response(worker.recv(timeout=2.0))
+        assert back.req_id == 3
+        np.testing.assert_array_equal(back.scores, np.ones(3, np.float32))
+    finally:
+        worker.close()
+        scorer.close()
