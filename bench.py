@@ -2447,6 +2447,72 @@ def _game_scale_multisweep():
     return out
 
 
+def _game_scale_multihost():
+    """Elastic multi-host step-time A/B (ROADMAP item 3, docs/scaling.md
+    §"Multi-host mesh"): the SAME synthetic manifest trained by 1 vs 2
+    elastic worker PROCESSES (``python -m photon_tpu.parallel.elastic`` —
+    real interpreters over the shared-filesystem collectives, the
+    transport the SIGKILL drill certifies), reporting mean coordinate-step
+    seconds per arm. The work is fixed and the parts split across hosts,
+    so ideal N=2 halves the step time.
+
+    Scaling needs real cores: on a 1-core rig two worker processes
+    timeshare the core and the ratio reads ~1 by construction —
+    ``host_cpu_count`` is stamped so the figure is filtered honestly, same
+    contract as the mesh and serving legs."""
+    import subprocess
+    import sys
+    import tempfile
+
+    from photon_tpu.parallel.elastic import make_synthetic_parts
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix="bench_multihost_")
+    parts, rows, dim, ents = (4, 24, 6, 8) if SMOKE else (8, 192, 16, 24)
+    manifest = make_synthetic_parts(
+        os.path.join(tmp, "data"), n_parts=parts, rows_per_part=rows,
+        dim=dim, n_entities=ents)
+
+    def arm(n_hosts: int) -> float:
+        mesh = os.path.join(tmp, f"mesh-n{n_hosts}")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "photon_tpu.parallel.elastic",
+                 "--mesh-dir", mesh, "--host-id", str(h),
+                 "--hosts", str(n_hosts), "--manifest", manifest,
+                 "--sweeps", "2", "--max-iterations", "10",
+                 "--beat-seconds", "0.5", "--stale-factor", "10"],
+                cwd=repo, stdout=subprocess.DEVNULL,
+                stderr=subprocess.PIPE, text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            ) for h in range(n_hosts)
+        ]
+        for p in procs:
+            _, err = p.communicate(timeout=420)
+            if p.returncode != 0:
+                raise RuntimeError(
+                    f"multihost arm n={n_hosts} worker exited "
+                    f"{p.returncode}: {(err or '')[-400:]}")
+        with open(os.path.join(mesh, "final.json")) as f:
+            return float(json.load(f)["step_seconds_mean"])
+
+    s1 = arm(1)
+    s2 = arm(2)
+    return {
+        "game_scale_multihost_hosts": [1, 2],
+        "game_scale_multihost_step_seconds_n1": round(s1, 4),
+        "game_scale_multihost_step_seconds_n2": round(s2, 4),
+        "game_scale_multihost_scaling": round(s1 / s2, 3) if s2 else None,
+        "game_scale_multihost_efficiency": (
+            round(s1 / s2 / 2.0, 3) if s2 else None),
+        "game_scale_multihost_host_cpu_count": os.cpu_count(),
+        "game_scale_multihost_note": (
+            "2 worker processes timeshare the cores; efficiency gates "
+            "only on a rig with >= 2 cores"
+            if (os.cpu_count() or 1) < 2 else "measured"),
+    }
+
+
 def _game_scale_mesh():
     """Mesh-sharded RE-step scaling A/B (ROADMAP item 1): the same
     entity bucket solved on 1 device vs entity-sharded across every
@@ -2906,10 +2972,10 @@ def bench_game_scale():
             free_rows / total_rows, 4) if total_rows else None,
     }
     # Pipelined data-path A/B + multi-sweep sweep-cache legs (ISSUE 9) +
-    # mesh-sharded RE scaling leg (ISSUE 14).
+    # mesh-sharded RE scaling leg (ISSUE 14) + elastic multi-host leg.
     # Isolated: a failure records a note but never loses the base figures.
     for extra in (_game_scale_data_path, _game_scale_multisweep,
-                  _game_scale_mesh):
+                  _game_scale_mesh, _game_scale_multihost):
         try:
             out.update(extra())
         except Exception as e:  # noqa: BLE001 - recorded, not fatal

@@ -186,6 +186,17 @@ echo "== multichip smoke (8-device mesh: sharded game_scale + shard-loss drill) 
 # harness prints it honestly either way.
 python scripts/multichip_smoke.py
 
+echo "== multihost smoke (3-process elastic mesh: SIGKILL + rejoin drill) =="
+# The executor-loss drill (ROADMAP item 3, docs/scaling.md §"Multi-host
+# mesh", docs/robustness.md §"Host loss"): 3 real worker processes train
+# the elastic GAME loop; SIGKILLing one mid-sweep must journal a classified
+# host_lost + coordinated mesh_shrunk epoch with the dead host's file parts
+# and entity shard redistributed, survivors must finish within 1e-12 of the
+# uninterrupted run with zero retraces after warmup, and restarting the
+# victim must journal host_rejoined + mesh_grown scale-up. The fleet report
+# must render the per-host Mesh section from the same run dir.
+python scripts/multihost_smoke.py
+
 echo "== multichip dryrun (8-device mesh: dp, dp x mp, RE, dcn x dp) =="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('dryrun ok')"
 

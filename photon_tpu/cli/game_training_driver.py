@@ -170,6 +170,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
         add_backend_policy_flag,
         add_compilation_cache_flag,
         add_compile_store_flag,
+        add_distributed_flags,
         add_fault_plan_flag,
         add_re_routing_flags,
         add_telemetry_flag,
@@ -177,6 +178,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     )
 
     add_backend_policy_flag(p)
+    add_distributed_flags(p)
     add_compilation_cache_flag(p)
     add_compile_store_flag(p)
     add_fault_plan_flag(p)
@@ -270,9 +272,18 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
     enable_trace(args.trace_out)
     # Join the multi-host runtime first (no-op single-process) so
     # jax.devices() below sees the whole pod slice (SURVEY.md §5.8).
+    # Bring-up failure is never silent: classified + journaled, and
+    # --distributed-policy decides exit-2 vs degrade-to-single-host
+    # (docs/scaling.md §"Multi-host mesh").
     from photon_tpu.parallel.distributed import initialize_distributed
+    from photon_tpu.supervisor import RecoveryJournal
 
-    initialize_distributed()
+    os.makedirs(args.output_dir, exist_ok=True)
+    initialize_distributed(
+        policy=args.distributed_policy,
+        journal=RecoveryJournal(
+            os.path.join(args.output_dir, "recovery.jsonl")),
+    )
     if args.dtype == "float64":
         import jax
 
@@ -317,10 +328,16 @@ def run(argv: Optional[Sequence[str]] = None) -> dict:
                 SloConfig.from_file(slo_path), min_interval_s=60.0)
         # Short interval: a retry must be able to tell "peer died with me"
         # from "peer is fine", so the staleness window (3x interval) has to
-        # fit inside a restart backoff, not dwarf it.
+        # fit inside a restart backoff, not dwarf it. Every beat also
+        # refreshes host_beacon_age_seconds{host=...} for the whole pod, so
+        # the fleet view shows a dead host as a climbing gauge without
+        # anyone reading beacon files (docs/observability.md §Fleet view).
+        import jax
+
         heartbeat = Heartbeat(
             args.heartbeat_dir, interval_seconds=2.0,
             slo_watchdog=slo_watchdog,
+            peer_gauges=range(jax.process_count()),
         ).start()
 
     def attempt(i: int) -> dict:

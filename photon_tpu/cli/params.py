@@ -556,6 +556,25 @@ def add_backend_policy_flag(parser) -> None:
              "accelerator (default: $PHOTON_BACKEND_POLICY or strict)")
 
 
+def add_distributed_flags(parser) -> None:
+    """Shared --distributed-policy flag (default: $PHOTON_DISTRIBUTED_POLICY
+    or 'strict'): what to do when multi-host bring-up
+    (``jax.distributed.initialize``) fails — coordinator unreachable, rank
+    mismatch, preempted peer (docs/scaling.md §"Multi-host mesh"). Either
+    way the failure is classified, counted, and journaled
+    (``distributed_init_failed``); the policy only decides whether the
+    process dies or degrades to single-host."""
+    import os
+
+    parser.add_argument(
+        "--distributed-policy", choices=["strict", "degrade"],
+        default=os.environ.get("PHOTON_DISTRIBUTED_POLICY") or "strict",
+        help="on failed multi-host bring-up: 'strict' = classified error + "
+             "exit 2 (a silent 1/N-sized mesh must never masquerade as the "
+             "pod); 'degrade' = journal the failure and continue "
+             "single-host (default: $PHOTON_DISTRIBUTED_POLICY or strict)")
+
+
 def enable_backend_guard(args, logger=None) -> dict:
     """Enforce --backend-policy before any in-process backend init. A
     probe that already passed in this process is not repeated (driver

@@ -230,6 +230,29 @@ def reset_process_table() -> None:
         _loaded_paths.clear()
 
 
+def merge_host_tables(paths, out_path: str) -> SolverCostTable:
+    """Fold several per-host cost tables into one persisted table.
+
+    The multi-host run writes ``solver_costs.host-<id>.json`` per host;
+    the coordinator folds them here into ``solver_costs.merged.json`` so a
+    warm restart of ANY host (pointing ``PHOTON_RE_COST_TABLE`` at the
+    merged file) skips calibration outright. ``merge`` means overlapping
+    measurements and the ``@devN`` shape-class suffix keeps entries from a
+    different local-mesh topology inert, so folding is always safe.
+    Unreadable shards are skipped — a torn per-host file must not poison
+    the merged table."""
+    merged = SolverCostTable()
+    for p in paths:
+        other = SolverCostTable()
+        try:
+            other.load(p)
+        except (OSError, ValueError, KeyError):
+            continue
+        merged.merge(other)
+    merged.save(out_path)
+    return merged
+
+
 def candidates_for(problem, bucket, normalization, u_max: int,
                    shards: int = 1) -> list:
     """Feasible chunked candidates for this bucket, Newton variants first.

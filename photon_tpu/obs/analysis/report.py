@@ -320,6 +320,46 @@ def _replication_section(snapshot: Mapping) -> Optional[dict]:
     }
 
 
+def _mesh_section(snapshot: Mapping,
+                  ledger: Sequence[Mapping]) -> Optional[dict]:
+    """Elastic multi-host mesh posture (docs/scaling.md §"Multi-host
+    mesh"): the newest membership epoch and shard assignment from the
+    merged ``mesh-epochs`` ledger, the host-loss / rejoin history, and
+    per-host beacon liveness from the folded
+    ``host_beacon_age_seconds{host=...}`` gauges — a dead host shows up
+    here as a frozen, climbing age WITHOUT anyone reading beacon files.
+    ``None`` when the run had no mesh."""
+    beacons = snapshot.get("host_beacon_age_seconds")
+    beacons = ({k: v for k, v in beacons.items() if k}
+               if isinstance(beacons, dict) else {})
+    epochs = [r for r in ledger
+              if r.get("event") in ("mesh_formed", "mesh_shrunk",
+                                    "mesh_grown")]
+    if not beacons and not epochs:
+        return None
+    newest = max(epochs, default=None,
+                 key=lambda r: (int(r.get("epoch", -1)), r.get("t", 0.0)))
+    losses = [{"host": r.get("host"), "epoch": r.get("epoch"),
+               "time": r.get("time"),
+               "beacon_age_seconds": r.get("beacon_age_seconds")}
+              for r in ledger if r.get("event") == "host_lost"]
+    rejoins = [{"host": r.get("host"), "epoch": r.get("epoch"),
+                "time": r.get("time")}
+               for r in ledger if r.get("event") == "host_rejoined"]
+    redist = [r for r in ledger
+              if r.get("event") == "shard_redistributed"]
+    return {
+        "epoch": None if newest is None else int(newest.get("epoch", -1)),
+        "members": None if newest is None else newest.get("members"),
+        "files": None if newest is None else newest.get("files"),
+        "epoch_rows": len(epochs),
+        "host_losses": losses,
+        "rejoins": rejoins,
+        "redistributions": len(redist),
+        "beacon_age_seconds": beacons,
+    }
+
+
 def _newest_bench(paths: Sequence[str]) -> Optional[dict]:
     """Summarize the newest parseable bench artifact found in the run
     dir (recency from artifact content, per artifacts.newest_artifacts'
@@ -453,6 +493,7 @@ def build_report(
             "snapshot": metrics_snapshot,
         },
         "replication": _replication_section(metrics_snapshot),
+        "mesh": _mesh_section(metrics_snapshot, ledger),
         "recovery_ledger": {
             **_ledger_counts(ledger),
             "events": ledger[-200:],
@@ -523,6 +564,35 @@ def format_markdown(report: Mapping, top: int = 5) -> str:
         lines.append("by classified cause: "
                      + ", ".join(f"{c}={n}" for c, n
                                  in sorted(led["by_cause"].items())))
+
+    mesh = report.get("mesh")
+    if mesh:
+        lines += ["", "## Mesh"]
+        if mesh.get("members") is not None:
+            files = mesh.get("files") or {}
+            lines += [f"epoch {mesh.get('epoch')} — members "
+                      f"{mesh.get('members')} "
+                      f"({mesh.get('epoch_rows')} epoch row(s), "
+                      f"{mesh.get('redistributions')} redistribution(s))",
+                      "", "| host | file shard | beacon age (s) |",
+                      "|---|---|---|"]
+            beacons = mesh.get("beacon_age_seconds") or {}
+            for h in mesh["members"]:
+                age = beacons.get(str(h))
+                lines.append(
+                    f"| {h} | {', '.join(files.get(str(h), []) or files.get(h, []))} | "
+                    + (f"{age:.2f}" if isinstance(age, (int, float))
+                       else "?") + " |")
+        for row in mesh.get("host_losses") or []:
+            age = row.get("beacon_age_seconds")
+            lines.append(
+                f"- host LOST: {row['host']} at epoch {row['epoch']} "
+                f"({row.get('time')}"
+                + (f", beacon age {age:.2f}s" if isinstance(age, (int, float))
+                   else "") + ")")
+        for row in mesh.get("rejoins") or []:
+            lines.append(f"- host rejoined: {row['host']} at epoch "
+                         f"{row['epoch']} ({row.get('time')})")
 
     rep = report.get("replication")
     if rep:

@@ -446,6 +446,11 @@ def discover(run_dir: str, max_depth: int = 4) -> FleetRunFiles:
                 out.registry_shards.append(path)
             elif name.startswith("recovery") and name.endswith(".jsonl"):
                 out.journals.append(path)
+            elif name.startswith("mesh-epochs") and name.endswith(".jsonl"):
+                # The elastic mesh ledger is a RecoveryJournal too: its
+                # host_lost / mesh_shrunk / host_rejoined rows join the
+                # merged recovery timeline and the report's Mesh section.
+                out.journals.append(path)
             elif name == "patch-journal.jsonl":
                 out.patch_journals.append(path)
             elif name.startswith("control-ledger") \

@@ -57,6 +57,7 @@ __all__ = [
     "CAUSE_INIT_UNAVAILABLE",
     "CAUSE_COMPILE_ERROR",
     "CAUSE_DEVICE_LOST",
+    "CAUSE_HOST_LOST",
     "CAUSE_OOM",
     "CAUSE_UNKNOWN",
     "BackendProbeResult",
@@ -80,6 +81,7 @@ BACKEND_POLICIES = ("strict", "failover", "cpu-only")
 CAUSE_INIT_UNAVAILABLE = "init_unavailable"
 CAUSE_COMPILE_ERROR = "compile_error"
 CAUSE_DEVICE_LOST = "device_lost"
+CAUSE_HOST_LOST = "host_lost"
 CAUSE_OOM = "oom"
 CAUSE_UNKNOWN = "unknown"
 
@@ -116,6 +118,17 @@ def max_inrun_recoveries(default: int = 2) -> int:
 # setup/compile error" — an init-phase failure that merely mentions
 # compilation, and restart-with-backoff (not a code change) is its remedy.
 _CAUSE_PATTERNS: tuple = (
+    # ``host_lost`` first: a dead PEER HOST often surfaces through the same
+    # transport noise a dead local device does ("connection reset" from the
+    # coordinator, a collective that never completes) — when the message
+    # names a peer host / missed beacon / mesh barrier, the whole-host
+    # protocol (mesh shrink, parallel/distributed.MeshMembership) owns the
+    # recovery, not the single-device ``recover_from_device_loss`` path.
+    (CAUSE_HOST_LOST, re.compile(
+        r"peer host|host\W{0,3}(was\s+)?lost|missed beacon"
+        r"|beacon.{0,30}stale|mesh barrier.{0,30}(timed? ?out|timeout)"
+        r"|collective.{0,40}waiting for host",
+        re.IGNORECASE)),
     (CAUSE_OOM, re.compile(
         r"RESOURCE_EXHAUSTED|out of memory|\bOOM\b|hbm.{0,20}exhausted",
         re.IGNORECASE)),

@@ -710,6 +710,7 @@ class Heartbeat:
         interval_seconds: float = 10.0,
         slo_watchdog=None,
         memory_guard="auto",
+        peer_gauges: Optional[Sequence[int]] = None,
     ):
         if process_id is None:
             import jax
@@ -728,6 +729,12 @@ class Heartbeat:
         # high-water sweep-cache spill ride the same loop for free.
         # "auto" resolves the process guard at start(); None disables.
         self.memory_guard = memory_guard
+        # Expected peer ids whose beacon ages this process exports as
+        # ``host_beacon_age_seconds{host=...}`` gauges on every beat — the
+        # fleet report and live /fleet then show a dead host (age frozen
+        # and climbing, or -1 for never-seen) without reading journals.
+        self.peer_gauges = (None if peer_gauges is None
+                            else [int(p) for p in peer_gauges])
         self.epoch = 0
         self._stop = None
         self._thread = None
@@ -832,6 +839,10 @@ class Heartbeat:
                     self.beat_once()
                 except OSError:
                     pass  # shared fs hiccup; next beat retries
+                try:
+                    self.export_peer_gauges()
+                except Exception:  # noqa: BLE001 - gauge export must
+                    pass  # never take the liveness beacon down with it
                 map_watch.check()
                 if mem_guard is not None:
                     try:
@@ -859,6 +870,35 @@ class Heartbeat:
 
     def __exit__(self, *exc) -> None:
         self.stop()
+
+    def export_peer_gauges(
+        self, expected: Optional[Sequence[int]] = None
+    ) -> None:
+        """Export ``host_beacon_age_seconds{host=...}`` for each expected
+        peer (default: the ``peer_gauges`` set; no-op when unset). Age is
+        judged like :meth:`check_peers` — against our own beacon's mtime,
+        the shared filesystem's clock — and a host with no beacon file
+        exports -1 (never seen / file vanished)."""
+        expected = self.peer_gauges if expected is None else expected
+        if not expected:
+            return
+        from photon_tpu.obs.metrics import REGISTRY
+
+        gauge = REGISTRY.gauge(
+            "host_beacon_age_seconds",
+            "Seconds since each expected host's last liveness beacon "
+            "(-1: no beacon file); a frozen, climbing age is a dead host",
+        )
+        try:
+            now = os.path.getmtime(self._path(self.process_id))
+        except OSError:
+            now = time.time()
+        for pid in expected:
+            try:
+                age = max(0.0, now - os.path.getmtime(self._path(pid)))
+            except OSError:
+                age = -1.0
+            gauge.set(age, host=str(pid))
 
     def watchdog(
         self,

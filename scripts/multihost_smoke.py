@@ -1,0 +1,229 @@
+"""Multi-host elasticity smoke (ci.sh stage; docs/scaling.md §"Multi-host
+mesh", docs/robustness.md §"Host loss").
+
+N real OS processes on one box play an elastic mesh over a shared
+filesystem — the executor-loss drill photon-ml inherited from Spark, run
+mechanically on every CI pass:
+
+1. **Uninterrupted reference** — 3 worker processes train the elastic
+   GAME loop (``python -m photon_tpu.parallel.elastic``) to completion:
+   every host must report ZERO kernel retraces after warmup and the mesh
+   ledger exactly one ``mesh_formed`` epoch.
+2. **SIGKILL drill** — same run, but host 2 is SIGKILLed mid-sweep (after
+   ``commit-1`` lands). Survivors must classify the silence as
+   ``host_lost``, journal the coordinated shrink (``mesh_shrunk`` +
+   ``shard_redistributed`` rows for the dead host's file parts AND its
+   entity shard), redo the in-flight step from the last commit, and keep
+   training. The victim is then RESTARTED: it must journal
+   ``host_rejoined`` and scale the mesh back up (``mesh_grown``) at a
+   step boundary. Final coefficients must match the uninterrupted run to
+   <= 1e-12 at f64 (they are bit-identical by construction: the global
+   reduction folds per-part partials in canonical part order, so WHO
+   computed a part never changes WHAT is summed), and the survivors must
+   again report zero retraces after warmup — a shrink re-pads to the same
+   bucket shapes instead of recompiling.
+3. **Fleet posture** — the run dir's report must render the Mesh section:
+   per-host topology with beacon liveness plus the host-loss/rejoin
+   ledger, and the coordinator must have folded the per-host solver cost
+   tables into ``solver_costs.merged.json`` when any host measured one.
+
+Scaling efficiency is NOT asserted here (this box may be 1-core; the
+honest N=1 vs N=2 step-time figure is the bench.py ``game_scale_multihost``
+leg, stamped with ``host_cpu_count``).
+"""
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PHOTON_BENCH_SMOKE"] = "1"
+
+HOSTS = 3
+SWEEPS = 4  # 8 coordinate steps: enough boundaries for kill + rejoin
+
+
+def check(cond, msg):
+    if not cond:
+        print(f"MULTIHOST SMOKE FAILED: {msg}", file=sys.stderr)
+        sys.exit(1)
+    print(f"  ok: {msg}")
+
+
+def spawn(mesh_dir, manifest, host_id, min_step=0.0):
+    """One elastic worker process (its own interpreter: a SIGKILL must
+    take out a real host, beacons and all)."""
+    cmd = [
+        sys.executable, "-m", "photon_tpu.parallel.elastic",
+        "--mesh-dir", mesh_dir, "--host-id", str(host_id),
+        "--hosts", str(HOSTS), "--manifest", manifest,
+        "--sweeps", str(SWEEPS), "--min-step-seconds", str(min_step),
+        # Oversubscribed CI box: N python processes timeshare the cores,
+        # so the beacon threads can starve for seconds at a time. A wide
+        # staleness window (0.5s * 10) keeps "slow" from reading as
+        # "dead" — the drill's SIGKILL is still detected in ~5s — and a
+        # modest L-BFGS budget keeps the reduce-round count honest.
+        "--beat-seconds", "0.5", "--stale-factor", "10",
+        "--max-iterations", "12",
+    ]
+    return subprocess.Popen(
+        cmd, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def finish(proc, who, deadline_s=280.0):
+    try:
+        out, err = proc.communicate(timeout=deadline_s)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        out, err = proc.communicate()
+        check(False, f"{who} timed out after {deadline_s}s; stderr tail: "
+              + (err or "")[-800:])
+    check(proc.returncode == 0,
+          f"{who} exited {proc.returncode}; stderr tail: "
+          + (err or "")[-800:])
+    last = (out or "").strip().splitlines()[-1]
+    return json.loads(last)
+
+
+def ledger_rows(mesh_dir):
+    rows = []
+    path = os.path.join(mesh_dir, "mesh-epochs.jsonl")
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+    return rows
+
+
+def wait_for(pred, what, deadline_s=120.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        if pred():
+            return
+        time.sleep(0.1)
+    check(False, f"timed out waiting for {what}")
+
+
+def main():
+    import numpy as np
+
+    from photon_tpu.parallel.elastic import make_synthetic_parts
+
+    tmp = tempfile.mkdtemp(prefix="multihost_smoke_")
+    manifest = make_synthetic_parts(
+        os.path.join(tmp, "data"), n_parts=6, rows_per_part=24, dim=6,
+        n_entities=12)
+
+    # -- leg 1: uninterrupted 3-host reference ----------------------------
+    print("leg 1: uninterrupted 3-host run")
+    mesh_a = os.path.join(tmp, "meshA")
+    procs = [spawn(mesh_a, manifest, h) for h in range(HOSTS)]
+    sums = [finish(p, f"reference host {h}") for h, p in enumerate(procs)]
+    for s in sums:
+        check(s["retraces_after_warmup"] == 0,
+              f"reference host {s['host_id']}: zero retraces after warmup")
+    rows = ledger_rows(mesh_a)
+    check([r["event"] for r in rows] == ["mesh_formed"],
+          "reference ledger is exactly one mesh_formed epoch")
+    ref = np.load(os.path.join(mesh_a, "final-model.npz"))
+
+    # -- leg 2: SIGKILL one host mid-sweep, then bring it back ------------
+    print("leg 2: SIGKILL host 2 mid-sweep, restart it")
+    mesh_b = os.path.join(tmp, "meshB")
+    survivors = [spawn(mesh_b, manifest, h, min_step=0.4) for h in (0, 1)]
+    victim = spawn(mesh_b, manifest, 2, min_step=0.4)
+    wait_for(lambda: os.path.exists(
+        os.path.join(mesh_b, "commits", "commit-1.json")),
+        "commit-1 (kill point)")
+    os.kill(victim.pid, signal.SIGKILL)
+    victim.communicate()
+    print(f"  killed host 2 (pid {victim.pid})")
+    wait_for(lambda: any(r["event"] == "mesh_shrunk"
+                         for r in ledger_rows(mesh_b)),
+             "journaled mesh shrink")
+    rejoiner = spawn(mesh_b, manifest, 2, min_step=0.4)
+    s0 = finish(survivors[0], "survivor host 0")
+    s1 = finish(survivors[1], "survivor host 1")
+    s2 = finish(rejoiner, "rejoined host 2")
+
+    rows = ledger_rows(mesh_b)
+    events = [r["event"] for r in rows]
+    lost = [r for r in rows if r["event"] == "host_lost"]
+    check(lost and lost[0]["host"] == 2 and lost[0]["cause"] == "host_lost",
+          "host_lost journaled for host 2 with classified cause")
+    shrunk = [r for r in rows if r["event"] == "mesh_shrunk"]
+    check(shrunk and shrunk[0]["members"] == [0, 1]
+          and shrunk[0]["dead"] == [2],
+          "mesh_shrunk epoch journaled with surviving members [0, 1]")
+    redist = [r for r in rows if r["event"] == "shard_redistributed"]
+    kinds = {r["kind"] for r in redist}
+    check({"files", "entities"} <= kinds,
+          "dead host's file parts AND entity shard redistributed")
+    moved = [i for r in redist if r["kind"] == "files"
+             and r.get("items") for i in r["items"]]
+    check(any(i in ("p002", "p005") for i in moved),
+          "host 2's file parts reassigned to survivors")
+    check("host_rejoined" in events and "mesh_grown" in events,
+          "restart journaled host_rejoined + mesh_grown scale-up")
+    grown = [r for r in rows if r["event"] == "mesh_grown"][-1]
+    check(grown["members"] == [0, 1, 2],
+          "mesh grew back to all 3 hosts")
+    check(events.index("mesh_shrunk") < events.index("mesh_grown"),
+          "shrink precedes scale-up in the ledger")
+
+    check(s0["shrinks"] >= 1 and s1["shrinks"] >= 1,
+          "both survivors ran the coordinated shrink")
+    check(s2["rejoined"], "host 2 came back via the rejoin path")
+    check(s0["retraces_after_warmup"] == 0
+          and s1["retraces_after_warmup"] == 0,
+          "survivors: zero retraces after warmup across shrink AND regrow")
+
+    got = np.load(os.path.join(mesh_b, "final-model.npz"))
+    for name in ("w", "re_scores"):
+        diff = float(np.max(np.abs(ref[name] - got[name])))
+        check(diff <= 1e-12,
+              f"{name} matches uninterrupted run (max diff {diff:.3e})")
+
+    # -- leg 3: fleet posture ---------------------------------------------
+    print("leg 3: fleet report + merged cost table")
+    from photon_tpu.obs.analysis.report import build_report, format_markdown
+
+    report = build_report(mesh_b)
+    mesh = report.get("mesh")
+    check(mesh is not None, "report has a mesh section")
+    check(mesh["members"] == [0, 1, 2]
+          and len(mesh["host_losses"]) >= 1
+          and len(mesh["rejoins"]) >= 1,
+          "mesh section carries topology + host-loss ledger")
+    md = format_markdown(report)
+    check("## Mesh" in md and "host LOST: 2" in md
+          and "host rejoined: 2" in md,
+          "markdown render shows the loss and the rejoin")
+    check(mesh["beacon_age_seconds"], "per-host beacon ages exported")
+
+    host_tables = glob.glob(os.path.join(mesh_b, "solver_costs.host-*.json"))
+    merged = os.path.join(mesh_b, "solver_costs.merged.json")
+    if host_tables:
+        check(os.path.exists(merged),
+              "coordinator folded per-host cost tables into "
+              "solver_costs.merged.json")
+    else:
+        print("  (no per-host cost tables at smoke shapes; merge leg "
+              "exercised in tests/test_multihost.py)")
+
+    print("MULTIHOST SMOKE PASSED")
+
+
+if __name__ == "__main__":
+    main()

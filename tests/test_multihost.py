@@ -1,0 +1,441 @@
+"""Elastic multi-host mesh (docs/scaling.md §"Multi-host mesh").
+
+Covers the membership layer (formation, barriers, part-keyed reduction,
+empty shards), the coordinated shrink ledger, ragged file-shard
+assignment, classified bring-up failure under --distributed-policy, the
+per-host cost-table merge, beacon-liveness gauges, and the fleet report's
+Mesh section. The full SIGKILL + rejoin drill over real processes runs in
+``scripts/multihost_smoke.py`` (a ci.sh stage); the slow marker here holds
+the subprocess N=1 vs N=2 coefficient-equality check.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from photon_tpu.parallel.distributed import (
+    DistributedInitError,
+    HostLostError,
+    MeshMembership,
+    assign_file_shards,
+    process_file_shard,
+    resolve_distributed_policy,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Shard assignment (pure)
+# ---------------------------------------------------------------------------
+
+
+class TestFileShardAssignment:
+    def test_ragged_round_robin(self):
+        got = assign_file_shards(["a", "b", "c", "d", "e"], [0, 1, 2])
+        assert got == {0: ["a", "d"], 1: ["b", "e"], 2: ["c"]}
+        assert sorted(f for fs in got.values() for f in fs) == [
+            "a", "b", "c", "d", "e"]
+
+    def test_fewer_files_than_hosts_keeps_empty_hosts(self):
+        # The empty-shard host must still get a key: membership, not data
+        # volume, defines who participates in collectives.
+        got = assign_file_shards(["only"], [0, 1, 2])
+        assert got == {0: ["only"], 1: [], 2: []}
+
+    def test_empty_file_list(self):
+        assert assign_file_shards([], [0, 1]) == {0: [], 1: []}
+
+    def test_unsorted_members_assign_deterministically(self):
+        a = assign_file_shards(["a", "b", "c"], [2, 0, 1])
+        b = assign_file_shards(["a", "b", "c"], [0, 1, 2])
+        assert a == b
+
+    def test_process_file_shard_slices_this_hosts_files(self):
+        # Single process: the whole list. (index, count) without files.
+        assert process_file_shard(["x", "y"]) == ["x", "y"]
+        assert process_file_shard() == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# host_lost classification + bring-up policy
+# ---------------------------------------------------------------------------
+
+
+class TestHostLostClassification:
+    def test_host_lost_error_classifies(self):
+        from photon_tpu.runtime.backend_guard import (
+            CAUSE_HOST_LOST,
+            classify_backend_error,
+        )
+
+        e = HostLostError([2], "reduction 's1-r0' epoch 0")
+        assert classify_backend_error(e) == CAUSE_HOST_LOST
+        assert e.dead == [2]
+
+    def test_barrier_timeout_text_classifies(self):
+        from photon_tpu.runtime.backend_guard import (
+            CAUSE_HOST_LOST,
+            classify_backend_error,
+        )
+
+        msg = RuntimeError("mesh barrier timed out at step-3")
+        assert classify_backend_error(msg) == CAUSE_HOST_LOST
+
+
+class TestDistributedPolicy:
+    def test_resolve_precedence_and_validation(self, monkeypatch):
+        monkeypatch.delenv("PHOTON_DISTRIBUTED_POLICY", raising=False)
+        assert resolve_distributed_policy() == "strict"
+        monkeypatch.setenv("PHOTON_DISTRIBUTED_POLICY", "degrade")
+        assert resolve_distributed_policy() == "degrade"
+        assert resolve_distributed_policy("strict") == "strict"  # arg wins
+        with pytest.raises(ValueError):
+            resolve_distributed_policy("yolo")
+
+    def test_strict_failure_is_classified_and_journaled(
+            self, tmp_path, monkeypatch):
+        import jax
+
+        from photon_tpu.parallel.distributed import initialize_distributed
+        from photon_tpu.supervisor import RecoveryJournal
+
+        def boom(**kwargs):
+            raise RuntimeError("coordinator unreachable: connect failed")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        journal = RecoveryJournal(str(tmp_path / "recovery.jsonl"))
+        with pytest.raises(DistributedInitError) as ei:
+            initialize_distributed(
+                "localhost:9999", num_processes=2, process_id=0,
+                policy="strict", journal=journal)
+        assert ei.value.cause  # classified, never a bare traceback
+        rows = [json.loads(line) for line in
+                (tmp_path / "recovery.jsonl").read_text().splitlines()]
+        assert [r["event"] for r in rows] == ["distributed_init_failed"]
+        assert rows[0]["policy"] == "strict" and rows[0]["cause"]
+
+    def test_degrade_continues_single_host(self, tmp_path, monkeypatch):
+        import jax
+
+        from photon_tpu.parallel.distributed import initialize_distributed
+        from photon_tpu.supervisor import RecoveryJournal
+
+        def boom(**kwargs):
+            raise RuntimeError("coordinator unreachable: connect failed")
+
+        monkeypatch.setattr(jax.distributed, "initialize", boom)
+        journal = RecoveryJournal(str(tmp_path / "recovery.jsonl"))
+        assert initialize_distributed(
+            "localhost:9999", num_processes=2, process_id=0,
+            policy="degrade", journal=journal) is False
+        rows = [json.loads(line) for line in
+                (tmp_path / "recovery.jsonl").read_text().splitlines()]
+        assert rows and rows[0]["event"] == "distributed_init_failed"
+
+    def test_driver_flag_registered(self):
+        import argparse
+
+        from photon_tpu.cli.params import add_distributed_flags
+
+        p = argparse.ArgumentParser()
+        add_distributed_flags(p)
+        assert p.parse_args([]).distributed_policy == "strict"
+        assert p.parse_args(
+            ["--distributed-policy", "degrade"]).distributed_policy \
+            == "degrade"
+
+
+# ---------------------------------------------------------------------------
+# Membership protocol (threads standing in for hosts)
+# ---------------------------------------------------------------------------
+
+
+def _run_hosts(fn, n, **kwargs):
+    """Run fn(host_id) on n threads; re-raise the first failure."""
+    errors = []
+
+    def wrap(h):
+        try:
+            fn(h)
+        except BaseException as e:  # noqa: BLE001 - surfaced to pytest
+            errors.append((h, e))
+
+    threads = [threading.Thread(target=wrap, args=(h,), daemon=True)
+               for h in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    if errors:
+        raise errors[0][1]
+    assert not any(t.is_alive() for t in threads), "host thread hung"
+
+
+class TestMeshMembership:
+    def test_form_barrier_reduce_with_empty_shard(self, tmp_path):
+        """3 hosts over 2 parts: the part-less host still barriers and
+        receives the full reduction — membership defines the collective."""
+        results = {}
+
+        def host(h):
+            mem = MeshMembership(
+                str(tmp_path), h, 3, ["a", "b"],
+                beat_seconds=0.1, stale_factor=30.0, wait_timeout=30.0)
+            try:
+                mem.start(form_timeout=30.0)
+                assert mem.members == [0, 1, 2]
+                assert mem.epoch == 0
+                payloads = {pid: {"v": np.full(2, float(h) + 1.0)}
+                            for pid in mem.my_files()}
+                out = mem.reduce_parts("t0", payloads)
+                folded = sum(out[p]["v"][0] for p in mem.part_ids)
+                mem.barrier("done")
+                results[h] = (mem.my_files(), folded)
+            finally:
+                mem.stop()
+
+        _run_hosts(host, 3)
+        assert results[0][0] == ["a"] and results[1][0] == ["b"]
+        assert results[2][0] == []  # empty shard, still participated
+        # Every host folded the SAME global value (owner 0 wrote 1.0 for
+        # part a, owner 1 wrote 2.0 for part b).
+        assert {r[1] for r in results.values()} == {3.0}
+
+    def test_shrink_journals_loss_and_redistributes(self, tmp_path):
+        """Survivor-coordinated shrink: classified host_lost row, epoch
+        row, and the dead host's parts reassigned to the survivor."""
+        formed = threading.Event()
+        die = threading.Event()
+        out = {}
+
+        def host(h):
+            mem = MeshMembership(
+                str(tmp_path), h, 2, ["a", "b"],
+                beat_seconds=0.1, stale_factor=3.0, wait_timeout=30.0)
+            mem.start(form_timeout=30.0)
+            if h == 1:  # this host "dies": beacons stop, thread exits
+                formed.wait(30.0)
+                mem.hb.stop()
+                die.set()
+                return
+            formed.set()
+            die.wait(30.0)
+            time.sleep(0.5)  # let host 1's last beat age past staleness
+            try:
+                mem.handle_loss([1])
+                out["members"] = mem.members
+                out["files"] = mem.files
+                out["epoch"] = mem.epoch
+            finally:
+                mem.stop()
+
+        _run_hosts(host, 2)
+        assert out["members"] == [0]
+        assert out["files"] == {0: ["a", "b"]}
+        assert out["epoch"] == 1
+        rows = [json.loads(line) for line in
+                (tmp_path / "mesh-epochs.jsonl").read_text().splitlines()]
+        events = [r["event"] for r in rows]
+        assert events[0] == "mesh_formed"
+        assert "host_lost" in events and "mesh_shrunk" in events
+        lost = rows[events.index("host_lost")]
+        assert lost["host"] == 1 and lost["cause"] == "host_lost"
+        moved = [r for r in rows if r["event"] == "shard_redistributed"
+                 and r.get("kind") == "files"]
+        assert moved and moved[0]["host"] == 0 and "b" in moved[0]["items"]
+
+    def test_shrink_budget_exhaustion_escalates(self, tmp_path):
+        die = threading.Event()
+
+        def host(h):
+            mem = MeshMembership(
+                str(tmp_path), h, 2, ["a"],
+                beat_seconds=0.1, stale_factor=3.0, wait_timeout=30.0,
+                max_shrinks=0)
+            mem.start(form_timeout=30.0)
+            if h == 1:
+                mem.hb.stop()
+                die.set()
+                return
+            die.wait(30.0)
+            time.sleep(0.5)
+            try:
+                with pytest.raises(RuntimeError, match="budget exhausted"):
+                    mem.handle_loss([1])
+            finally:
+                mem.stop()
+
+        _run_hosts(host, 2)
+        rows = [json.loads(line) for line in
+                (tmp_path / "mesh-epochs.jsonl").read_text().splitlines()]
+        assert any(r["event"] == "recovery_budget_exhausted"
+                   and r["scope"] == "mesh_shrink" for r in rows)
+
+
+# ---------------------------------------------------------------------------
+# Beacon gauges + fleet report Mesh section
+# ---------------------------------------------------------------------------
+
+
+class TestBeaconGauges:
+    def test_export_peer_gauges(self, tmp_path):
+        from photon_tpu.obs.metrics import REGISTRY
+        from photon_tpu.supervisor import Heartbeat
+
+        hb = Heartbeat(str(tmp_path), process_id=0, memory_guard=None,
+                       peer_gauges=[0, 1])
+        hb.beat_once()
+        hb.export_peer_gauges()
+        snap = REGISTRY.snapshot()["host_beacon_age_seconds"]
+        assert 0.0 <= snap["0"] < 5.0   # own beacon: fresh
+        assert snap["1"] == -1.0        # never beaconed: sentinel, not 0
+
+
+class TestFleetMeshSection:
+    def _ledger_rows(self):
+        return [
+            {"event": "mesh_formed", "epoch": 0, "t": 1.0,
+             "members": [0, 1], "files": {"0": ["a"], "1": ["b"]}},
+            {"event": "host_lost", "host": 1, "cause": "host_lost",
+             "epoch": 0, "t": 2.0, "time": "T1",
+             "beacon_age_seconds": 1.5},
+            {"event": "mesh_shrunk", "epoch": 1, "t": 2.1,
+             "members": [0], "files": {"0": ["a", "b"]}, "dead": [1]},
+            {"event": "shard_redistributed", "kind": "files", "host": 0,
+             "t": 2.2, "items": ["b"]},
+            {"event": "host_rejoined", "host": 1, "epoch": 1, "t": 3.0,
+             "time": "T2"},
+            {"event": "mesh_grown", "epoch": 2, "t": 3.1,
+             "members": [0, 1], "files": {"0": ["a"], "1": ["b"]},
+             "joined": [1]},
+        ]
+
+    def test_mesh_section_shape(self):
+        from photon_tpu.obs.analysis.report import _mesh_section
+
+        snap = {"host_beacon_age_seconds": {"0": 0.1, "1": 7.5}}
+        mesh = _mesh_section(snap, self._ledger_rows())
+        assert mesh["epoch"] == 2 and mesh["members"] == [0, 1]
+        assert mesh["host_losses"] == [
+            {"host": 1, "epoch": 0, "time": "T1",
+             "beacon_age_seconds": 1.5}]
+        assert mesh["rejoins"][0]["host"] == 1
+        assert mesh["redistributions"] == 1
+        assert mesh["beacon_age_seconds"]["1"] == 7.5
+
+    def test_no_mesh_run_has_no_section(self):
+        from photon_tpu.obs.analysis.report import _mesh_section
+
+        assert _mesh_section({}, []) is None
+        assert _mesh_section({"other_metric": 1.0},
+                             [{"event": "run_restart"}]) is None
+
+    def test_report_end_to_end_renders_mesh(self, tmp_path):
+        from photon_tpu.obs import fleet
+        from photon_tpu.obs.analysis.report import (
+            build_report,
+            format_markdown,
+        )
+        from photon_tpu.obs.metrics import REGISTRY
+
+        with open(tmp_path / "mesh-epochs.jsonl", "w") as f:
+            for row in self._ledger_rows():
+                f.write(json.dumps({"time": "T0", "pid": 1, **row}) + "\n")
+        REGISTRY.gauge("host_beacon_age_seconds", "t").set(0.2, host="0")
+        fleet.write_registry_shard(
+            str(tmp_path / "registry.mesh-host-0.json"), role="mesh-host")
+        report = build_report(str(tmp_path))
+        assert report["mesh"]["members"] == [0, 1]
+        md = format_markdown(report)
+        assert "## Mesh" in md
+        assert "host LOST: 1" in md and "host rejoined: 1" in md
+
+
+# ---------------------------------------------------------------------------
+# Cost-table merge
+# ---------------------------------------------------------------------------
+
+
+class TestCostTableMerge:
+    def _table(self, tmp_path, name, entries):
+        from photon_tpu.game.solver_routing import SolverCostTable
+
+        t = SolverCostTable()
+        t.load_json({"version": 1, "entries": entries})
+        path = str(tmp_path / name)
+        t.save(path)
+        return path
+
+    def test_merge_means_overlap_adopts_rest(self, tmp_path):
+        from photon_tpu.game.solver_routing import merge_host_tables
+
+        a = self._table(tmp_path, "solver_costs.host-0.json",
+                        {"S32_P8@dev1": {"newton@256": 1.0, "lbfgs": 4.0}})
+        b = self._table(tmp_path, "solver_costs.host-1.json",
+                        {"S32_P8@dev1": {"newton@256": 3.0},
+                         "S32_P8@dev8": {"newton@256": 9.0}})
+        out = str(tmp_path / "solver_costs.merged.json")
+        merged = merge_host_tables([a, b], out)
+        entries = merged.to_json()["entries"]
+        assert entries["S32_P8@dev1"]["newton@256"] == 2.0  # mean
+        assert entries["S32_P8@dev1"]["lbfgs"] == 4.0       # adopted
+        assert entries["S32_P8@dev8"]["newton@256"] == 9.0  # @devN inert
+        assert os.path.exists(out)
+
+    def test_torn_shard_skipped(self, tmp_path):
+        from photon_tpu.game.solver_routing import merge_host_tables
+
+        good = self._table(tmp_path, "solver_costs.host-0.json",
+                           {"S4_P4@dev1": {"lbfgs": 2.0}})
+        torn = tmp_path / "solver_costs.host-1.json"
+        torn.write_text("{not json")
+        merged = merge_host_tables([good, str(torn)],
+                                   str(tmp_path / "merged.json"))
+        assert merged.to_json()["entries"]["S4_P4@dev1"]["lbfgs"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# Elastic trainer: membership-invariant coefficients (subprocess, slow)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestElasticEquality:
+    def test_two_hosts_match_one_host_bitwise(self, tmp_path):
+        """The whole elasticity argument in one assert: the global
+        reduction folds per-part partials in canonical part order, so the
+        optimizer trajectory cannot depend on the part->host assignment.
+        N=1 and N=2 worker processes must produce IDENTICAL coefficients
+        (the SIGKILL mid-run version lives in scripts/multihost_smoke.py)."""
+        from photon_tpu.parallel.elastic import make_synthetic_parts
+
+        manifest = make_synthetic_parts(
+            str(tmp_path / "data"), n_parts=4, rows_per_part=12, dim=5,
+            n_entities=6)
+
+        def run(n_hosts):
+            mesh = str(tmp_path / f"mesh{n_hosts}")
+            procs = [subprocess.Popen(
+                [sys.executable, "-m", "photon_tpu.parallel.elastic",
+                 "--mesh-dir", mesh, "--host-id", str(h),
+                 "--hosts", str(n_hosts), "--manifest", manifest,
+                 "--sweeps", "1", "--max-iterations", "8",
+                 "--beat-seconds", "0.5", "--stale-factor", "20"],
+                cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True,
+                env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            ) for h in range(n_hosts)]
+            for p in procs:
+                out, err = p.communicate(timeout=240)
+                assert p.returncode == 0, err[-800:]
+            return np.load(os.path.join(mesh, "final-model.npz"))
+
+        one, two = run(1), run(2)
+        np.testing.assert_array_equal(one["w"], two["w"])
+        np.testing.assert_array_equal(one["re_scores"], two["re_scores"])
